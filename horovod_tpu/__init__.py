@@ -1,0 +1,161 @@
+"""horovod_tpu — a TPU-native distributed training framework with the
+capability set of Horovod v0.16 (reference: /root/reference), re-designed for
+JAX/XLA on TPU pod slices.
+
+Five-line usage, matching the reference's contract (README.md:96-119):
+
+    import horovod_tpu as hvd
+    hvd.init()
+    mesh = hvd.default_mesh()                 # pin to the pod, not a GPU id
+    opt = hvd.jax.DistributedOptimizer(optax.sgd(lr * hvd.num_chips()))
+    params = hvd.jax.broadcast_parameters(params, root_rank=0)  # in step fn
+
+Two data planes:
+- compiled (jit/shard_map): mesh-axis collectives, zero runtime state;
+- eager (torch/numpy/host): background engine with coordinator negotiation,
+  fusion, timeline, stall detection — the reference's runtime model.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .common.basics import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    is_homogeneous,
+    mpi_threads_supported,
+    default_mesh,
+    config,
+    NotInitializedError,
+)
+from .common.topology import num_devices as num_chips, num_local_devices  # noqa: F401
+from .compression import Compression  # noqa: F401
+from .parallel.collectives import ReduceOp  # noqa: F401
+from .parallel.mesh import (  # noqa: F401
+    HVD_AXIS,
+    data_parallel_mesh,
+    hierarchical_mesh,
+    training_mesh,
+)
+
+# Submodules (framework bindings) are imported lazily to keep `import
+# horovod_tpu` cheap and framework-optional, like the reference's per-framework
+# packages (horovod.tensorflow vs horovod.torch import independently).
+from . import jax  # noqa: F401  (JAX is the required core framework)
+
+
+def _is_tracer(x) -> bool:
+    import jax as _jax
+
+    return isinstance(x, _jax.core.Tracer)
+
+
+def allreduce(tensor, average: bool = True, name: str | None = None,
+              axis_name: str = HVD_AXIS, op: ReduceOp | None = None):
+    """Allreduce that works in both worlds (reference hvd.allreduce,
+    tensorflow/__init__.py:46-92):
+
+    - inside jit/shard_map: lowers to psum/pmean over ``axis_name``;
+    - eager numpy/host values: routed through the background engine.
+    """
+    if op is None:
+        op = ReduceOp.AVERAGE if average else ReduceOp.SUM
+    if _is_tracer(tensor):
+        from .parallel import collectives
+
+        return collectives.allreduce(tensor, axis_name, op)
+    import numpy as _np
+
+    arr = _np.asarray(tensor)
+    from .common import basics
+
+    return basics.engine().run("allreduce", arr, name or f"allreduce.{arr.shape}",
+                               average=(op == ReduceOp.AVERAGE))
+
+
+def allgather(tensor, name: str | None = None, axis_name: str = HVD_AXIS):
+    """Allgather, concatenating along dim 0 (reference hvd.allgather)."""
+    if _is_tracer(tensor):
+        from .parallel import collectives
+
+        return collectives.allgather(tensor, axis_name)
+    import numpy as _np
+
+    arr = _np.asarray(tensor)
+    from .common import basics
+
+    return basics.engine().run("allgather", arr, name or f"allgather.{arr.shape}")
+
+
+def broadcast(tensor, root_rank: int = 0, name: str | None = None,
+              axis_name: str = HVD_AXIS):
+    """Broadcast from ``root_rank`` (reference hvd.broadcast)."""
+    if _is_tracer(tensor):
+        from .parallel import collectives
+
+        return collectives.broadcast(tensor, root_rank, axis_name)
+    import numpy as _np
+
+    arr = _np.asarray(tensor)
+    from .common import basics
+
+    return basics.engine().run("broadcast", arr, name or f"broadcast.{arr.shape}",
+                               root_rank=root_rank)
+
+
+def alltoall(tensor, name: str | None = None, axis_name: str = HVD_AXIS):
+    """All-to-all (beyond the reference's op set; needed for sequence
+    parallelism — SURVEY.md §5.7)."""
+    if _is_tracer(tensor):
+        from .parallel import collectives
+
+        return collectives.alltoall(tensor, axis_name)
+    import numpy as _np
+
+    arr = _np.asarray(tensor)
+    from .common import basics
+
+    return basics.engine().run("alltoall", arr, name or f"alltoall.{arr.shape}")
+
+
+def reducescatter(tensor, average: bool = False, name: str | None = None,
+                  axis_name: str = HVD_AXIS):
+    """Reduce-scatter (public here; internal-only in the reference,
+    operations.cc:1350)."""
+    if _is_tracer(tensor):
+        from .parallel import collectives
+
+        return collectives.reducescatter(tensor, axis_name, average=average)
+    import numpy as _np
+
+    arr = _np.asarray(tensor)
+    from .common import basics
+
+    return basics.engine().run("reducescatter", arr, name or f"rs.{arr.shape}",
+                               average=average)
+
+
+def run_on_mesh(fn, mesh=None, axis_name: str = HVD_AXIS, in_specs=None, out_specs=None):
+    """shard_map ``fn`` over the (default data-parallel) mesh so the in-jit
+    collectives above have their axis in scope. Batch dim 0 is sharded across
+    the axis by default; everything else replicated."""
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    if mesh is None:
+        mesh = default_mesh()
+    if in_specs is None:
+        in_specs = P(axis_name)
+    if out_specs is None:
+        out_specs = P()
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_vma=False)

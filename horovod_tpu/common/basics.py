@@ -1,0 +1,173 @@
+"""Lifecycle state — the TPU analog of HorovodBasics + HorovodGlobalState.
+
+The reference's HorovodBasics (horovod/common/__init__.py:51-154) is a ctypes
+wrapper over the C ABI (horovod_init/_rank/_size/..., operations.h:76-106,
+operations.cc:2413-2468). Here the same contract is split:
+
+- topology & lifecycle live in this Python object (no MPI to spin up);
+- the native background engine (horovod_tpu/cc) is attached lazily for the
+  eager/host data plane and owns the coordinator tick, fusion planner,
+  timeline and stall check, exactly like the reference's background thread
+  (operations.cc:1695-2380);
+- the compiled data plane needs no runtime state at all: mesh axes are the
+  communicators.
+
+``init()`` is idempotent (reference InitializeHorovodOnce test_and_set guard,
+operations.cc:2384-2401); ``shutdown()`` allows re-init (operations.cc:2424-2432).
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Optional, Sequence
+
+from .config import Config
+from .topology import Topology, detect, num_devices, num_local_devices
+from ..utils.logging import log
+
+
+class NotInitializedError(RuntimeError):
+    def __init__(self) -> None:
+        super().__init__(
+            "Horovod has not been initialized; use hvd.init()."
+        )
+
+
+class _State:
+    """Singleton global state (reference HorovodGlobalState, operations.cc:115)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.initialized = False
+        self.topology: Optional[Topology] = None
+        self.config: Optional[Config] = None
+        self.engine = None          # native engine handle, attached lazily
+        self.mesh = None            # default data-parallel mesh, created lazily
+        self._atexit_registered = False
+
+
+_state = _State()
+
+
+def init(comm: Optional[Sequence[int]] = None) -> None:
+    """Initialize. ``comm`` may be a list of ranks forming a subset world
+    (reference horovod_init with ranks[], operations.cc:2415; mpi4py comms have
+    no TPU analog and raise)."""
+    with _state._lock:
+        if _state.initialized:
+            return
+        topo = detect()
+        if comm is not None:
+            if not isinstance(comm, (list, tuple)):
+                raise ValueError(
+                    "comm must be a list of ranks on TPU (MPI communicators do not exist here)"
+                )
+            ranks = sorted(comm)
+            if topo.rank not in ranks and topo.size > 1:
+                raise ValueError(f"rank {topo.rank} not in comm {ranks}")
+            if topo.size > 1:
+                topo = Topology(
+                    rank=ranks.index(topo.rank),
+                    size=len(ranks),
+                    local_rank=topo.local_rank,
+                    local_size=min(topo.local_size, len(ranks)),
+                    cross_rank=topo.cross_rank,
+                    cross_size=topo.cross_size,
+                )
+        _state.topology = topo
+        _state.config = Config.from_env()
+        _state.initialized = True
+        if not _state._atexit_registered:
+            atexit.register(shutdown)
+            _state._atexit_registered = True
+        log("debug", f"horovod_tpu initialized: {topo}", rank=topo.rank)
+
+
+def shutdown() -> None:
+    """Tear down (reference horovod_shutdown, operations.cc:2424-2432);
+    re-init is allowed afterwards."""
+    with _state._lock:
+        if not _state.initialized:
+            return
+        if _state.engine is not None:
+            try:
+                _state.engine.shutdown()
+            except Exception as e:  # pragma: no cover
+                log("warning", f"engine shutdown failed: {e}")
+            _state.engine = None
+        _state.mesh = None
+        _state.topology = None
+        _state.config = None
+        _state.initialized = False
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def _topo() -> Topology:
+    if not _state.initialized or _state.topology is None:
+        raise NotInitializedError()
+    return _state.topology
+
+
+def rank() -> int:
+    return _topo().rank
+
+
+def size() -> int:
+    return _topo().size
+
+
+def local_rank() -> int:
+    return _topo().local_rank
+
+
+def local_size() -> int:
+    return _topo().local_size
+
+
+def cross_rank() -> int:
+    return _topo().cross_rank
+
+
+def cross_size() -> int:
+    return _topo().cross_size
+
+
+def is_homogeneous() -> bool:
+    return _topo().is_homogeneous
+
+
+def config() -> Config:
+    if not _state.initialized or _state.config is None:
+        raise NotInitializedError()
+    return _state.config
+
+
+def mpi_threads_supported() -> bool:
+    """Parity shim for hvd.mpi_threads_supported() (operations.cc:2460-2467).
+    There is no MPI on TPU; the host control plane is always thread-safe."""
+    _topo()
+    return True
+
+
+def default_mesh():
+    """Lazily-created 1-D 'hvd' mesh over all visible chips."""
+    _topo()
+    if _state.mesh is None:
+        from ..parallel.mesh import data_parallel_mesh
+
+        _state.mesh = data_parallel_mesh()
+    return _state.mesh
+
+
+def engine():
+    """Lazily attach the native eager engine (host data plane)."""
+    _topo()
+    if _state.engine is None:
+        from . import engine as engine_mod
+
+        _state.engine = engine_mod.create(_topo(), config())
+    return _state.engine

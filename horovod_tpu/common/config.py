@@ -1,0 +1,99 @@
+"""Runtime configuration for horovod_tpu.
+
+Mirrors the reference's env-var config surface (horovod/common/operations.h:56-66,
+parsed once in BackgroundThreadLoop, operations.cc:1837-1909). All knobs are
+environment variables read once at init(); the autotuner may override the
+non-pinned ones at runtime, exactly like the reference's ParameterManager
+(parameter_manager.cc:145-233).
+
+TPU-first differences:
+- fusion threshold applies to gradient-bucket concatenation before a single
+  ``psum`` (the XLA collective replaces ncclAllReduce);
+- cycle time drives the host-side negotiation engine used by the eager
+  (torch / numpy) path only — inside ``jit`` ordering is static at trace time.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() not in ("0", "false", "no", "")
+
+
+# Default tensor fusion threshold: 64 MiB (reference operations.cc:1838).
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
+# Default cycle time: 5 ms (reference operations.cc:1844).
+DEFAULT_CYCLE_TIME_MS = 5.0
+# Stall-check warning period: 60 s (reference operations.cc:258 STALL_WARNING_TIME).
+STALL_WARNING_TIME_S = 60.0
+
+
+@dataclass
+class Config:
+    """Knobs parsed from the environment, one field per reference env var."""
+
+    fusion_threshold: int = DEFAULT_FUSION_THRESHOLD      # HOROVOD_FUSION_THRESHOLD
+    cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS          # HOROVOD_CYCLE_TIME
+    timeline: str = ""                                    # HOROVOD_TIMELINE
+    timeline_mark_cycles: bool = False                    # HOROVOD_TIMELINE_MARK_CYCLES
+    autotune: bool = False                                # HOROVOD_AUTOTUNE
+    autotune_log: str = ""                                # HOROVOD_AUTOTUNE_LOG
+    stall_check_disable: bool = False                     # HOROVOD_STALL_CHECK_DISABLE
+    hierarchical_allreduce: bool = False                  # HOROVOD_HIERARCHICAL_ALLREDUCE
+    hierarchical_allgather: bool = False                  # HOROVOD_HIERARCHICAL_ALLGATHER
+    log_level: str = "warning"                            # HOROVOD_LOG_LEVEL
+    log_hide_time: bool = False                           # HOROVOD_LOG_HIDE_TIME
+    # Which env vars were explicitly pinned (autotuner must not override,
+    # reference operations.cc:1840-1879 "fixed=true").
+    pinned: set = field(default_factory=set)
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        cfg = cls(
+            fusion_threshold=_env_int("HOROVOD_FUSION_THRESHOLD", DEFAULT_FUSION_THRESHOLD),
+            cycle_time_ms=_env_float("HOROVOD_CYCLE_TIME", DEFAULT_CYCLE_TIME_MS),
+            timeline=os.environ.get("HOROVOD_TIMELINE", ""),
+            timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES"),
+            autotune=_env_bool("HOROVOD_AUTOTUNE"),
+            autotune_log=os.environ.get("HOROVOD_AUTOTUNE_LOG", ""),
+            stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
+            hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
+            hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
+            log_level=os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(),
+            log_hide_time=_env_bool("HOROVOD_LOG_HIDE_TIME"),
+        )
+        for var in (
+            "HOROVOD_FUSION_THRESHOLD",
+            "HOROVOD_CYCLE_TIME",
+            "HOROVOD_HIERARCHICAL_ALLREDUCE",
+            "HOROVOD_HIERARCHICAL_ALLGATHER",
+        ):
+            if os.environ.get(var) not in (None, ""):
+                cfg.pinned.add(var)
+        return cfg

@@ -1,0 +1,463 @@
+"""Eager/host-side collective engine — the runtime negotiation path.
+
+This is the analog of the reference's background-thread engine
+(operations.cc:1695-2380): framework threads enqueue named tensors
+asynchronously and get handles; a background loop ticks every cycle_time,
+negotiates which tensors are globally ready (every rank submitted them),
+fuses eligible ones, executes the collective, and fires completions
+(HandleManager, torch/handle_manager.h:32-43).
+
+It serves the *eager* path only — torch tensors, numpy arrays, host metrics.
+The compiled JAX path needs none of this (ordering is static at trace time).
+
+Two implementations behind one interface:
+- the native C++ engine (horovod_tpu/cc, loaded via ctypes) — preferred;
+- this Python engine — reference semantics, used as fallback and for
+  single-process worlds.
+
+Control plane: rank 0 is coordinator over TCP (replaces the per-tick
+MPI_Gather/MPI_Bcast of RequestLists/ResponseLists, operations.cc:2088-2109,
+2282-2287). Data plane: the coordinator relays reduced buffers (correct,
+simple); the native engine upgrades this to a ring.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from .config import Config, STALL_WARNING_TIME_S
+from .topology import Topology
+from ..utils.logging import log
+
+
+class HorovodInternalError(RuntimeError):
+    """Collective failed (reference Status::UnknownError surfaced through
+    ThrowIfError, torch/adapter_v2.cc)."""
+
+
+class TensorShapeMismatchError(HorovodInternalError):
+    """Rank-divergent shape/dtype/op — the reference turns this into
+    Response::ERROR delivered to every rank instead of a deadlock
+    (ConstructResponse, operations.cc:321-523)."""
+
+
+# ---------------------------------------------------------------- wire helpers
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (n,) = struct.unpack("!Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+# ------------------------------------------------------------------ handles
+
+class HandleManager:
+    """int handle → status map (reference torch/handle_manager.{cc,h})."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next = 0
+        self._results: dict[int, tuple[Optional[Exception], Any]] = {}
+        self._done = threading.Condition(self._lock)
+
+    def allocate(self) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            return h
+
+    def mark_done(self, handle: int, error: Optional[Exception], result: Any) -> None:
+        with self._done:
+            self._results[handle] = (error, result)
+            self._done.notify_all()
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            return handle in self._results
+
+    def wait_and_clear(self, handle: int, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._done:
+            while handle not in self._results:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(f"handle {handle} not done")
+                self._done.wait(remaining)
+            error, result = self._results.pop(handle)
+        if error is not None:
+            raise error
+        return result
+
+
+# ------------------------------------------------------------------ engine
+
+_OPS = ("allreduce", "allgather", "broadcast", "alltoall", "reducescatter")
+
+
+class PyEngine:
+    """Python reference implementation of the eager engine."""
+
+    def __init__(self, topo: Topology, config: Config) -> None:
+        self.topo = topo
+        self.config = config
+        self.handles = HandleManager()
+        self._shutdown = threading.Event()
+        self._lock = threading.Lock()
+        # name → (op, array, root, handle, enqueue_time); the tensor table
+        # (reference operations.cc:121-127 tensor_table + message_queue).
+        self._queue: list[dict] = []
+        self._timeline = None
+        if config.timeline and topo.rank == 0:
+            from ..utils.timeline import Timeline
+
+            self._timeline = Timeline(config.timeline, mark_cycles=config.timeline_mark_cycles)
+        self._coord: Optional[_Coordinator] = None
+        self._client: Optional[_Client] = None
+        if topo.size > 1:
+            addr = os.environ.get("HOROVOD_COORD_ADDR")
+            if not addr:
+                raise HorovodInternalError(
+                    "multi-process eager collectives need HOROVOD_COORD_ADDR "
+                    "(set by the horovod_tpu launcher)"
+                )
+            host, port = addr.rsplit(":", 1)
+            if topo.rank == 0:
+                self._coord = _Coordinator(topo.size, host, int(port))
+                self._coord.start()
+            self._client = _Client(host, int(port), topo.rank)
+        self._thread = threading.Thread(
+            target=self._loop, name="horovod_tpu_engine", daemon=True
+        )
+        self._thread.start()
+
+    # -- public enqueue API (reference EnqueueTensorAllreduce/..., operations.cc:2472-2591)
+
+    def enqueue(self, op: str, array: np.ndarray, name: str, root_rank: int = 0,
+                average: bool = True) -> int:
+        if op not in _OPS:
+            raise ValueError(f"unknown op {op}")
+        if self._shutdown.is_set():
+            raise HorovodInternalError("Horovod has been shut down")
+        handle = self.handles.allocate()
+        entry = {
+            "op": op,
+            "array": np.asarray(array),
+            "name": name,
+            "root": root_rank,
+            "average": average,
+            "handle": handle,
+            "t": time.monotonic(),
+        }
+        with self._lock:
+            self._queue.append(entry)
+        if self._timeline:
+            self._timeline.negotiate_start(name, op.upper())
+        return handle
+
+    def poll(self, handle: int) -> bool:
+        return self.handles.poll(handle)
+
+    def synchronize(self, handle: int, timeout: Optional[float] = None) -> Any:
+        return self.handles.wait_and_clear(handle, timeout)
+
+    def run(self, op: str, array: np.ndarray, name: str, **kw) -> Any:
+        return self.synchronize(self.enqueue(op, array, name, **kw))
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self._thread.join(timeout=5)
+        if self._client:
+            self._client.close()
+        if self._coord:
+            self._coord.stop()
+        if self._timeline:
+            self._timeline.close()
+        # Fail outstanding callbacks (reference SHUT_DOWN_ERROR, operations.cc:263-268)
+        with self._lock:
+            for e in self._queue:
+                self.handles.mark_done(
+                    e["handle"], HorovodInternalError("Horovod has been shut down"), None
+                )
+            self._queue.clear()
+
+    # -- background loop (reference RunLoopOnce, operations.cc:2030-2380)
+
+    def _loop(self) -> None:
+        last_stall_check = time.monotonic()
+        while not self._shutdown.is_set():
+            time.sleep(self.config.cycle_time_ms / 1000.0)
+            if self._timeline:
+                self._timeline.mark_cycle()
+            with self._lock:
+                batch = self._queue
+                self._queue = []
+            if self.topo.size == 1:
+                for e in batch:
+                    self._complete_local(e)
+            else:
+                self._negotiate_and_execute(batch)
+            if (not self.config.stall_check_disable
+                    and time.monotonic() - last_stall_check > STALL_WARNING_TIME_S):
+                self._check_stalled()
+                last_stall_check = time.monotonic()
+
+    def _complete_local(self, e: dict) -> None:
+        name, arr = e["name"], e["array"]
+        if self._timeline:
+            self._timeline.start(name, e["op"].upper())
+        if e["op"] == "allgather":
+            result = arr
+        elif e["op"] == "alltoall":
+            result = arr
+        else:
+            result = arr
+        if self._timeline:
+            self._timeline.end(name)
+        self.handles.mark_done(e["handle"], None, result)
+
+    def _negotiate_and_execute(self, batch: list[dict]) -> None:
+        # Workers ship their request list to the coordinator (MPI_Gatherv
+        # analog); coordinator matches by name across ranks, validates,
+        # executes, and ships results back (MPI_Bcast analog). The relay also
+        # carries the data, so negotiation+execution is one round trip here.
+        requests = [
+            {
+                "name": e["name"], "op": e["op"], "shape": tuple(e["array"].shape),
+                "dtype": str(e["array"].dtype), "root": e["root"],
+                "average": e["average"],
+            }
+            for e in batch
+        ]
+        arrays = {e["name"]: e["array"] for e in batch}
+        try:
+            results = self._client.exchange(requests, arrays)
+        except Exception as exc:
+            for e in batch:
+                self.handles.mark_done(e["handle"], HorovodInternalError(str(exc)), None)
+            return
+        for e in batch:
+            name = e["name"]
+            res = results.get(name)
+            if res is None:
+                # not globally ready this tick: requeue
+                with self._lock:
+                    self._queue.append(e)
+                continue
+            err, value = res
+            if err is not None:
+                self.handles.mark_done(e["handle"], TensorShapeMismatchError(err), None)
+            else:
+                self.handles.mark_done(e["handle"], None, value)
+
+    def _check_stalled(self) -> None:
+        """Reference CheckForStalledTensors (operations.cc:1625-1672)."""
+        now = time.monotonic()
+        with self._lock:
+            stalled = [e["name"] for e in self._queue if now - e["t"] > STALL_WARNING_TIME_S]
+        if stalled:
+            log(
+                "warning",
+                "One or more tensors were submitted to be reduced, gathered or "
+                "broadcasted by subset of ranks and are waiting for remainder of "
+                f"ranks for more than {int(STALL_WARNING_TIME_S)} seconds. Stalled ops: "
+                + ", ".join(stalled),
+                rank=self.topo.rank,
+            )
+
+
+# ------------------------------------------------------- multi-process plumbing
+
+class _Coordinator:
+    """Rank-0 TCP coordinator: collects per-tick request lists + data from all
+    ranks, matches by name, validates cross-rank consistency, computes, and
+    returns results. Plays the reference's coordinator role
+    (IncrementTensorCount/ConstructResponse, operations.cc:287-523)."""
+
+    def __init__(self, world: int, host: str, port: int) -> None:
+        self.world = world
+        self.server = socket.create_server((host, port), backlog=world + 4, reuse_port=False)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # name → {rank: (request, array)}; the message_table
+        self._pending: dict[str, dict[int, tuple[dict, np.ndarray]]] = {}
+        self._results: dict[str, tuple[Optional[str], Any]] = {}
+        self._result_claims: dict[str, int] = {}
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._accept_loop, name="hvd_coord_accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.server.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.server.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                msg = _recv_msg(conn)
+                if msg["kind"] == "exchange":
+                    out = self._handle_exchange(msg["rank"], msg["requests"], msg["arrays"])
+                    _send_msg(conn, out)
+                elif msg["kind"] == "bye":
+                    return
+        except (ConnectionError, EOFError, OSError):
+            return
+
+    def _handle_exchange(self, rank: int, requests: list[dict], arrays: dict) -> dict:
+        ready: list[str] = []
+        with self._cv:
+            for req in requests:
+                entry = self._pending.setdefault(req["name"], {})
+                entry[rank] = (req, arrays[req["name"]])
+                if len(entry) == self.world:
+                    ready.append(req["name"])
+            for name in ready:
+                self._results[name] = self._execute(name, self._pending.pop(name))
+                self._result_claims[name] = 0
+            self._cv.notify_all()
+            # Block until every requested tensor is globally ready (collective
+            # semantics). A rank that never shows up trips the deadline; the
+            # caller requeues and the stall checker warns (reference
+            # CheckForStalledTensors, operations.cc:1625-1672).
+            out: dict[str, tuple[Optional[str], Any]] = {}
+            deadline = time.monotonic() + 30.0
+            names = [r["name"] for r in requests]
+            while time.monotonic() < deadline and any(
+                n not in self._results for n in names
+            ):
+                self._cv.wait(timeout=0.1)
+            for n in names:
+                if n in self._results:
+                    out[n] = self._results[n]
+                    self._result_claims[n] += 1
+                    if self._result_claims[n] == self.world:
+                        del self._results[n]
+                        del self._result_claims[n]
+        return out
+
+    def _execute(self, name: str, contributions: dict[int, tuple[dict, np.ndarray]]):
+        reqs = [contributions[r][0] for r in sorted(contributions)]
+        arrs = [contributions[r][1] for r in sorted(contributions)]
+        op = reqs[0]["op"]
+        # Cross-rank validation (ConstructResponse, operations.cc:321-523).
+        if any(r["op"] != op for r in reqs):
+            return (f"Mismatched collective operations for tensor {name}", None)
+        if any(r["dtype"] != reqs[0]["dtype"] for r in reqs):
+            return (f"Mismatched data types for tensor {name}", None)
+        if op in ("allreduce", "broadcast", "alltoall", "reducescatter") and any(
+            r["shape"] != reqs[0]["shape"] for r in reqs
+        ):
+            return (f"Mismatched tensor shapes for {op} {name}", None)
+        if op == "allgather" and any(r["shape"][1:] != reqs[0]["shape"][1:] for r in reqs):
+            return (f"Mismatched non-first dimensions for allgather {name}", None)
+        if op == "broadcast" and any(r["root"] != reqs[0]["root"] for r in reqs):
+            return (f"Mismatched root ranks for broadcast {name}", None)
+        try:
+            if op == "allreduce":
+                acc = np.sum(np.stack(arrs, axis=0), axis=0, dtype=np.float64) \
+                    if np.issubdtype(arrs[0].dtype, np.floating) else sum(arrs)
+                if reqs[0]["average"]:
+                    acc = acc / len(arrs)
+                return (None, np.asarray(acc, dtype=arrs[0].dtype))
+            if op == "allgather":
+                return (None, np.concatenate(arrs, axis=0))
+            if op == "broadcast":
+                return (None, arrs[reqs[0]["root"]])
+            if op == "reducescatter":
+                acc = sum(a.astype(np.float64) for a in arrs) if np.issubdtype(
+                    arrs[0].dtype, np.floating) else sum(arrs)
+                acc = np.asarray(acc, dtype=arrs[0].dtype)
+                shards = np.array_split(acc, self.world, axis=0)
+                return (None, {"__per_rank__": shards})
+            if op == "alltoall":
+                shards = [np.array_split(a, self.world, axis=0) for a in arrs]
+                per_rank = [np.concatenate([shards[s][r] for s in range(self.world)], axis=0)
+                            for r in range(self.world)]
+                return (None, {"__per_rank__": per_rank})
+        except Exception as exc:  # pragma: no cover
+            return (str(exc), None)
+        return (f"unknown op {op}", None)
+
+
+class _Client:
+    def __init__(self, host: str, port: int, rank: int) -> None:
+        self.rank = rank
+        deadline = time.monotonic() + 60.0
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                self.sock = socket.create_connection((host, port), timeout=60)
+                break
+            except OSError as e:
+                last = e
+                time.sleep(0.1)
+        else:
+            raise HorovodInternalError(f"cannot reach coordinator at {host}:{port}: {last}")
+        self.sock.settimeout(120)
+        self._lock = threading.Lock()
+
+    def exchange(self, requests: list[dict], arrays: dict) -> dict:
+        with self._lock:
+            _send_msg(self.sock, {"kind": "exchange", "rank": self.rank,
+                                  "requests": requests, "arrays": arrays})
+            out = _recv_msg(self.sock)
+        # Unwrap per-rank results (reducescatter / alltoall)
+        for name, (err, val) in list(out.items()):
+            if err is None and isinstance(val, dict) and "__per_rank__" in val:
+                out[name] = (None, val["__per_rank__"][self.rank])
+        return out
+
+    def close(self) -> None:
+        try:
+            _send_msg(self.sock, {"kind": "bye"})
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def create(topo: Topology, config: Config):
+    """Factory: native C++ engine when available, Python fallback otherwise."""
+    try:
+        from ..cc import native_engine  # built extension
+
+        return native_engine.NativeEngine(topo, config)
+    except Exception:
+        return PyEngine(topo, config)

@@ -1,0 +1,114 @@
+"""Process / device topology resolution for TPU pod slices.
+
+The reference resolves rank/size/local_rank from ``MPI_COMM_WORLD`` and a
+node-local shared-memory split (operations.cc:1728-1797). On TPU there is no
+MPI: topology comes from the pod-slice runtime (one process per host, N local
+chips per process) or from the horovodrun-equivalent launcher, which exports
+``HOROVOD_RANK`` / ``HOROVOD_SIZE`` / ``HOROVOD_LOCAL_RANK`` /
+``HOROVOD_LOCAL_SIZE`` / ``HOROVOD_CROSS_RANK`` / ``HOROVOD_CROSS_SIZE``.
+
+Resolution priority:
+1. launcher-exported HOROVOD_* env vars (set by horovod_tpu.runner);
+2. JAX distributed runtime (``jax.process_index()`` / ``jax.process_count()``)
+   when it has been initialized with more than one process;
+3. single-process world: rank 0, size 1.
+
+The reference's homogeneity check (equal local_size on every node,
+operations.cc:1774-1790) is mirrored in :func:`Topology.validate`.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Topology:
+    """One rank's view of the job, mirroring HorovodGlobalState's rank fields
+    (reference operations.cc:115-171)."""
+
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int   # which node this rank's host is (reference cross_comm split)
+    cross_size: int   # number of nodes
+    is_homogeneous: bool = True
+
+    def validate(self) -> None:
+        if not (0 <= self.rank < self.size):
+            raise ValueError(f"rank {self.rank} out of range for size {self.size}")
+        if not (0 <= self.local_rank < self.local_size):
+            raise ValueError(
+                f"local_rank {self.local_rank} out of range for local_size {self.local_size}"
+            )
+        if self.size % self.local_size != 0 and self.is_homogeneous:
+            raise ValueError(
+                "homogeneous topology requires size to be a multiple of local_size "
+                f"(got size={self.size}, local_size={self.local_size})"
+            )
+
+
+def _from_env() -> Topology | None:
+    if "HOROVOD_RANK" not in os.environ or "HOROVOD_SIZE" not in os.environ:
+        return None
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    local_rank = int(os.environ.get("HOROVOD_LOCAL_RANK", 0))
+    local_size = int(os.environ.get("HOROVOD_LOCAL_SIZE", 1))
+    cross_rank = int(os.environ.get("HOROVOD_CROSS_RANK", rank // max(local_size, 1)))
+    cross_size = int(os.environ.get("HOROVOD_CROSS_SIZE", max(size // max(local_size, 1), 1)))
+    return Topology(rank, size, local_rank, local_size, cross_rank, cross_size)
+
+
+def _from_jax() -> Topology | None:
+    try:
+        import jax
+    except ImportError:  # pragma: no cover
+        return None
+    try:
+        count = jax.process_count()
+    except Exception:  # jax.distributed not initialized / no backend
+        return None
+    if count <= 1:
+        return None
+    rank = jax.process_index()
+    # One process per host on TPU pod slices: local_rank is 0, local_size 1,
+    # and the process grid is the cross grid.
+    return Topology(
+        rank=rank,
+        size=count,
+        local_rank=0,
+        local_size=1,
+        cross_rank=rank,
+        cross_size=count,
+    )
+
+
+def detect() -> Topology:
+    """Resolve this process's topology (see module docstring for priority)."""
+    topo = _from_env() or _from_jax() or Topology(0, 1, 0, 1, 0, 1)
+    topo.validate()
+    return topo
+
+
+def num_local_devices() -> int:
+    """Chips attached to this process (reference local_size is the per-node GPU
+    count; on TPU a single process drives all local chips via SPMD)."""
+    try:
+        import jax
+
+        return jax.local_device_count()
+    except Exception:  # pragma: no cover
+        return 1
+
+
+def num_devices() -> int:
+    """Total chips in the job."""
+    try:
+        import jax
+
+        return jax.device_count()
+    except Exception:  # pragma: no cover
+        return 1

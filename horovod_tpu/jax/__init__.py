@@ -1,0 +1,172 @@
+"""JAX framework binding — the first-class framework of the TPU build.
+
+Parity map to the reference bindings:
+
+- :func:`DistributedOptimizer`      ↔ hvd.DistributedOptimizer
+  (torch/__init__.py:52-151, tensorflow/__init__.py:151-249). Wraps any optax
+  GradientTransformation; grads are fused into flat buckets and allreduced
+  with one psum per bucket before the inner update. Hook machinery is
+  unnecessary: JAX grads arrive as a complete pytree, so "fuse → psum →
+  unfuse" replaces the per-parameter grad-accumulator hooks.
+- :func:`distributed_gradients` / :func:`grad` ↔ DistributedGradientTape
+  (tensorflow/__init__.py:252-326).
+- :func:`broadcast_parameters`      ↔ hvd.broadcast_parameters
+  (torch/__init__.py:200-230) — rank-0-writes + broadcast-on-restore contract.
+- :func:`broadcast_optimizer_state` ↔ hvd.broadcast_optimizer_state
+  (torch/__init__.py:232-348). Optax state is a pytree, so the reference's
+  scalar-wrapping dance collapses into one broadcast.
+- :func:`metric_average`            ↔ MetricAverageCallback
+  (_keras/callbacks.py:33-67).
+
+Everything here runs inside shard_map/pmap over a named mesh axis (default
+``'hvd'``); use horovod_tpu.run_on_mesh / shard_map directly to enter SPMD.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..compression import Compression, Compressor
+from ..parallel import collectives, fusion
+from ..parallel.collectives import ReduceOp
+from ..parallel.mesh import HVD_AXIS
+from ..common.config import DEFAULT_FUSION_THRESHOLD
+
+
+def allreduce_gradients(
+    grads,
+    axis_name: str = HVD_AXIS,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    compression: type[Compressor] = Compression.none,
+    fusion_threshold: int = DEFAULT_FUSION_THRESHOLD,
+    hierarchical: bool = False,
+):
+    """Fused allreduce of a gradient pytree (the DistributedOptimizer hot path)."""
+    ctx_box = {}
+
+    def compress(buf):
+        out, ctx = compression.compress(buf)
+        ctx_box[id(buf)] = ctx
+        return out
+
+    def decompress(buf, orig_dtype):
+        return buf.astype(orig_dtype) if buf.dtype != orig_dtype else buf
+
+    return fusion.fused_allreduce(
+        grads,
+        axis_name=axis_name,
+        threshold=fusion_threshold,
+        op=op,
+        compress=compress if compression is not Compression.none else None,
+        decompress=decompress if compression is not Compression.none else None,
+        hierarchical=hierarchical,
+    )
+
+
+def DistributedOptimizer(
+    optimizer: optax.GradientTransformation,
+    axis_name: str = HVD_AXIS,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    compression: type[Compressor] = Compression.none,
+    fusion_threshold: int = DEFAULT_FUSION_THRESHOLD,
+    hierarchical: bool = False,
+    backward_passes_per_step: int = 1,
+) -> optax.GradientTransformation:
+    """Wrap an optax optimizer so that ``update()`` first averages gradients
+    across the mesh axis, exactly where the reference wraps
+    compute_gradients/step.
+
+    ``backward_passes_per_step > 1`` accumulates that many local microbatch
+    gradients before one fused allreduce + inner update (reference
+    torch/__init__.py:71-93), cutting collective frequency by the same factor.
+    """
+
+    def update_fn(grads, state, params=None, **extra):
+        reduced = allreduce_gradients(
+            grads,
+            axis_name=axis_name,
+            op=op,
+            compression=compression,
+            fusion_threshold=fusion_threshold,
+            hierarchical=hierarchical,
+        )
+        return optimizer.update(reduced, state, params, **extra)
+
+    wrapped = optax.GradientTransformationExtraArgs(optimizer.init, update_fn)
+    if backward_passes_per_step > 1:
+        wrapped = optax.MultiSteps(wrapped, every_k_schedule=backward_passes_per_step).gradient_transformation()
+    return wrapped
+
+
+def distributed_gradients(
+    grads_or_fn,
+    axis_name: str = HVD_AXIS,
+    compression: type[Compressor] = Compression.none,
+    **kw,
+):
+    """DistributedGradientTape analog: either allreduce an existing grad
+    pytree, or wrap a ``jax.grad``-style function so its output gradients are
+    averaged across ranks."""
+    if callable(grads_or_fn):
+        fn = grads_or_fn
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            out = fn(*args, **kwargs)
+            if isinstance(out, tuple) and len(out) == 2:  # value_and_grad
+                val, grads = out
+                return val, allreduce_gradients(grads, axis_name, compression=compression, **kw)
+            return allreduce_gradients(out, axis_name, compression=compression, **kw)
+
+        return wrapper
+    return allreduce_gradients(grads_or_fn, axis_name, compression=compression, **kw)
+
+
+def grad(fun: Callable, axis_name: str = HVD_AXIS, **grad_kw) -> Callable:
+    """``jax.grad`` that returns rank-averaged gradients."""
+    return distributed_gradients(jax.grad(fun, **grad_kw), axis_name=axis_name)
+
+
+def value_and_grad(fun: Callable, axis_name: str = HVD_AXIS, **grad_kw) -> Callable:
+    """``jax.value_and_grad`` with rank-averaged gradients."""
+    return distributed_gradients(jax.value_and_grad(fun, **grad_kw), axis_name=axis_name)
+
+
+def broadcast_parameters(params, root_rank: int = 0, axis_name: str = HVD_AXIS):
+    """Replace every leaf with root's value — initial-state consistency
+    (reference broadcast_parameters, torch/__init__.py:200-230, and
+    BroadcastGlobalVariablesHook, tensorflow/__init__.py:117-148)."""
+    return jax.tree_util.tree_map(
+        lambda t: collectives.broadcast(t, root_rank, axis_name), params
+    )
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0, axis_name: str = HVD_AXIS):
+    """Broadcast optimizer state (reference torch/__init__.py:232-348; optax
+    state is already a pytree of arrays/scalars, so no scalar wrapping is
+    needed). Integer leaves (step counters) ride the same masked-psum."""
+
+    def bcast_leaf(t):
+        arr = jnp.asarray(t)
+        return collectives.broadcast(arr, root_rank, axis_name)
+
+    return jax.tree_util.tree_map(bcast_leaf, opt_state)
+
+
+def broadcast_object(obj, root_rank: int = 0, axis_name: str = HVD_AXIS):
+    """Pytree-of-arrays broadcast; alias used by checkpoint-resume flows
+    (reference resume_from_epoch broadcast in examples/pytorch_imagenet_resnet50.py)."""
+    return jax.tree_util.tree_map(
+        lambda t: collectives.broadcast(jnp.asarray(t), root_rank, axis_name), obj
+    )
+
+
+def metric_average(value, axis_name: str = HVD_AXIS):
+    """Average a scalar metric across ranks (reference MetricAverageCallback,
+    _keras/callbacks.py:33-67)."""
+    return collectives.allreduce(jnp.asarray(value), axis_name, ReduceOp.AVERAGE)

@@ -1,0 +1,157 @@
+"""Tensor fusion: batch many small gradients into few flat buffers before a
+single collective.
+
+TPU-native equivalent of the reference's fusion pipeline — the coordinator's
+greedy same-dtype/device merge up to HOROVOD_FUSION_THRESHOLD
+(operations.cc:2154-2266), the per-(device,framework) fusion buffer
+(fusion_buffer_manager.h:41-47), and the MEMCPY_IN/OUT_FUSION_BUFFER steps of
+PerformOperation (operations.cc:798-814, 1491-1586).
+
+Differences by design:
+- Bucket construction happens at *trace time* from the gradient pytree, so
+  every rank builds identical buckets deterministically (tree_flatten order) —
+  no runtime negotiation needed for the compiled path. This resolves the
+  async-enqueue-vs-XLA ordering problem called out in SURVEY.md §7.
+- The "memcpy into fusion buffer" is a concatenate that XLA fuses; the
+  collective runs once per bucket, preserving Horovod's
+  fewer-larger-collectives behaviour on ICI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import collectives
+from .mesh import HVD_AXIS
+from ..common.config import DEFAULT_FUSION_THRESHOLD
+
+
+@dataclass(frozen=True)
+class _Leaf:
+    index: int          # position in tree_flatten order
+    shape: tuple
+    dtype: Any
+    size: int           # elements
+
+
+@dataclass(frozen=True)
+class FusionPlan:
+    """Static bucketing of a pytree's leaves: list of buckets, each a tuple of
+    leaf descriptors with the same dtype, total bytes ≤ threshold (single
+    oversize leaves get their own bucket, as in the reference where a tensor
+    larger than the threshold is sent unfused)."""
+
+    treedef: Any
+    buckets: tuple[tuple[_Leaf, ...], ...]
+    pad_to: int = 1     # pad each buffer length to a multiple (hierarchical RS)
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.buckets)
+
+
+def build_plan(tree, threshold: int = DEFAULT_FUSION_THRESHOLD, pad_to: int = 1) -> FusionPlan:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    descs = []
+    for i, leaf in enumerate(leaves):
+        shape = tuple(leaf.shape)
+        dtype = jnp.asarray(leaf).dtype if not hasattr(leaf, "dtype") else leaf.dtype
+        descs.append(_Leaf(i, shape, jnp.dtype(dtype), int(np.prod(shape)) if shape else 1))
+
+    # Greedy same-dtype packing in deterministic order (reference merges only
+    # matching dtype/device responses, operations.cc:2165-2207).
+    buckets: list[list[_Leaf]] = []
+    cur: dict[Any, list[_Leaf]] = {}
+    cur_bytes: dict[Any, int] = {}
+    for d in descs:
+        nbytes = d.size * jnp.dtype(d.dtype).itemsize
+        key = d.dtype
+        if key in cur and cur_bytes[key] + nbytes <= threshold:
+            cur[key].append(d)
+            cur_bytes[key] += nbytes
+        else:
+            if key in cur:
+                buckets.append(cur[key])
+            cur[key] = [d]
+            cur_bytes[key] = nbytes
+    for key in sorted(cur.keys(), key=str):
+        buckets.append(cur[key])
+    buckets.sort(key=lambda b: b[0].index)
+    return FusionPlan(treedef, tuple(tuple(b) for b in buckets), pad_to)
+
+
+def fuse(tree, plan: FusionPlan) -> list:
+    """Flatten + concatenate each bucket into one 1-D buffer (the fusion
+    buffer fill, MEMCPY_IN_FUSION_BUFFER)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    buffers = []
+    for bucket in plan.buckets:
+        flat = [jnp.ravel(leaves[d.index]) for d in bucket]
+        buf = jnp.concatenate(flat) if len(flat) > 1 else flat[0]
+        if plan.pad_to > 1:
+            rem = buf.shape[0] % plan.pad_to
+            if rem:
+                buf = jnp.pad(buf, (0, plan.pad_to - rem))
+        buffers.append(buf)
+    return buffers
+
+
+def unfuse(buffers: Sequence, plan: FusionPlan):
+    """Split buffers back into leaves (MEMCPY_OUT_FUSION_BUFFER) and rebuild
+    the pytree."""
+    leaves: list = [None] * plan.treedef.num_leaves
+    for bucket, buf in zip(plan.buckets, buffers):
+        offset = 0
+        for d in bucket:
+            leaves[d.index] = jnp.reshape(buf[offset : offset + d.size], d.shape)
+            offset += d.size
+    return jax.tree_util.tree_unflatten(plan.treedef, leaves)
+
+
+def fused_allreduce(
+    tree,
+    axis_name: str = HVD_AXIS,
+    threshold: int = DEFAULT_FUSION_THRESHOLD,
+    op: collectives.ReduceOp = collectives.ReduceOp.AVERAGE,
+    compress: Callable | None = None,
+    decompress: Callable | None = None,
+    hierarchical: bool = False,
+    ici_axis: str = "ici",
+    dcn_axis: str = "dcn",
+):
+    """The Horovod fast path: fuse → (compress) → one collective per bucket →
+    (decompress) → unfuse. ``compress``/``decompress`` are dtype casts from
+    horovod_tpu.compression (reference tensorflow/compression.py:FP16Compressor).
+    """
+    pad_to = 1
+    if hierarchical:
+        # psum_scatter needs dim 0 divisible by the ici axis size; plan pads.
+        pad_to = jax.lax.axis_size(ici_axis) if _in_trace(tree) else 1
+    plan = build_plan(tree, threshold, pad_to=pad_to)
+    buffers = fuse(tree, plan)
+    out = []
+    for buf in buffers:
+        orig_dtype = buf.dtype
+        if compress is not None:
+            buf = compress(buf)
+        if hierarchical:
+            reduced = collectives.hierarchical_allreduce(
+                buf, ici_axis=ici_axis, dcn_axis=dcn_axis,
+                average=(op == collectives.ReduceOp.AVERAGE),
+            )
+        else:
+            reduced = collectives.allreduce(buf, axis_name, op)
+        if decompress is not None:
+            reduced = decompress(reduced, orig_dtype)
+        out.append(reduced)
+    return unfuse(out, plan)
+
+
+def _in_trace(tree) -> bool:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return bool(leaves) and isinstance(leaves[0], jax.core.Tracer)

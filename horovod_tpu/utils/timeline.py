@@ -1,0 +1,115 @@
+"""Horovod Timeline — Chrome-tracing (catapult) JSON of per-tensor collective
+phases, written by rank 0 only (reference horovod/common/timeline.{cc,h};
+docs/timeline.md).
+
+Mechanism mirrors the reference: events go into a queue drained by a dedicated
+writer thread (TimelineWriter::WriterLoop, timeline.cc:120-146); the main path
+never blocks on file IO. Phases per tensor: NEGOTIATE_<OP> (instant events per
+reporting rank), then <OP> with nested activity spans (WAIT_FOR_DATA,
+MEMCPY_IN_FUSION_BUFFER, ..., operations.h:29-50). Optional cycle markers via
+HOROVOD_TIMELINE_MARK_CYCLES (timeline.h:93 MarkCycleStart).
+
+On-device time is XLA's domain: pair this host-side timeline with the JAX/TPU
+profiler (jax.profiler.trace) for kernel-level spans.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from typing import Optional
+
+
+class Timeline:
+    def __init__(self, path: str, mark_cycles: bool = False) -> None:
+        self.path = path
+        self.mark_cycles_enabled = mark_cycles
+        self._q: queue.Queue = queue.Queue(maxsize=1 << 20)  # capacity mirrors timeline.h:66-68
+        self._stop = threading.Event()
+        self._t0 = time.monotonic()
+        self._tensor_pids: dict[str, int] = {}
+        self._next_pid = 1
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._writer_loop, name="hvd_timeline", daemon=True)
+        self._thread.start()
+
+    # -- event emission (Timeline::NegotiateStart/Start/ActivityStart/End, timeline.h:83-93)
+
+    def _ts_us(self) -> int:
+        return int((time.monotonic() - self._t0) * 1e6)
+
+    def _pid(self, name: str) -> int:
+        with self._lock:
+            if name not in self._tensor_pids:
+                pid = self._next_pid
+                self._next_pid += 1
+                self._tensor_pids[name] = pid
+                self._emit({"name": "process_name", "ph": "M", "pid": pid,
+                            "args": {"name": name}})
+            return self._tensor_pids[name]
+
+    def _emit(self, ev: dict) -> None:
+        try:
+            self._q.put_nowait(ev)
+        except queue.Full:  # drop rather than block the hot path
+            pass
+
+    def negotiate_start(self, name: str, op: str) -> None:
+        pid = self._pid(name)
+        self._emit({"name": f"NEGOTIATE_{op}", "ph": "B", "pid": pid, "tid": 0,
+                    "ts": self._ts_us()})
+
+    def negotiate_rank_ready(self, name: str, rank: int) -> None:
+        pid = self._pid(name)
+        self._emit({"name": str(rank), "ph": "i", "pid": pid, "tid": 0,
+                    "ts": self._ts_us(), "s": "p"})
+
+    def negotiate_end(self, name: str) -> None:
+        pid = self._pid(name)
+        self._emit({"name": "", "ph": "E", "pid": pid, "tid": 0, "ts": self._ts_us()})
+
+    def start(self, name: str, op: str) -> None:
+        self.negotiate_end(name)
+        pid = self._pid(name)
+        self._emit({"name": op, "ph": "B", "pid": pid, "tid": 0, "ts": self._ts_us()})
+
+    def activity_start(self, name: str, activity: str) -> None:
+        pid = self._pid(name)
+        self._emit({"name": activity, "ph": "B", "pid": pid, "tid": 1, "ts": self._ts_us()})
+
+    def activity_end(self, name: str) -> None:
+        pid = self._pid(name)
+        self._emit({"name": "", "ph": "E", "pid": pid, "tid": 1, "ts": self._ts_us()})
+
+    def end(self, name: str) -> None:
+        pid = self._pid(name)
+        self._emit({"name": "", "ph": "E", "pid": pid, "tid": 0, "ts": self._ts_us()})
+
+    def mark_cycle(self) -> None:
+        if self.mark_cycles_enabled:
+            self._emit({"name": "CYCLE_START", "ph": "i", "pid": 0, "tid": 0,
+                        "ts": self._ts_us(), "s": "g"})
+
+    # -- writer thread
+
+    def _writer_loop(self) -> None:
+        with open(self.path, "w") as f:
+            f.write("[\n")
+            first = True
+            while not (self._stop.is_set() and self._q.empty()):
+                try:
+                    ev = self._q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if not first:
+                    f.write(",\n")
+                f.write(json.dumps(ev))
+                first = False
+                f.flush()
+            f.write("\n]\n")
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)

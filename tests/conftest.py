@@ -1,0 +1,48 @@
+"""Test harness: virtual 8-device CPU mesh.
+
+Mirrors the reference CI strategy (SURVEY.md §4): one suite that self-adapts
+to the topology it finds. Multi-*device* semantics run on an 8-device virtual
+CPU platform (`--xla_force_host_platform_device_count=8`); multi-*process*
+eager-engine semantics are tested in-process against the TCP coordinator.
+
+Must run before any jax import in the test process: the environment pins
+JAX_PLATFORMS=axon (single real TPU chip), which we override to CPU here —
+benches use the real chip, tests use the virtual mesh.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def hvd():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+@pytest.fixture()
+def mesh8():
+    from horovod_tpu.parallel.mesh import data_parallel_mesh
+
+    assert jax.device_count() == 8, "virtual CPU mesh not active"
+    return data_parallel_mesh()
+
+
+@pytest.fixture()
+def mesh_2x4():
+    """('dcn','ici') hierarchical mesh: 2 virtual nodes × 4 chips."""
+    from horovod_tpu.parallel.mesh import hierarchical_mesh
+
+    return hierarchical_mesh(ici_size=4)

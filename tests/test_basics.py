@@ -1,0 +1,99 @@
+"""Lifecycle + topology tests (reference: init/rank/size surface of every
+binding's test file, e.g. test/test_torch.py TorchTests.test_horovod_rank)."""
+
+import os
+
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.topology import Topology, detect
+
+
+def test_init_idempotent():
+    hvd.init()
+    hvd.init()  # InitializeHorovodOnce guard (operations.cc:2384-2401)
+    assert hvd.is_initialized()
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    hvd.shutdown()
+    assert not hvd.is_initialized()
+
+
+def test_reinit_after_shutdown():
+    hvd.init()
+    hvd.shutdown()
+    hvd.init()  # re-init allowed (operations.cc:2424-2432)
+    assert hvd.is_initialized()
+    hvd.shutdown()
+
+
+def test_not_initialized_raises():
+    hvd.shutdown()
+    with pytest.raises(hvd.NotInitializedError):
+        hvd.rank()
+    with pytest.raises(hvd.NotInitializedError):
+        hvd.size()
+
+
+def test_mpi_threads_supported_shim():
+    hvd.init()
+    assert hvd.mpi_threads_supported() is True
+    hvd.shutdown()
+
+
+def test_topology_from_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_RANK", "3")
+    monkeypatch.setenv("HOROVOD_SIZE", "8")
+    monkeypatch.setenv("HOROVOD_LOCAL_RANK", "1")
+    monkeypatch.setenv("HOROVOD_LOCAL_SIZE", "2")
+    t = detect()
+    assert (t.rank, t.size, t.local_rank, t.local_size) == (3, 8, 1, 2)
+    assert t.cross_rank == 1 and t.cross_size == 4
+
+
+def test_topology_validation():
+    with pytest.raises(ValueError):
+        Topology(rank=5, size=4, local_rank=0, local_size=1,
+                 cross_rank=0, cross_size=1).validate()
+    with pytest.raises(ValueError):
+        Topology(rank=0, size=4, local_rank=3, local_size=2,
+                 cross_rank=0, cross_size=1).validate()
+
+
+def test_comm_subset():
+    # init(comm=[ranks]) — reference horovod_init with ranks[] (operations.cc:2415)
+    hvd.init(comm=[0])
+    assert hvd.size() == 1 and hvd.rank() == 0
+    hvd.shutdown()
+    with pytest.raises(ValueError):
+        hvd.init(comm="not-a-list")
+
+
+def test_config_env_parsing(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1048576")
+    monkeypatch.setenv("HOROVOD_CYCLE_TIME", "2.5")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    cfg = Config.from_env()
+    assert cfg.fusion_threshold == 1048576
+    assert cfg.cycle_time_ms == 2.5
+    assert cfg.autotune is True
+    assert cfg.hierarchical_allreduce is True
+    # Pinned vars must not be autotuned (operations.cc:1840-1879 fixed=true)
+    assert "HOROVOD_FUSION_THRESHOLD" in cfg.pinned
+    assert "HOROVOD_CYCLE_TIME" in cfg.pinned
+
+
+def test_config_defaults():
+    cfg = Config()
+    assert cfg.fusion_threshold == 64 * 1024 * 1024  # operations.cc:1838
+    assert cfg.cycle_time_ms == 5.0                  # operations.cc:1844
+    assert not cfg.autotune
+
+
+def test_num_chips():
+    assert hvd.num_chips() == 8  # virtual mesh
+    assert hvd.num_local_devices() == 8

@@ -1,0 +1,224 @@
+"""Collective correctness over the 8-device mesh — the core op matrix of the
+reference suite (test/test_tensorflow.py:MPITests — allreduce/allgather/
+broadcast across dtypes/dims, fusion, grads)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel import collectives, fusion
+from horovod_tpu.parallel.collectives import ReduceOp
+
+N = 8
+
+
+def smap(fn, mesh, in_specs, out_specs):
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                             check_vma=False))
+
+
+def per_rank(mesh, shape, dtype=jnp.float32, seed=0):
+    """A (N, *shape) array where slice i is rank i's local tensor."""
+    k = jax.random.PRNGKey(seed)
+    x = jax.random.normal(k, (N,) + shape).astype(dtype)
+    return x
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.int32])
+@pytest.mark.parametrize("dims", [(4,), (3, 5), (2, 3, 4)])
+def test_allreduce_average_dtypes(mesh8, dtype, dims):
+    # reference test_horovod_allreduce (test/test_tensorflow.py:46)
+    x = per_rank(mesh8, dims, jnp.float32).astype(dtype)
+    op = ReduceOp.AVERAGE if jnp.issubdtype(dtype, jnp.floating) else ReduceOp.SUM
+    f = smap(lambda t: collectives.allreduce(t, "hvd", op),
+             mesh8, (P("hvd"),), P("hvd"))
+    out = f(x)
+    expect = np.mean(np.asarray(x, np.float64), axis=0) if op == ReduceOp.AVERAGE \
+        else np.sum(np.asarray(x, np.float64), axis=0)
+    tol = 1e-2 if dtype == jnp.bfloat16 else 1e-5
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out[r], np.float64), expect, rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("op,npfn", [(ReduceOp.MIN, np.min), (ReduceOp.MAX, np.max)])
+def test_allreduce_minmax(mesh8, op, npfn):
+    x = per_rank(mesh8, (6,))
+    f = smap(lambda t: collectives.allreduce(t, "hvd", op), mesh8, (P("hvd"),), P("hvd"))
+    out = np.asarray(f(x))
+    expect = npfn(np.asarray(x), axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expect, rtol=1e-6)
+
+
+def test_allgather(mesh8):
+    # reference test_horovod_allgather (test/test_tensorflow.py:392)
+    x = per_rank(mesh8, (2, 3))
+    f = smap(lambda t: collectives.allgather(t, "hvd"), mesh8, (P("hvd"),), P("hvd"))
+    out = f(x)  # each rank gets (N*2, 3); stacked output (N, N*2, 3) after gather
+    full = np.concatenate([np.asarray(x[r]) for r in range(N)], axis=0)
+    got = np.asarray(out).reshape(N, N * 2, 3)
+    for r in range(N):
+        np.testing.assert_allclose(got[r], full, rtol=1e-6)
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast_roots(mesh8, root):
+    # reference test_horovod_broadcast (test/test_tensorflow.py:524)
+    x = per_rank(mesh8, (5,))
+    f = smap(lambda t: collectives.broadcast(t, root, "hvd"), mesh8, (P("hvd"),), P("hvd"))
+    out = np.asarray(f(x))
+    for r in range(N):
+        np.testing.assert_allclose(out[r], np.asarray(x[root]), rtol=1e-6)
+
+
+def test_broadcast_int(mesh8):
+    x = jnp.arange(N * 4, dtype=jnp.int32).reshape(N, 4)
+    f = smap(lambda t: collectives.broadcast(t, 2, "hvd"), mesh8, (P("hvd"),), P("hvd"))
+    out = np.asarray(f(x))
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], np.asarray(x[2]))
+
+
+def test_reducescatter(mesh8):
+    x = per_rank(mesh8, (N * 2, 3))
+    f = smap(lambda t: collectives.reducescatter(jnp.squeeze(t, 0), "hvd"),
+             mesh8, (P("hvd"),), P("hvd"))
+    out = np.asarray(f(x)).reshape(N, 2, 3)  # per-rank shard r
+    total = np.sum(np.asarray(x, np.float64), axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], total[r * 2:(r + 1) * 2], rtol=1e-4, atol=1e-5)
+
+
+def test_alltoall(mesh8):
+    x = per_rank(mesh8, (N, 4))
+    f = smap(lambda t: collectives.alltoall(jnp.squeeze(t, 0), "hvd"),
+             mesh8, (P("hvd"),), P("hvd"))
+    out = np.asarray(f(x)).reshape(N, N, 4)
+    xs = np.asarray(x)
+    for r in range(N):
+        expect = np.stack([xs[s, r] for s in range(N)], axis=0)
+        np.testing.assert_allclose(out[r], expect, rtol=1e-6)
+
+
+def test_ring_shift(mesh8):
+    x = per_rank(mesh8, (3,))
+    f = smap(lambda t: collectives.ring_shift(t, "hvd", 1), mesh8, (P("hvd"),), P("hvd"))
+    out = np.asarray(f(x))
+    xs = np.asarray(x)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], xs[(r - 1) % N], rtol=1e-6)
+
+
+def test_hierarchical_allreduce(mesh_2x4):
+    # reference hierarchical ladder (operations.cc:1284-1436): result must
+    # equal the flat allreduce over all 8 devices.
+    x = per_rank(mesh_2x4, (8, 3))
+    f = jax.jit(shard_map(
+        lambda t: collectives.hierarchical_allreduce(jnp.squeeze(t, 0), "ici", "dcn"),
+        mesh=mesh_2x4, in_specs=(P(("dcn", "ici")),), out_specs=P(("dcn", "ici")),
+        check_vma=False))
+    out = np.asarray(f(x)).reshape(N, 8, 3)
+    expect = np.mean(np.asarray(x, np.float64), axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_allreduce_grad(mesh8):
+    # reference test_horovod_allreduce_grad (test/test_tensorflow.py:334):
+    # backward of allreduce is allreduce (mpi_ops.py:94-183). In JAX the
+    # transpose of pmean with a ones cotangent on every rank is
+    # psum(1)/N == 1 — identical to the reference's averaged backward.
+    x = per_rank(mesh8, (4,))
+
+    def loss(t):
+        return jnp.sum(collectives.allreduce(t, "hvd", ReduceOp.AVERAGE))
+
+    f = smap(jax.grad(loss), mesh8, (P("hvd"),), P("hvd"))
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, np.ones((N, 4)), rtol=1e-6)
+
+
+def test_allgather_grad(mesh8):
+    # reference test_horovod_allgather_grad (test/test_tensorflow.py:482).
+    # JAX transpose of all_gather is slice-of-psum: with the replicated loss
+    # computed on every rank, each rank's grad is N · 2·t_r (sum over the N
+    # identical replicated losses, vs. the reference's averaged backward).
+    x = per_rank(mesh8, (2,))
+
+    def loss(t):
+        g = collectives.allgather(t, "hvd")
+        return jnp.sum(g * g)
+
+    f = smap(jax.grad(loss), mesh8, (P("hvd"),), P("hvd"))
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, N * 2 * np.asarray(x), rtol=1e-4)
+
+
+# ---------------------------------------------------------------- fusion
+
+def test_fusion_plan_respects_threshold():
+    tree = {f"g{i}": jnp.ones((100,), jnp.float32) for i in range(10)}  # 400 B each
+    plan = fusion.build_plan(tree, threshold=1000)  # 2 leaves per bucket
+    assert plan.num_buckets == 5
+    assert all(sum(d.size for d in b) * 4 <= 1000 for b in plan.buckets)
+
+
+def test_fusion_groups_by_dtype():
+    tree = {"a": jnp.ones((4,), jnp.float32), "b": jnp.ones((4,), jnp.bfloat16),
+            "c": jnp.ones((4,), jnp.float32)}
+    plan = fusion.build_plan(tree, threshold=1 << 20)
+    dtypes = [b[0].dtype for b in plan.buckets]
+    for bucket in plan.buckets:
+        assert len({d.dtype for d in bucket}) == 1
+    assert len(dtypes) == 2  # one f32 bucket (a+c), one bf16
+
+
+def test_fuse_unfuse_roundtrip():
+    tree = {"w": jnp.arange(12.0).reshape(3, 4), "b": jnp.arange(5.0),
+            "s": jnp.array(7.0)}
+    plan = fusion.build_plan(tree)
+    bufs = fusion.fuse(tree, plan)
+    out = fusion.unfuse(bufs, plan)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(out[k]), np.asarray(tree[k]))
+
+
+def test_fused_allreduce_matches_unfused(mesh8):
+    # fusion must not change numerics (reference fused tests,
+    # test_horovod_allreduce_cpu_fused, test/test_tensorflow.py:107)
+    k = jax.random.PRNGKey(1)
+    tree = {
+        "a": jax.random.normal(k, (N, 16)),
+        "b": jax.random.normal(jax.random.fold_in(k, 1), (N, 3, 3)),
+        "c": jax.random.normal(jax.random.fold_in(k, 2), (N, 1)),
+    }
+
+    def fused(t):
+        return fusion.fused_allreduce(t, "hvd", threshold=128)
+
+    f = smap(fused, mesh8, ({"a": P("hvd"), "b": P("hvd"), "c": P("hvd")},),
+             {"a": P("hvd"), "b": P("hvd"), "c": P("hvd")})
+    out = f(tree)
+    for key in tree:
+        expect = np.mean(np.asarray(tree[key], np.float64), axis=0)
+        got = np.asarray(out[key])
+        for r in range(N):
+            np.testing.assert_allclose(got[r], expect, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_allreduce_hierarchical(mesh_2x4):
+    tree = {"a": jnp.ones((N, 7)), "b": jnp.ones((N, 13))}
+
+    def fused(t):
+        return fusion.fused_allreduce(t, threshold=1 << 20, hierarchical=True)
+
+    f = jax.jit(shard_map(fused, mesh=mesh_2x4,
+                          in_specs=({"a": P(("dcn", "ici")), "b": P(("dcn", "ici"))},),
+                          out_specs={"a": P(("dcn", "ici")), "b": P(("dcn", "ici"))},
+                          check_vma=False))
+    out = f(tree)
+    np.testing.assert_allclose(np.asarray(out["a"]), np.ones((N, 7)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["b"]), np.ones((N, 13)), rtol=1e-6)

@@ -1,0 +1,202 @@
+"""Eager engine tests: handle lifecycle, single-process completion, and
+multi-process coordinator semantics exercised with in-process rank threads
+(reference runs the same file under mpirun; here the TCP coordinator is the
+wire, SURVEY.md §4)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import (
+    HandleManager,
+    PyEngine,
+    TensorShapeMismatchError,
+    _Client,
+    _Coordinator,
+)
+from horovod_tpu.common.topology import Topology
+
+
+def test_handle_manager():
+    hm = HandleManager()
+    h1, h2 = hm.allocate(), hm.allocate()
+    assert h1 != h2
+    assert not hm.poll(h1)
+    hm.mark_done(h1, None, 42)
+    assert hm.poll(h1)
+    assert hm.wait_and_clear(h1) == 42
+    assert not hm.poll(h1)  # cleared
+    err = RuntimeError("boom")
+    hm.mark_done(h2, err, None)
+    with pytest.raises(RuntimeError):
+        hm.wait_and_clear(h2)
+
+
+def engine_single():
+    topo = Topology(0, 1, 0, 1, 0, 1)
+    cfg = Config(cycle_time_ms=1.0)
+    return PyEngine(topo, cfg)
+
+
+def test_single_process_ops():
+    eng = engine_single()
+    try:
+        a = np.arange(6.0).reshape(2, 3)
+        np.testing.assert_array_equal(eng.run("allreduce", a, "t1"), a)
+        np.testing.assert_array_equal(eng.run("allgather", a, "t2"), a)
+        np.testing.assert_array_equal(eng.run("broadcast", a, "t3"), a)
+    finally:
+        eng.shutdown()
+
+
+def test_async_poll_synchronize():
+    eng = engine_single()
+    try:
+        h = eng.enqueue("allreduce", np.ones(4), "async1")
+        out = eng.synchronize(h, timeout=10)
+        np.testing.assert_array_equal(out, np.ones(4))
+    finally:
+        eng.shutdown()
+
+
+def test_shutdown_fails_pending():
+    eng = engine_single()
+    eng._shutdown.set()  # freeze the loop
+    eng._thread.join(timeout=5)
+    eng._queue.append({"op": "allreduce", "array": np.ones(2), "name": "x",
+                       "root": 0, "average": True, "handle": eng.handles.allocate(),
+                       "t": 0.0})
+    h = eng._queue[-1]["handle"]
+    eng.shutdown()
+    with pytest.raises(RuntimeError):
+        eng.synchronize(h, timeout=1)
+
+
+# ------------------------------------------------- multi-rank via coordinator
+
+WORLD = 4
+
+
+def run_ranks(fn):
+    """Run fn(rank, client) on WORLD threads against one coordinator."""
+    coord = _Coordinator(WORLD, "127.0.0.1", 0)
+    port = coord.server.getsockname()[1]
+    coord.start()
+    results: dict[int, object] = {}
+    errors: list[Exception] = []
+
+    def worker(rank):
+        try:
+            client = _Client("127.0.0.1", port, rank)
+            try:
+                results[rank] = fn(rank, client)
+            finally:
+                client.close()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(WORLD)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    coord.stop()
+    assert not errors, errors
+    return results
+
+
+def test_coordinator_allreduce():
+    def fn(rank, client):
+        arr = np.full((3,), float(rank))
+        req = [{"name": "g", "op": "allreduce", "shape": (3,), "dtype": "float64",
+                "root": 0, "average": True}]
+        out = client.exchange(req, {"g": arr})
+        return out["g"]
+
+    results = run_ranks(fn)
+    expect = np.full((3,), np.mean(np.arange(WORLD)))
+    for r in range(WORLD):
+        err, val = results[r]
+        assert err is None
+        np.testing.assert_allclose(val, expect)
+
+
+def test_coordinator_allgather_broadcast():
+    def fn(rank, client):
+        arr = np.full((rank + 1, 2), float(rank))  # variable dim 0!
+        req = [
+            {"name": "ag", "op": "allgather", "shape": arr.shape, "dtype": "float64",
+             "root": 0, "average": True},
+            {"name": "bc", "op": "broadcast", "shape": (2,), "dtype": "float64",
+             "root": 2, "average": True},
+        ]
+        out = client.exchange(req, {"ag": arr, "bc": np.full((2,), float(rank))})
+        return out
+
+    results = run_ranks(fn)
+    total_rows = sum(r + 1 for r in range(WORLD))
+    for r in range(WORLD):
+        err, val = results[r]["ag"]
+        assert err is None
+        assert val.shape == (total_rows, 2)  # variable-dim allgather (Allgatherv)
+        err, val = results[r]["bc"]
+        assert err is None
+        np.testing.assert_allclose(val, np.full((2,), 2.0))
+
+
+def test_coordinator_shape_mismatch_error():
+    """Rank-divergent shapes must produce an error on every rank, not a hang
+    (reference ConstructResponse error paths, test/test_tensorflow.py:265-333)."""
+
+    def fn(rank, client):
+        shape = (3,) if rank != 1 else (4,)
+        arr = np.ones(shape)
+        req = [{"name": "bad", "op": "allreduce", "shape": shape, "dtype": "float64",
+                "root": 0, "average": True}]
+        return client.exchange(req, {"bad": arr})["bad"]
+
+    results = run_ranks(fn)
+    for r in range(WORLD):
+        err, val = results[r]
+        assert err is not None and "Mismatched" in err
+
+
+def test_coordinator_dtype_mismatch_error():
+    def fn(rank, client):
+        dtype = "float64" if rank != 2 else "int32"
+        arr = np.ones((2,), dtype=np.float64 if rank != 2 else np.int32)
+        req = [{"name": "badt", "op": "allreduce", "shape": (2,), "dtype": dtype,
+                "root": 0, "average": True}]
+        return client.exchange(req, {"badt": arr})["badt"]
+
+    results = run_ranks(fn)
+    for r in range(WORLD):
+        err, val = results[r]
+        assert err is not None and "Mismatched data types" in err
+
+
+def test_coordinator_alltoall_reducescatter():
+    def fn(rank, client):
+        a2a = np.full((WORLD, 2), float(rank))
+        rs = np.arange(WORLD * 2, dtype=np.float64)
+        req = [
+            {"name": "a2a", "op": "alltoall", "shape": a2a.shape, "dtype": "float64",
+             "root": 0, "average": False},
+            {"name": "rs", "op": "reducescatter", "shape": rs.shape, "dtype": "float64",
+             "root": 0, "average": False},
+        ]
+        return client.exchange(req, {"a2a": a2a, "rs": rs})
+
+    results = run_ranks(fn)
+    for r in range(WORLD):
+        err, val = results[r]["a2a"]
+        assert err is None
+        expect = np.repeat(np.arange(WORLD, dtype=np.float64), 2).reshape(WORLD, 2)
+        np.testing.assert_allclose(val, expect)
+        err, val = results[r]["rs"]
+        assert err is None
+        np.testing.assert_allclose(
+            val, WORLD * np.arange(WORLD * 2, dtype=np.float64)[r * 2:(r + 1) * 2])

@@ -1,0 +1,204 @@
+"""DistributedOptimizer / broadcast-state tests (reference:
+test/test_torch.py broadcast_state matrix 802-934, test_force_allreduce 1040;
+test/test_tensorflow.py DistributedOptimizer grad paths)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.compression import Compression
+
+N = 8
+
+
+def make_data(seed=0):
+    k = jax.random.PRNGKey(seed)
+    x = jax.random.normal(k, (N * 4, 6))
+    y = jax.random.normal(jax.random.fold_in(k, 1), (N * 4, 2))
+    return x, y
+
+
+def make_params(seed=2):
+    k = jax.random.PRNGKey(seed)
+    return {"w": jax.random.normal(k, (6, 2)) * 0.1, "b": jnp.zeros((2,))}
+
+
+def loss_fn(params, x, y):
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def run_distributed(mesh, opt, params, x, y, steps=3):
+    state = opt.init(params)
+
+    def step(params, state, x, y):
+        grads = jax.grad(loss_fn)(params, x, y)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state
+
+    sstep = jax.jit(shard_map(step, mesh=mesh,
+                              in_specs=(P(), P(), P("hvd"), P("hvd")),
+                              out_specs=(P(), P()), check_vma=False))
+    for _ in range(steps):
+        params, state = sstep(params, state, x, y)
+    return params
+
+
+def run_single(opt, params, x, y, steps=3):
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state, x, y):
+        grads = jax.grad(loss_fn)(params, x, y)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state
+
+    for _ in range(steps):
+        params, state = step(params, state, x, y)
+    return params
+
+
+@pytest.mark.parametrize("inner", ["sgd", "adam"])
+def test_distributed_matches_global_batch(mesh8, inner):
+    """N-way data parallel with averaged grads == single process on the full
+    batch — the core Horovod correctness property."""
+    x, y = make_data()
+    params = make_params()
+    make = {"sgd": lambda: optax.sgd(0.05), "adam": lambda: optax.adam(1e-2)}[inner]
+    p_dist = run_distributed(mesh8, hvd.jax.DistributedOptimizer(make()), dict(params), x, y)
+    p_single = run_single(make(), dict(params), x, y)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_dist[k]), np.asarray(p_single[k]),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_compression_bf16_close(mesh8):
+    x, y = make_data()
+    params = make_params()
+    opt = hvd.jax.DistributedOptimizer(optax.sgd(0.05), compression=Compression.bf16)
+    p_c = run_distributed(mesh8, opt, dict(params), x, y)
+    p_ref = run_single(optax.sgd(0.05), dict(params), x, y)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_c[k]), np.asarray(p_ref[k]),
+                                   rtol=3e-2, atol=3e-3)
+
+
+def test_compression_fp16_roundtrip():
+    # reference test_compress_fp16 (test/test_tensorflow.py:766)
+    t = jnp.arange(8.0, dtype=jnp.float32)
+    c, ctx = Compression.fp16.compress(t)
+    assert c.dtype == jnp.float16
+    out = Compression.fp16.decompress(c, ctx)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), np.asarray(t))
+    # non-float passes through
+    i = jnp.arange(4)
+    c2, ctx2 = Compression.fp16.compress(i)
+    assert c2.dtype == i.dtype and ctx2 is None
+
+
+def test_backward_passes_per_step(mesh8):
+    """k-step accumulation applies the inner update every k-th call with the
+    accumulated-mean gradient (reference torch/__init__.py:71-93)."""
+    x, y = make_data()
+    params = make_params()
+    opt = hvd.jax.DistributedOptimizer(optax.sgd(0.1), backward_passes_per_step=2)
+    state = opt.init(params)
+
+    def step(params, state, x, y):
+        grads = jax.grad(loss_fn)(params, x, y)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state
+
+    sstep = jax.jit(shard_map(step, mesh=mesh8,
+                              in_specs=(P(), P(), P("hvd"), P("hvd")),
+                              out_specs=(P(), P()), check_vma=False))
+    p1, state = sstep(params, state, x, y)
+    # first microbatch: no update yet
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(params[k]), rtol=1e-6)
+    p2, state = sstep(p1, state, x, y)
+    changed = any(not np.allclose(np.asarray(p2[k]), np.asarray(params[k])) for k in params)
+    assert changed
+
+
+def test_broadcast_parameters(mesh8):
+    """Initial-state consistency (reference broadcast_parameters,
+    torch/__init__.py:200-230)."""
+    def body(seed):
+        # each rank fabricates different params; broadcast makes them rank 0's
+        s = seed[0, 0]
+        k = jax.random.fold_in(jax.random.PRNGKey(0), s)
+        p = {"w": jax.random.normal(k, (1, 3, 3)),
+             "step": jnp.reshape(s, (1,)).astype(jnp.int32)}
+        return hvd.jax.broadcast_parameters(p, root_rank=0)
+
+    seeds = jnp.arange(N, dtype=jnp.int32).reshape(N, 1)
+    f = jax.jit(shard_map(body, mesh=mesh8, in_specs=(P("hvd"),),
+                          out_specs={"w": P("hvd"), "step": P("hvd")}, check_vma=False))
+    out = f(seeds)
+    w = np.asarray(out["w"]).reshape(N, 3, 3)
+    for r in range(1, N):
+        np.testing.assert_allclose(w[r], w[0], rtol=1e-6)
+    assert np.all(np.asarray(out["step"]) == 0)  # root's seed
+
+
+def test_broadcast_optimizer_state(mesh8):
+    """reference broadcast_optimizer_state over torch.optim matrix
+    (torch/__init__.py:232-348) — optax states are pytrees with int steps and
+    float moments; all leaves must end up as rank 0's."""
+    opt = optax.adam(1e-3)
+    params = make_params()
+    n_leaves = len(jax.tree_util.tree_leaves(opt.init(params)))
+
+    def body(seed):
+        p = jax.tree_util.tree_map(lambda t: t + seed[0, 0].astype(t.dtype), params)
+        state = opt.init(p)
+        # perturb so ranks disagree before the broadcast
+        state = jax.tree_util.tree_map(lambda t: t + seed[0, 0].astype(t.dtype), state)
+        state = hvd.jax.broadcast_optimizer_state(state, root_rank=0)
+        # flatten to rank-1 leaves so out_specs can stack them across ranks
+        return [jnp.reshape(leaf, (1, -1)).astype(jnp.float32)
+                for leaf in jax.tree_util.tree_leaves(state)]
+
+    seeds = jnp.arange(N, dtype=jnp.float32).reshape(N, 1)
+    f = jax.jit(shard_map(body, mesh=mesh8, in_specs=(P("hvd"),),
+                          out_specs=[P("hvd")] * n_leaves, check_vma=False))
+    out = f(seeds)
+    for leaf in out:
+        arr = np.asarray(leaf)  # (N, k)
+        for r in range(1, N):
+            np.testing.assert_allclose(arr[r], arr[0], rtol=1e-6)
+
+
+def test_distributed_gradients_wrapper(mesh8):
+    x, y = make_data()
+    params = make_params()
+
+    def step(params, x, y):
+        g = hvd.jax.grad(lambda p: loss_fn(p, x, y))(params)
+        return g
+
+    f = jax.jit(shard_map(step, mesh=mesh8, in_specs=(P(), P("hvd"), P("hvd")),
+                          out_specs=P(), check_vma=False))
+    g = f(params, x, y)
+    g_ref = jax.grad(loss_fn)(params, x, y)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(g[k]), np.asarray(g_ref[k]),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_metric_average(mesh8):
+    def body(v):
+        return hvd.jax.metric_average(jnp.squeeze(v, 0))
+
+    vals = jnp.arange(N, dtype=jnp.float32).reshape(N, 1)
+    f = jax.jit(shard_map(body, mesh=mesh8, in_specs=(P("hvd"),), out_specs=P(),
+                          check_vma=False))
+    out = float(np.asarray(f(vals)).ravel()[0])
+    assert abs(out - np.mean(np.arange(N))) < 1e-6

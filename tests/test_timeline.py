@@ -1,0 +1,49 @@
+"""Timeline content test (reference test/test_timeline.py:41-58: set
+HOROVOD_TIMELINE, run one allreduce, assert NEGOTIATE_ALLREDUCE / ALLREDUCE /
+CYCLE_START appear in the JSON)."""
+
+import json
+import time
+
+import numpy as np
+
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import PyEngine
+from horovod_tpu.common.topology import Topology
+from horovod_tpu.utils.timeline import Timeline
+
+
+def test_timeline_file_contents(tmp_path):
+    path = str(tmp_path / "timeline.json")
+    cfg = Config(cycle_time_ms=1.0, timeline=path, timeline_mark_cycles=True)
+    eng = PyEngine(Topology(0, 1, 0, 1, 0, 1), cfg)
+    try:
+        eng.run("allreduce", np.ones(4), "grad/w")
+        time.sleep(0.05)
+    finally:
+        eng.shutdown()
+    text = open(path).read()
+    assert "NEGOTIATE_ALLREDUCE" in text
+    assert '"ALLREDUCE"' in text
+    assert "CYCLE_START" in text
+    events = json.loads(text)
+    assert isinstance(events, list) and events
+
+
+def test_timeline_valid_json_and_phases(tmp_path):
+    path = str(tmp_path / "t.json")
+    tl = Timeline(path, mark_cycles=True)
+    tl.negotiate_start("tensor.a", "ALLREDUCE")
+    tl.negotiate_rank_ready("tensor.a", 0)
+    tl.start("tensor.a", "ALLREDUCE")
+    tl.activity_start("tensor.a", "MEMCPY_IN_FUSION_BUFFER")
+    tl.activity_end("tensor.a")
+    tl.end("tensor.a")
+    tl.mark_cycle()
+    time.sleep(0.05)
+    tl.close()
+    events = json.loads(open(path).read())
+    names = [e["name"] for e in events]
+    assert "NEGOTIATE_ALLREDUCE" in names
+    assert "MEMCPY_IN_FUSION_BUFFER" in names
+    assert "process_name" in names  # tensor pid metadata
