@@ -77,7 +77,7 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
     arr = _np.asarray(tensor)
     from .common import basics
 
-    return basics.engine().run("allreduce", arr, name or f"allreduce.{arr.shape}",
+    return basics.engine().run("allreduce", arr, name,
                                average=(op == ReduceOp.AVERAGE))
 
 
@@ -92,7 +92,7 @@ def allgather(tensor, name: str | None = None, axis_name: str = HVD_AXIS):
     arr = _np.asarray(tensor)
     from .common import basics
 
-    return basics.engine().run("allgather", arr, name or f"allgather.{arr.shape}")
+    return basics.engine().run("allgather", arr, name)
 
 
 def broadcast(tensor, root_rank: int = 0, name: str | None = None,
@@ -107,7 +107,7 @@ def broadcast(tensor, root_rank: int = 0, name: str | None = None,
     arr = _np.asarray(tensor)
     from .common import basics
 
-    return basics.engine().run("broadcast", arr, name or f"broadcast.{arr.shape}",
+    return basics.engine().run("broadcast", arr, name,
                                root_rank=root_rank)
 
 
@@ -123,7 +123,7 @@ def alltoall(tensor, name: str | None = None, axis_name: str = HVD_AXIS):
     arr = _np.asarray(tensor)
     from .common import basics
 
-    return basics.engine().run("alltoall", arr, name or f"alltoall.{arr.shape}")
+    return basics.engine().run("alltoall", arr, name)
 
 
 def reducescatter(tensor, average: bool = False, name: str | None = None,
@@ -139,7 +139,7 @@ def reducescatter(tensor, average: bool = False, name: str | None = None,
     arr = _np.asarray(tensor)
     from .common import basics
 
-    return basics.engine().run("reducescatter", arr, name or f"rs.{arr.shape}",
+    return basics.engine().run("reducescatter", arr, name,
                                average=average)
 
 

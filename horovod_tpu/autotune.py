@@ -1,0 +1,104 @@
+"""Python face of the native autotuner (reference parameter_manager +
+optim/bayesian_optimization + optim/gaussian_process, SURVEY.md §2.1).
+
+The eager engine embeds a ParameterManager internally (HOROVOD_AUTOTUNE=1);
+this module exposes the same native objects directly so the *compiled* path
+can tune its fusion threshold between jit re-traces, and so the math is
+testable from Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def _lib():
+    from .cc import lib_path
+
+    lib = ctypes.CDLL(lib_path())
+    lib.hvd_pm_create.restype = ctypes.c_void_p
+    lib.hvd_pm_create.argtypes = [ctypes.c_longlong, ctypes.c_double,
+                                  ctypes.c_int, ctypes.c_int]
+    lib.hvd_pm_destroy.argtypes = [ctypes.c_void_p]
+    lib.hvd_pm_update.restype = ctypes.c_int
+    lib.hvd_pm_update.argtypes = [ctypes.c_void_p, ctypes.c_longlong, ctypes.c_double]
+    lib.hvd_pm_active.restype = ctypes.c_int
+    lib.hvd_pm_active.argtypes = [ctypes.c_void_p]
+    lib.hvd_pm_fusion_threshold.restype = ctypes.c_longlong
+    lib.hvd_pm_fusion_threshold.argtypes = [ctypes.c_void_p]
+    lib.hvd_pm_cycle_time_ms.restype = ctypes.c_double
+    lib.hvd_pm_cycle_time_ms.argtypes = [ctypes.c_void_p]
+    lib.hvd_pm_set_log.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.hvd_gp_fit_predict.restype = ctypes.c_int
+    lib.hvd_gp_fit_predict.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+    ]
+    return lib
+
+
+def gp_fit_predict(X: Sequence[Sequence[float]], y: Sequence[float],
+                   xstar: Sequence[float]) -> tuple[float, float]:
+    """Fit the native GP and predict (mu, sigma) at ``xstar``."""
+    lib = _lib()
+    Xa = np.ascontiguousarray(X, dtype=np.float64)
+    ya = np.ascontiguousarray(y, dtype=np.float64)
+    xs = np.ascontiguousarray(xstar, dtype=np.float64)
+    mu = ctypes.c_double()
+    sigma = ctypes.c_double()
+    rc = lib.hvd_gp_fit_predict(
+        Xa.shape[0], Xa.shape[1],
+        Xa.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ya.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        xs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(mu), ctypes.byref(sigma),
+    )
+    if rc != 0:
+        raise RuntimeError("GP fit failed (matrix not positive definite?)")
+    return mu.value, sigma.value
+
+
+class ParameterManager:
+    """Tunes (fusion_threshold, cycle_time_ms) from throughput samples."""
+
+    def __init__(self, fusion_threshold: int = 64 << 20,
+                 cycle_time_ms: float = 5.0,
+                 threshold_pinned: bool = False, cycle_pinned: bool = False,
+                 log_path: Optional[str] = None) -> None:
+        self._lib = _lib()
+        self._h = self._lib.hvd_pm_create(
+            fusion_threshold, cycle_time_ms, int(threshold_pinned),
+            int(cycle_pinned))
+        if log_path:
+            self._lib.hvd_pm_set_log(self._h, log_path.encode())
+
+    def update(self, bytes_moved: int, seconds: float) -> bool:
+        """Record one sample; returns True when the knobs changed."""
+        return bool(self._lib.hvd_pm_update(self._h, bytes_moved, seconds))
+
+    @property
+    def active(self) -> bool:
+        return bool(self._lib.hvd_pm_active(self._h))
+
+    @property
+    def fusion_threshold(self) -> int:
+        return int(self._lib.hvd_pm_fusion_threshold(self._h))
+
+    @property
+    def cycle_time_ms(self) -> float:
+        return float(self._lib.hvd_pm_cycle_time_ms(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_pm_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

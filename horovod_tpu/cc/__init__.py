@@ -1,0 +1,48 @@
+"""Native core loader — builds (once) and loads libhvd_core.so.
+
+The analog of the reference's check_extension/get_ext_suffix dance
+(horovod/common/__init__.py:20-48), except the extension is built on first
+use with the in-tree Makefile instead of at pip-install time: the TPU hosts
+this targets always carry a toolchain, and a stale wheel is worse than a
+30-second first build.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB = os.path.join(_DIR, "libhvd_core.so")
+_lock = threading.Lock()
+
+
+class NativeBuildError(ImportError):
+    pass
+
+
+def lib_path(build: bool = True) -> str:
+    """Path to the built shared library, building it if needed."""
+    with _lock:
+        sources_newer = False
+        if os.path.exists(_LIB):
+            lib_mtime = os.path.getmtime(_LIB)
+            src_dir = os.path.join(_DIR, "src")
+            for f in os.listdir(src_dir):
+                if os.path.getmtime(os.path.join(src_dir, f)) > lib_mtime:
+                    sources_newer = True
+                    break
+        if (not os.path.exists(_LIB) or sources_newer) and build:
+            proc = subprocess.run(
+                ["make", "-C", _DIR],
+                capture_output=True,
+                text=True,
+            )
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    "failed to build libhvd_core.so:\n" + proc.stderr[-4000:]
+                )
+        if not os.path.exists(_LIB):
+            raise NativeBuildError("libhvd_core.so not built")
+        return _LIB

@@ -1,0 +1,171 @@
+"""ctypes wrapper over libhvd_core.so — the HorovodBasics analog.
+
+Presents the same interface as the Python PyEngine
+(horovod_tpu/common/engine.py): enqueue/poll/synchronize/run/shutdown, so
+`basics.engine()` can swap implementations freely. Reference counterpart:
+ctypes HorovodBasics over the C ABI (horovod/common/__init__.py:51-154) plus
+the per-framework enqueue paths (torch/mpi_ops_v2.cc:52-224).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+from . import lib_path
+# Shared exception types: user except clauses must match regardless of which
+# engine implementation is active.
+from ..common.engine import HorovodInternalError, TensorShapeMismatchError  # noqa: F401
+
+# Order in sync with hvd_common.h.
+OPS = {"allreduce": 0, "allgather": 1, "broadcast": 2, "reducescatter": 3, "alltoall": 4}
+DTYPES = ["uint8", "int8", "int32", "int64", "float16", "bfloat16", "float32", "float64", "bool"]
+_STATUS_NAMES = {1: "UnknownError", 2: "PreconditionError", 3: "Aborted", 4: "InvalidArgument"}
+
+# c_api.cc copies result shapes into a fixed 64-slot buffer (numpy's own
+# maximum is 64 dims, NPY_MAXDIMS).
+MAX_NDIM = 64
+
+
+def _np_dtype_id(dt: np.dtype) -> int:
+    name = dt.name
+    if name not in DTYPES:
+        raise ValueError(f"unsupported dtype {name}")
+    return DTYPES.index(name)
+
+
+def _dtype_from_id(i: int) -> np.dtype:
+    name = DTYPES[i]
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _load():
+    lib = ctypes.CDLL(lib_path())
+    lib.hvd_init.restype = ctypes.c_int
+    lib.hvd_init.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_double,
+        ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.hvd_shutdown.restype = None
+    lib.hvd_enqueue.restype = ctypes.c_longlong
+    lib.hvd_enqueue.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.hvd_poll.restype = ctypes.c_int
+    lib.hvd_poll.argtypes = [ctypes.c_longlong]
+    lib.hvd_wait.restype = ctypes.c_int
+    lib.hvd_wait.argtypes = [
+        ctypes.c_longlong, ctypes.c_double, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_longlong),
+        ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.hvd_fetch.restype = ctypes.c_int
+    lib.hvd_fetch.argtypes = [ctypes.c_longlong, ctypes.c_void_p, ctypes.c_longlong]
+    lib.hvd_release.restype = None
+    lib.hvd_release.argtypes = [ctypes.c_longlong]
+    return lib
+
+
+class NativeEngine:
+    """Drop-in replacement for PyEngine backed by the C++ core."""
+
+    def __init__(self, topo, config) -> None:
+        self.topo = topo
+        self.config = config
+        self._lib = _load()
+        host, port = "", 0
+        if topo.size > 1:
+            addr = os.environ.get("HOROVOD_COORD_ADDR")
+            if not addr:
+                raise HorovodInternalError(
+                    "multi-process eager collectives need HOROVOD_COORD_ADDR "
+                    "(set by the horovod_tpu launcher)"
+                )
+            host, p = addr.rsplit(":", 1)
+            port = int(p)
+        err = ctypes.create_string_buffer(1024)
+        timeline = config.timeline if topo.rank == 0 else ""
+        pinned = getattr(config, "pinned", set())
+        rc = self._lib.hvd_init(
+            topo.rank, topo.size, topo.local_rank, topo.local_size,
+            topo.cross_rank, topo.cross_size, host.encode(), port,
+            float(config.cycle_time_ms), int(config.fusion_threshold),
+            timeline.encode(), int(config.timeline_mark_cycles),
+            int(config.stall_check_disable), int(config.autotune),
+            config.autotune_log.encode(),
+            int("HOROVOD_FUSION_THRESHOLD" in pinned),
+            int("HOROVOD_CYCLE_TIME" in pinned), err, 1024,
+        )
+        if rc != 0:
+            raise HorovodInternalError(f"native init failed: {err.value.decode()}")
+
+    def enqueue(self, op: str, array: np.ndarray, name: Optional[str] = None,
+                root_rank: int = 0, average: bool = True) -> int:
+        if op == "allgather" and np.asarray(array).ndim == 0:
+            # np.ascontiguousarray would silently promote the scalar to (1,)
+            raise HorovodInternalError(
+                "Allgather requires tensors of rank >= 1 (got a scalar)")
+        arr = np.ascontiguousarray(array)
+        if arr.ndim > MAX_NDIM:
+            raise ValueError(f"tensor rank {arr.ndim} exceeds maximum {MAX_NDIM}")
+        shape = (ctypes.c_longlong * arr.ndim)(*arr.shape)
+        err = ctypes.create_string_buffer(512)
+        h = self._lib.hvd_enqueue(
+            OPS[op], (name or "").encode(), _np_dtype_id(arr.dtype), shape, arr.ndim,
+            arr.ctypes.data_as(ctypes.c_void_p), root_rank, int(average),
+            err, 512,
+        )
+        if h < 0:
+            raise HorovodInternalError(f"enqueue failed: {err.value.decode()}")
+        return int(h)
+
+    def poll(self, handle: int) -> bool:
+        return bool(self._lib.hvd_poll(handle))
+
+    def synchronize(self, handle: int, timeout: Optional[float] = None) -> Any:
+        dtype_out = ctypes.c_int()
+        ndim_out = ctypes.c_int()
+        nbytes_out = ctypes.c_longlong()
+        shape_out = (ctypes.c_longlong * MAX_NDIM)()
+        err = ctypes.create_string_buffer(1024)
+        # C side: timeout < 0 = wait forever, 0 = immediate poll.
+        rc = self._lib.hvd_wait(
+            handle, -1.0 if timeout is None else float(timeout),
+            ctypes.byref(dtype_out), shape_out,
+            MAX_NDIM, ctypes.byref(ndim_out), ctypes.byref(nbytes_out), err, 1024,
+        )
+        if rc != 0:
+            msg = err.value.decode() or _STATUS_NAMES.get(rc, f"status {rc}")
+            if rc == 5:  # IN_PROGRESS: still in flight, handle stays valid
+                raise TimeoutError(msg)
+            if rc == 2:
+                raise TensorShapeMismatchError(msg)
+            raise HorovodInternalError(msg)
+        shape = tuple(shape_out[i] for i in range(ndim_out.value))
+        out = np.empty(shape, dtype=_dtype_from_id(dtype_out.value))
+        assert out.nbytes == nbytes_out.value, (out.nbytes, nbytes_out.value)
+        rc = self._lib.hvd_fetch(
+            handle, out.ctypes.data_as(ctypes.c_void_p), out.nbytes
+        )
+        if rc != 0:
+            raise HorovodInternalError(f"fetch failed rc={rc}")
+        return out
+
+    def run(self, op: str, array: np.ndarray, name: str, **kw) -> Any:
+        return self.synchronize(self.enqueue(op, array, name, **kw))
+
+    def shutdown(self) -> None:
+        self._lib.hvd_shutdown()

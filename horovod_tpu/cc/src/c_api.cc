@@ -1,0 +1,190 @@
+// C ABI over the native engine, consumed from Python via ctypes.
+//
+// The reference exposes horovod_init/_rank/_size/... as a C ABI wrapped by
+// the ctypes HorovodBasics (reference horovod/common/operations.h:76-106,
+// horovod/common/__init__.py:51-154) and per-framework enqueue entry points
+// (EnqueueTensorAllreduce etc). pybind11 isn't available in this image, so
+// the whole native surface is C functions; horovod_tpu/cc/native_engine.py
+// is the HorovodBasics analog.
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+
+#include "engine.h"
+
+using namespace hvd;
+
+namespace {
+// shared_ptr so data-path calls hold the engine alive across a concurrent
+// hvd_shutdown (ctypes releases the GIL, so hvd_wait can be blocked in one
+// thread while another shuts down).
+std::shared_ptr<Engine> g_engine;
+std::mutex g_mu;
+
+std::shared_ptr<Engine> engine() {
+  std::lock_guard<std::mutex> g(g_mu);
+  return g_engine;
+}
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. coord_host may be "" for single-process worlds.
+int hvd_init(int rank, int size, int local_rank, int local_size, int cross_rank,
+             int cross_size, const char* coord_host, int coord_port,
+             double cycle_time_ms, long long fusion_threshold,
+             const char* timeline_path, int timeline_mark_cycles,
+             int stall_check_disable, int autotune, const char* autotune_log,
+             int threshold_pinned, int cycle_pinned, char* err, int errcap) {
+  std::lock_guard<std::mutex> g(g_mu);
+  if (g_engine) return 0;  // idempotent (reference InitializeHorovodOnce)
+  try {
+    Topology t{rank, size, local_rank, local_size, cross_rank, cross_size};
+    EngineConfig c;
+    c.cycle_time_ms = cycle_time_ms;
+    c.fusion_threshold = (size_t)fusion_threshold;
+    c.timeline_path = timeline_path ? timeline_path : "";
+    c.timeline_mark_cycles = timeline_mark_cycles != 0;
+    c.stall_check_disable = stall_check_disable != 0;
+    c.autotune = autotune != 0;
+    c.autotune_log = autotune_log ? autotune_log : "";
+    c.threshold_pinned = threshold_pinned != 0;
+    c.cycle_pinned = cycle_pinned != 0;
+    c.coord_host = coord_host ? coord_host : "";
+    c.coord_port = coord_port;
+    g_engine = std::make_shared<Engine>(t, c);
+    return 0;
+  } catch (const std::exception& ex) {
+    if (err && errcap > 0) std::snprintf(err, (size_t)errcap, "%s", ex.what());
+    return 1;
+  }
+}
+
+void hvd_shutdown() {
+  std::shared_ptr<Engine> eng;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    eng = std::move(g_engine);
+    g_engine.reset();
+  }
+  if (eng) eng->shutdown();  // destructor runs when the last caller drops it
+}
+
+int hvd_is_initialized() { return engine() ? 1 : 0; }
+int hvd_rank() { auto e = engine(); return e ? e->topology().rank : -1; }
+int hvd_size() { auto e = engine(); return e ? e->topology().size : -1; }
+int hvd_local_rank() { auto e = engine(); return e ? e->topology().local_rank : -1; }
+int hvd_local_size() { auto e = engine(); return e ? e->topology().local_size : -1; }
+
+// op / dtype use the enum orders in hvd_common.h. Returns handle >= 0, or -1.
+long long hvd_enqueue(int op, const char* name, int dtype,
+                      const long long* shape, int ndim, const void* data,
+                      int root_rank, int average, char* err, int errcap) {
+  auto eng = engine();
+  if (!eng) return -1;
+  try {
+    std::vector<int64_t> s(shape, shape + ndim);
+    return eng->enqueue((OpType)op, name, (DataType)dtype, s, data,
+                        root_rank, average != 0);
+  } catch (const std::exception& ex) {
+    if (err && errcap > 0) std::snprintf(err, (size_t)errcap, "%s", ex.what());
+    return -1;
+  }
+}
+
+int hvd_poll(long long handle) {
+  auto eng = engine();
+  return eng && eng->poll(handle) ? 1 : 0;
+}
+
+// Blocks until done. Returns StatusType as int; fills result metadata on OK.
+int hvd_wait(long long handle, double timeout_s, int* dtype_out,
+             long long* shape_out, int shape_cap, int* ndim_out,
+             long long* nbytes_out, char* err, int errcap) {
+  auto eng = engine();
+  if (!eng) return (int)StatusType::ABORTED;
+  Status st = eng->wait(handle, timeout_s);
+  if (!st.ok()) {
+    if (err && errcap > 0) std::snprintf(err, (size_t)errcap, "%s", st.reason.c_str());
+    // Timeout (IN_PROGRESS): the op is still in flight — keep the handle so
+    // the eventual result stays claimable. Real errors consume the handle.
+    if (st.type != StatusType::IN_PROGRESS) eng->release(handle);
+    return (int)st.type;
+  }
+  const Response* res = eng->peek(handle);
+  if (!res) return (int)StatusType::UNKNOWN_ERROR;
+  if (dtype_out) *dtype_out = (int)res->dtype;
+  if (ndim_out) *ndim_out = (int)res->shape.size();
+  for (int i = 0; i < (int)res->shape.size() && i < shape_cap; i++) {
+    shape_out[i] = res->shape[(size_t)i];
+  }
+  if (nbytes_out) *nbytes_out = (long long)res->data.size();
+  return 0;
+}
+
+// Copies the result bytes out and releases the handle.
+int hvd_fetch(long long handle, void* out, long long cap) {
+  auto eng = engine();
+  if (!eng) return 1;
+  const Response* res = eng->peek(handle);
+  if (!res) return 1;
+  if ((long long)res->data.size() > cap) return 2;
+  std::memcpy(out, res->data.data(), res->data.size());
+  eng->release(handle);
+  return 0;
+}
+
+void hvd_release(long long handle) {
+  auto eng = engine();
+  if (eng) eng->release(handle);
+}
+
+// Live knob values (the autotuner may have moved them).
+double hvd_cycle_time_ms() {
+  auto eng = engine();
+  return eng ? eng->cycle_time_ms() : -1.0;
+}
+long long hvd_fusion_threshold() {
+  auto eng = engine();
+  return eng ? (long long)eng->fusion_threshold() : -1;
+}
+
+// ---- standalone autotuner objects (tests + compiled-path tuning) ----
+
+void* hvd_pm_create(long long fusion_threshold, double cycle_time_ms,
+                    int threshold_pinned, int cycle_pinned) {
+  return new ParameterManager(fusion_threshold, cycle_time_ms,
+                              threshold_pinned != 0, cycle_pinned != 0);
+}
+void hvd_pm_destroy(void* pm) { delete (ParameterManager*)pm; }
+int hvd_pm_update(void* pm, long long bytes, double seconds) {
+  return ((ParameterManager*)pm)->update(bytes, seconds) ? 1 : 0;
+}
+int hvd_pm_active(void* pm) { return ((ParameterManager*)pm)->active() ? 1 : 0; }
+long long hvd_pm_fusion_threshold(void* pm) {
+  return ((ParameterManager*)pm)->knobs().fusion_threshold;
+}
+double hvd_pm_cycle_time_ms(void* pm) {
+  return ((ParameterManager*)pm)->knobs().cycle_time_ms;
+}
+void hvd_pm_set_log(void* pm, const char* path) {
+  ((ParameterManager*)pm)->set_log_path(path ? path : "");
+}
+
+// One-shot GP fit/predict (n samples of dimension dims, row-major X).
+int hvd_gp_fit_predict(int n, int dims, const double* X, const double* y,
+                       const double* xstar, double* mu, double* sigma) {
+  std::vector<std::vector<double>> xs((size_t)n);
+  for (int i = 0; i < n; i++) {
+    xs[(size_t)i].assign(X + (size_t)i * dims, X + (size_t)(i + 1) * dims);
+  }
+  std::vector<double> ys(y, y + n);
+  GaussianProcess gp;
+  if (!gp.fit(xs, ys)) return 1;
+  std::vector<double> q(xstar, xstar + dims);
+  gp.predict(q, mu, sigma);
+  return 0;
+}
+
+}  // extern "C"

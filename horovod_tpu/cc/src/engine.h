@@ -1,0 +1,200 @@
+// The native eager engine: background thread + coordinator negotiation.
+//
+// This is the TPU-host re-design of the reference's core runtime
+// (horovod/common/operations.cc): a tensor table + message queue drained by a
+// background thread every cycle (RunLoopOnce, operations.cc:2030-2380), a
+// rank-0 coordinator that matches named tensors across ranks and validates
+// cross-rank consistency (IncrementTensorCount/ConstructResponse,
+// operations.cc:287-523), fusion of small same-dtype tensors
+// (operations.cc:2154-2266), a handle table for async callers
+// (torch/handle_manager.{cc,h}), stall detection
+// (CheckForStalledTensors, operations.cc:1625-1672) and a timeline.
+//
+// Differences by design (TPU host, no MPI/NCCL):
+// - control plane is a TCP coordinator (Spark-service blueprint, SURVEY §2.6)
+//   instead of MPI_Gatherv/Bcast ticks;
+// - the data plane for this engine is host memory (eager torch/numpy
+//   tensors); the relay carries tensor bytes with the request, so
+//   negotiation + execution complete in one round trip;
+// - the compiled JAX path bypasses all of this (XLA collectives).
+#ifndef HVD_ENGINE_H
+#define HVD_ENGINE_H
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "autotuner.h"
+#include "fusion.h"
+#include "hvd_common.h"
+#include "timeline.h"
+#include "wire.h"
+
+namespace hvd {
+
+struct Topology {
+  int rank = 0, size = 1, local_rank = 0, local_size = 1, cross_rank = 0,
+      cross_size = 1;
+};
+
+struct EngineConfig {
+  double cycle_time_ms = 5.0;            // HOROVOD_CYCLE_TIME
+  size_t fusion_threshold = 64u << 20;   // HOROVOD_FUSION_THRESHOLD
+  std::string timeline_path;             // HOROVOD_TIMELINE
+  bool timeline_mark_cycles = false;     // HOROVOD_TIMELINE_MARK_CYCLES
+  bool stall_check_disable = false;      // HOROVOD_STALL_CHECK_DISABLE
+  double stall_warning_s = 60.0;         // STALL_WARNING_TIME
+  bool autotune = false;                 // HOROVOD_AUTOTUNE
+  std::string autotune_log;              // HOROVOD_AUTOTUNE_LOG
+  bool threshold_pinned = false;         // env pinned HOROVOD_FUSION_THRESHOLD
+  bool cycle_pinned = false;             // env pinned HOROVOD_CYCLE_TIME
+  std::string coord_host;
+  int coord_port = 0;
+};
+
+// int handle -> result map (reference torch/handle_manager.{cc,h}).
+class HandleManager {
+ public:
+  int64_t allocate();
+  void mark_done(int64_t h, Status status, Response result);
+  bool poll(int64_t h);
+  // timeout_s < 0: wait forever; == 0: immediate poll. Timeout returns
+  // Aborted WITHOUT consuming the handle (the op is still in flight and its
+  // result must stay claimable — a later wait/release owns it).
+  Status wait(int64_t h, double timeout_s);   // leaves result in place
+  const Response* peek(int64_t h);
+  void release(int64_t h);
+  void fail_all(const std::string& reason);
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int64_t next_ = 0;
+  std::map<int64_t, std::pair<Status, Response>> done_;
+};
+
+class Coordinator;  // rank-0 control-plane server
+class Client;       // per-rank connection to the coordinator
+
+class Engine {
+ public:
+  Engine(const Topology& topo, const EngineConfig& cfg);
+  ~Engine();
+
+  // Async enqueue (reference EnqueueTensorAllreduce/..., operations.cc:2472-2591).
+  int64_t enqueue(OpType op, const std::string& name, DataType dtype,
+                  const std::vector<int64_t>& shape, const void* data,
+                  int root_rank, bool average);
+  bool poll(int64_t handle) { return handles_.poll(handle); }
+  Status wait(int64_t handle, double timeout_s) {
+    return handles_.wait(handle, timeout_s);
+  }
+  const Response* peek(int64_t handle) { return handles_.peek(handle); }
+  void release(int64_t handle) { handles_.release(handle); }
+
+  void shutdown();
+  const Topology& topology() const { return topo_; }
+  // Live knob values (autotuner may move them; reference ParameterManager
+  // overrides unless env-pinned, operations.cc:1840-1879).
+  double cycle_time_ms() const { return cycle_time_ms_; }
+  int64_t fusion_threshold() const { return fusion_threshold_; }
+
+ private:
+  struct Entry {
+    Request req;
+    int64_t handle;
+    std::chrono::steady_clock::time_point enqueued;
+  };
+
+  void loop();                       // reference BackgroundThreadLoop/RunLoopOnce
+  void complete_local(Entry& e);     // size==1 fast path
+  void negotiate_and_execute(std::vector<Entry>& batch);
+  void check_stalled();
+  void finish(Entry& e, Status st, Response res);  // mark done + release name
+
+  Topology topo_;
+  EngineConfig cfg_;
+  HandleManager handles_;
+  Timeline timeline_;
+  std::mutex qmu_;
+  std::deque<Entry> queue_;
+  // Names queued or in flight: a second enqueue of a live name is a caller
+  // bug the reference rejects loudly (test_torch.py:356 duplicate-name test).
+  std::set<std::string> inflight_;
+  std::atomic<bool> shutdown_{false};
+  std::thread bg_;
+  std::unique_ptr<Coordinator> coord_;
+  std::unique_ptr<Client> client_;
+  std::chrono::steady_clock::time_point last_stall_check_;
+  std::unique_ptr<ParameterManager> pm_;
+  double cycle_time_ms_ = 5.0;
+  int64_t fusion_threshold_ = 64 << 20;
+};
+
+// ---------------------------------------------------------------- coordinator
+
+// Rank-0 control-plane server. Holds the message table (tensor name ->
+// per-rank contributions); when a tensor has contributions from every rank it
+// is validated (ConstructResponse semantics: mismatched op/dtype/shape/root
+// across ranks produce an ERROR response for every rank instead of a
+// deadlock, operations.cc:321-523), executed on the host, and the results
+// are handed back to each rank's serve thread.
+class Coordinator {
+ public:
+  Coordinator(int world, const std::string& host, int port, Timeline* timeline,
+              size_t fusion_threshold);
+  ~Coordinator();
+  void stop();
+
+  // In-process exchange for rank 0 (no socket round trip).
+  std::vector<Response> exchange(int rank, std::vector<Request> reqs);
+
+ private:
+  void accept_loop();
+  void serve(int fd);
+  void execute_ready(const std::vector<std::string>& ready);
+  // Returns one Response per rank (broadcast results are identical; scatter
+  // results differ per rank).
+  std::vector<Response> execute(const std::string& name,
+                                std::map<int, Request>& contribs);
+
+  int world_;
+  int listen_fd_ = -1;
+  Timeline* timeline_;
+  size_t fusion_threshold_;
+  FusionBuffer fusion_buf_;
+  std::atomic<bool> stop_{false};
+  std::thread accept_thread_;
+  std::vector<std::thread> serve_threads_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::map<int, Request>> pending_;   // message table
+  std::map<std::string, std::vector<Response>> results_;    // per-rank results
+  std::map<std::string, std::set<int>> claimed_;            // ranks that took it
+};
+
+class Client {
+ public:
+  Client(const std::string& host, int port, int rank, double timeout_s);
+  ~Client();
+  std::vector<Response> exchange(const std::vector<Request>& reqs);
+
+ private:
+  int fd_ = -1;
+  int rank_;
+  std::mutex mu_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_ENGINE_H

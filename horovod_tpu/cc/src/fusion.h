@@ -1,0 +1,66 @@
+// Tensor-fusion planner for the eager engine.
+//
+// Native equivalent of the reference coordinator's greedy fusion loop
+// (operations.cc:2154-2266: merge ALLREDUCE responses of matching dtype up to
+// the fusion threshold, with look-ahead over skipped entries) plus the fusion
+// buffer itself (fusion_buffer_manager.{cc,h}: one cached buffer reused
+// across cycles). The compiled JAX path has its own trace-time planner
+// (horovod_tpu/parallel/fusion.py); this one serves the host data plane.
+#ifndef HVD_FUSION_H
+#define HVD_FUSION_H
+
+#include <cstdint>
+#include <map>
+#include <vector>
+
+#include "hvd_common.h"
+
+namespace hvd {
+
+struct FusionItem {
+  size_t index;   // position in the ready list
+  DataType dtype;
+  size_t nbytes;
+};
+
+// Greedy same-dtype bucketing with look-ahead: items are scanned in order;
+// an item joins the open bucket of its dtype if it fits under the threshold,
+// else it opens a new bucket (single oversize items get their own bucket,
+// like a tensor larger than the threshold going unfused in the reference).
+inline std::vector<std::vector<FusionItem>> plan_fusion(
+    const std::vector<FusionItem>& items, size_t threshold) {
+  std::vector<std::vector<FusionItem>> buckets;
+  std::map<DataType, size_t> open;  // dtype -> bucket index
+  std::map<DataType, size_t> open_bytes;
+  for (const auto& it : items) {
+    auto f = open.find(it.dtype);
+    if (f != open.end() && open_bytes[it.dtype] + it.nbytes <= threshold) {
+      buckets[f->second].push_back(it);
+      open_bytes[it.dtype] += it.nbytes;
+    } else {
+      open[it.dtype] = buckets.size();
+      open_bytes[it.dtype] = it.nbytes;
+      buckets.push_back({it});
+    }
+  }
+  return buckets;
+}
+
+// Reusable fusion buffer (reference fusion_buffer_manager.h:41-47: one
+// persistent buffer per device/framework, reallocated when the threshold
+// grows). Host-side: one per engine.
+class FusionBuffer {
+ public:
+  uint8_t* get(size_t nbytes) {
+    if (buf_.size() < nbytes) buf_.resize(nbytes);
+    return buf_.data();
+  }
+  size_t capacity() const { return buf_.size(); }
+
+ private:
+  std::vector<uint8_t> buf_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_FUSION_H

@@ -1,0 +1,109 @@
+// Minimal TCP framing for the control plane.
+//
+// Replaces the reference's MPI_Gather/MPI_Gatherv/MPI_Bcast control-plane
+// collectives (operations.cc:2088-2109, 2282-2287) with a socket
+// coordinator, following the in-repo blueprint of the Spark driver/task
+// services (reference horovod/spark/util/network.py:44-76: digest + length +
+// body framing; we use plain length framing since all peers are the same
+// build inside one pod).
+#ifndef HVD_NET_H
+#define HVD_NET_H
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+inline void send_all(int fd, const void* p, size_t n) {
+  const uint8_t* c = (const uint8_t*)p;
+  while (n > 0) {
+    ssize_t w = ::send(fd, c, n, MSG_NOSIGNAL);
+    if (w <= 0) throw std::runtime_error("send failed");
+    c += w;
+    n -= (size_t)w;
+  }
+}
+
+inline void recv_all(int fd, void* p, size_t n) {
+  uint8_t* c = (uint8_t*)p;
+  while (n > 0) {
+    ssize_t r = ::recv(fd, c, n, 0);
+    if (r <= 0) throw std::runtime_error("recv failed / peer closed");
+    c += r;
+    n -= (size_t)r;
+  }
+}
+
+inline void send_frame(int fd, const std::vector<uint8_t>& payload) {
+  uint64_t len = payload.size();
+  send_all(fd, &len, 8);
+  if (len) send_all(fd, payload.data(), payload.size());
+}
+
+inline std::vector<uint8_t> recv_frame(int fd) {
+  uint64_t len = 0;
+  recv_all(fd, &len, 8);
+  std::vector<uint8_t> out(len);
+  if (len) recv_all(fd, out.data(), len);
+  return out;
+}
+
+inline int listen_on(const std::string& host, int port, int backlog) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) throw std::runtime_error("socket failed");
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  addr.sin_addr.s_addr = host.empty() ? INADDR_ANY : inet_addr(host.c_str());
+  if (::bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    ::close(fd);
+    throw std::runtime_error("bind failed on port " + std::to_string(port));
+  }
+  if (::listen(fd, backlog) != 0) {
+    ::close(fd);
+    throw std::runtime_error("listen failed");
+  }
+  return fd;
+}
+
+inline int connect_to(const std::string& host, int port, double timeout_s) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res) != 0)
+    throw std::runtime_error("getaddrinfo failed for " + host);
+  double waited = 0.0;
+  while (true) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      freeaddrinfo(res);
+      return fd;
+    }
+    if (fd >= 0) ::close(fd);
+    if (waited >= timeout_s) {
+      freeaddrinfo(res);
+      throw std::runtime_error("cannot reach coordinator at " + host + ":" +
+                               std::to_string(port));
+    }
+    ::usleep(100 * 1000);
+    waited += 0.1;
+  }
+}
+
+}  // namespace hvd
+
+#endif  // HVD_NET_H

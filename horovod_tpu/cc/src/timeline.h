@@ -1,0 +1,158 @@
+// Chrome-tracing timeline writer.
+//
+// Native equivalent of the reference Timeline/TimelineWriter
+// (horovod/common/timeline.{cc,h}): every tensor-state transition emits an
+// event into a bounded queue drained by a dedicated writer thread
+// (timeline.cc:120-146). The reference uses a boost lock-free SPSC queue of
+// capacity 1M; a mutexed deque with the same capacity bound keeps the
+// dependency surface zero and the enqueue cost irrelevant next to socket IO.
+// Output format: catapult JSON (docs/timeline.md), one pid per tensor lane.
+#ifndef HVD_TIMELINE_H
+#define HVD_TIMELINE_H
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+namespace hvd {
+
+class Timeline {
+ public:
+  Timeline() = default;
+  ~Timeline() { shutdown(); }
+
+  void init(const std::string& path, bool mark_cycles) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (file_) return;
+    file_ = std::fopen(path.c_str(), "w");
+    if (!file_) return;
+    std::fputs("[\n", file_);
+    mark_cycles_ = mark_cycles;
+    healthy_ = true;
+    start_ = now_us();
+    writer_ = std::thread([this] { writer_loop(); });
+  }
+
+  bool healthy() const { return healthy_; }
+
+  // Negotiation phases (reference timeline.h:83-89).
+  void negotiate_start(const std::string& tensor, const char* op) {
+    emit(tensor, 'B', std::string("NEGOTIATE_") + op, "");
+  }
+  void negotiate_rank_ready(const std::string& tensor, int rank) {
+    emit(tensor, 'i', std::to_string(rank), "");
+  }
+  void negotiate_end(const std::string& tensor) { emit(tensor, 'E', "", ""); }
+
+  // Processing phases (reference timeline.h:90-93).
+  void start(const std::string& tensor, const char* op) { emit(tensor, 'B', op, ""); }
+  void activity_start(const std::string& tensor, const char* activity) {
+    emit(tensor, 'B', activity, "");
+  }
+  void activity_end(const std::string& tensor) { emit(tensor, 'E', "", ""); }
+  void end(const std::string& tensor) { emit(tensor, 'E', "", ""); }
+
+  void mark_cycle_start() {
+    if (healthy_ && mark_cycles_) emit("CYCLE", 'i', "CYCLE_START", "");
+  }
+
+  void shutdown() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (!healthy_) return;
+      healthy_ = false;
+      cv_.notify_all();
+    }
+    if (writer_.joinable()) writer_.join();
+    if (file_) {
+      std::fputs("]\n", file_);
+      std::fclose(file_);
+      file_ = nullptr;
+    }
+  }
+
+ private:
+  struct Event {
+    char phase;         // B / E / i
+    std::string tensor;
+    std::string name;
+    int64_t ts_us;
+  };
+
+  static int64_t now_us() {
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+  void emit(const std::string& tensor, char phase, const std::string& name,
+            const std::string&) {
+    if (!healthy_) return;
+    std::lock_guard<std::mutex> g(mu_);
+    if (queue_.size() >= kCapacity) return;  // drop, like a full SPSC queue
+    queue_.push_back(Event{phase, tensor, name, now_us() - start_});
+    cv_.notify_one();
+  }
+
+  void writer_loop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (healthy_ || !queue_.empty()) {
+      if (queue_.empty()) {
+        cv_.wait_for(lk, std::chrono::milliseconds(50));
+        continue;
+      }
+      Event e = std::move(queue_.front());
+      queue_.pop_front();
+      lk.unlock();
+      write_event(e);
+      lk.lock();
+    }
+  }
+
+  void write_event(const Event& e) {
+    int pid = pid_for(e.tensor);
+    if (e.phase == 'E') {
+      std::fprintf(file_, "{\"ph\":\"E\",\"pid\":%d,\"ts\":%lld},\n", pid,
+                   (long long)e.ts_us);
+    } else {
+      std::fprintf(file_,
+                   "{\"ph\":\"%c\",\"pid\":%d,\"ts\":%lld,\"name\":\"%s\"%s},\n",
+                   e.phase, pid, (long long)e.ts_us, e.name.c_str(),
+                   e.phase == 'i' ? ",\"s\":\"p\"" : "");
+    }
+    std::fflush(file_);
+  }
+
+  int pid_for(const std::string& tensor) {
+    auto it = pids_.find(tensor);
+    if (it != pids_.end()) return it->second;
+    int pid = (int)pids_.size() + 1;
+    pids_[tensor] = pid;
+    // metadata record naming the lane (reference timeline.cc WriteAtFileStart)
+    std::fprintf(file_,
+                 "{\"ph\":\"M\",\"pid\":%d,\"name\":\"process_name\","
+                 "\"args\":{\"name\":\"%s\"}},\n",
+                 pid, tensor.c_str());
+    return pid;
+  }
+
+  static constexpr size_t kCapacity = 1 << 20;  // reference timeline.h:66
+  std::FILE* file_ = nullptr;
+  bool healthy_ = false;
+  bool mark_cycles_ = false;
+  int64_t start_ = 0;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Event> queue_;
+  std::thread writer_;
+  std::unordered_map<std::string, int> pids_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_TIMELINE_H

@@ -1,0 +1,167 @@
+// Wire format for control-plane messages.
+//
+// The reference serializes Request/RequestList/Response/ResponseList with
+// FlatBuffers (horovod/common/wire/message.fbs:41-101, message.{cc,h}).
+// Here the schema is the same shape — Request{rank, op, dtype, name, root,
+// shape}, Response{type, names, error, sizes} — but the encoding is a plain
+// length-prefixed little-endian stream: the messages are rank-local,
+// version-locked to the build, and never persisted, so a schema compiler
+// buys nothing on TPU hosts.
+#ifndef HVD_WIRE_H
+#define HVD_WIRE_H
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "hvd_common.h"
+
+namespace hvd {
+
+class Writer {
+ public:
+  std::vector<uint8_t> buf;
+
+  void u8(uint8_t v) { buf.push_back(v); }
+  void u32(uint32_t v) { raw(&v, 4); }
+  void u64(uint64_t v) { raw(&v, 8); }
+  void i32(int32_t v) { raw(&v, 4); }
+  void i64(int64_t v) { raw(&v, 8); }
+  void f64(double v) { raw(&v, 8); }
+  void str(const std::string& s) {
+    u32((uint32_t)s.size());
+    raw(s.data(), s.size());
+  }
+  void bytes(const void* p, size_t n) {
+    u64(n);
+    raw(p, n);
+  }
+  void raw(const void* p, size_t n) {
+    const uint8_t* c = (const uint8_t*)p;
+    buf.insert(buf.end(), c, c + n);
+  }
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* p, size_t n) : p_(p), n_(n) {}
+
+  uint8_t u8() { return *take(1); }
+  uint32_t u32() { uint32_t v; std::memcpy(&v, take(4), 4); return v; }
+  uint64_t u64() { uint64_t v; std::memcpy(&v, take(8), 8); return v; }
+  int32_t i32() { int32_t v; std::memcpy(&v, take(4), 4); return v; }
+  int64_t i64() { int64_t v; std::memcpy(&v, take(8), 8); return v; }
+  double f64() { double v; std::memcpy(&v, take(8), 8); return v; }
+  std::string str() {
+    uint32_t n = u32();
+    const uint8_t* p = take(n);
+    return std::string((const char*)p, n);
+  }
+  std::vector<uint8_t> bytes() {
+    uint64_t n = u64();
+    const uint8_t* p = take(n);
+    return std::vector<uint8_t>(p, p + n);
+  }
+  bool done() const { return off_ == n_; }
+
+ private:
+  const uint8_t* take(size_t n) {
+    if (off_ + n > n_) throw std::runtime_error("wire: truncated message");
+    const uint8_t* out = p_ + off_;
+    off_ += n;
+    return out;
+  }
+  const uint8_t* p_;
+  size_t n_;
+  size_t off_ = 0;
+};
+
+// A collective request from one rank (reference message.h:44-120).
+struct Request {
+  int32_t rank = 0;
+  OpType op = OpType::ALLREDUCE;
+  DataType dtype = DataType::F32;
+  std::string name;
+  int32_t root_rank = 0;
+  uint8_t average = 1;
+  std::vector<int64_t> shape;
+  std::vector<uint8_t> data;  // relay data plane: tensor bytes ride along
+
+  size_t elements() const {
+    size_t n = 1;
+    for (auto d : shape) n *= (size_t)d;
+    return n;
+  }
+
+  void write(Writer& w) const {
+    w.i32(rank);
+    w.u8((uint8_t)op);
+    w.u8((uint8_t)dtype);
+    w.str(name);
+    w.i32(root_rank);
+    w.u8(average);
+    w.u8((uint8_t)shape.size());
+    for (auto d : shape) w.i64(d);
+    w.bytes(data.data(), data.size());
+  }
+  static Request read(Reader& r) {
+    Request q;
+    q.rank = r.i32();
+    q.op = (OpType)r.u8();
+    q.dtype = (DataType)r.u8();
+    q.name = r.str();
+    q.root_rank = r.i32();
+    q.average = r.u8();
+    uint8_t nd = r.u8();
+    q.shape.resize(nd);
+    for (int i = 0; i < nd; i++) q.shape[i] = r.i64();
+    q.data = r.bytes();
+    return q;
+  }
+};
+
+// Result for one tensor (reference Response, message.h:146-209: OK with
+// payload metadata, or ERROR with reason delivered to every rank).
+struct Response {
+  enum Kind : uint8_t { OK = 0, ERROR = 1 };
+  Kind kind = OK;
+  std::string name;
+  std::string error;
+  DataType dtype = DataType::F32;
+  std::vector<int64_t> shape;
+  std::vector<uint8_t> data;
+
+  void write(Writer& w) const {
+    w.u8((uint8_t)kind);
+    w.str(name);
+    if (kind == ERROR) {
+      w.str(error);
+      return;
+    }
+    w.u8((uint8_t)dtype);
+    w.u8((uint8_t)shape.size());
+    for (auto d : shape) w.i64(d);
+    w.bytes(data.data(), data.size());
+  }
+  static Response read(Reader& r) {
+    Response res;
+    res.kind = (Kind)r.u8();
+    res.name = r.str();
+    if (res.kind == ERROR) {
+      res.error = r.str();
+      return res;
+    }
+    res.dtype = (DataType)r.u8();
+    uint8_t nd = r.u8();
+    res.shape.resize(nd);
+    for (int i = 0; i < nd; i++) res.shape[i] = r.i64();
+    res.data = r.bytes();
+    return res;
+  }
+};
+
+}  // namespace hvd
+
+#endif  // HVD_WIRE_H

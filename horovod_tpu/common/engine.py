@@ -128,6 +128,7 @@ class PyEngine:
         # name → (op, array, root, handle, enqueue_time); the tensor table
         # (reference operations.cc:121-127 tensor_table + message_queue).
         self._queue: list[dict] = []
+        self._inflight: set[str] = set()  # duplicate-name guard
         self._timeline = None
         if config.timeline and topo.rank == 0:
             from ..utils.timeline import Timeline
@@ -154,13 +155,20 @@ class PyEngine:
 
     # -- public enqueue API (reference EnqueueTensorAllreduce/..., operations.cc:2472-2591)
 
-    def enqueue(self, op: str, array: np.ndarray, name: str, root_rank: int = 0,
-                average: bool = True) -> int:
+    def enqueue(self, op: str, array: np.ndarray, name: Optional[str],
+                root_rank: int = 0, average: bool = True) -> int:
         if op not in _OPS:
             raise ValueError(f"unknown op {op}")
         if self._shutdown.is_set():
             raise HorovodInternalError("Horovod has been shut down")
+        if op == "allgather" and np.asarray(array).ndim == 0:
+            raise HorovodInternalError(
+                "Allgather requires tensors of rank >= 1 (got a scalar)")
         handle = self.handles.allocate()
+        if not name:
+            # Auto-name by handle (reference GetOpName, mpi_ops_v2.cc:44-50):
+            # handles increment identically across ranks when op order matches.
+            name = f"{op}.noname.{handle}"
         entry = {
             "op": op,
             "array": np.asarray(array),
@@ -171,6 +179,12 @@ class PyEngine:
             "t": time.monotonic(),
         }
         with self._lock:
+            if name in self._inflight:
+                raise HorovodInternalError(
+                    f"Duplicate tensor name {name}; a name may only be used "
+                    "once until its collective completes"
+                )
+            self._inflight.add(name)
             self._queue.append(entry)
         if self._timeline:
             self._timeline.negotiate_start(name, op.upper())
@@ -201,6 +215,7 @@ class PyEngine:
                     e["handle"], HorovodInternalError("Horovod has been shut down"), None
                 )
             self._queue.clear()
+            self._inflight.clear()
 
     # -- background loop (reference RunLoopOnce, operations.cc:2030-2380)
 
@@ -223,6 +238,11 @@ class PyEngine:
                 self._check_stalled()
                 last_stall_check = time.monotonic()
 
+    def _finish(self, e: dict, error, result) -> None:
+        with self._lock:
+            self._inflight.discard(e["name"])
+        self.handles.mark_done(e["handle"], error, result)
+
     def _complete_local(self, e: dict) -> None:
         name, arr = e["name"], e["array"]
         if self._timeline:
@@ -235,7 +255,7 @@ class PyEngine:
             result = arr
         if self._timeline:
             self._timeline.end(name)
-        self.handles.mark_done(e["handle"], None, result)
+        self._finish(e, None, result)
 
     def _negotiate_and_execute(self, batch: list[dict]) -> None:
         # Workers ship their request list to the coordinator (MPI_Gatherv
@@ -255,7 +275,7 @@ class PyEngine:
             results = self._client.exchange(requests, arrays)
         except Exception as exc:
             for e in batch:
-                self.handles.mark_done(e["handle"], HorovodInternalError(str(exc)), None)
+                self._finish(e, HorovodInternalError(str(exc)), None)
             return
         for e in batch:
             name = e["name"]
@@ -267,9 +287,9 @@ class PyEngine:
                 continue
             err, value = res
             if err is not None:
-                self.handles.mark_done(e["handle"], TensorShapeMismatchError(err), None)
+                self._finish(e, TensorShapeMismatchError(err), None)
             else:
-                self.handles.mark_done(e["handle"], None, value)
+                self._finish(e, None, value)
 
     def _check_stalled(self) -> None:
         """Reference CheckForStalledTensors (operations.cc:1625-1672)."""
@@ -305,7 +325,7 @@ class _Coordinator:
         # name → {rank: (request, array)}; the message_table
         self._pending: dict[str, dict[int, tuple[dict, np.ndarray]]] = {}
         self._results: dict[str, tuple[Optional[str], Any]] = {}
-        self._result_claims: dict[str, int] = {}
+        self._claimed: dict[str, set[int]] = {}
 
     def start(self) -> None:
         t = threading.Thread(target=self._accept_loop, name="hvd_coord_accept", daemon=True)
@@ -345,13 +365,19 @@ class _Coordinator:
         ready: list[str] = []
         with self._cv:
             for req in requests:
-                entry = self._pending.setdefault(req["name"], {})
-                entry[rank] = (req, arrays[req["name"]])
+                name = req["name"]
+                # Re-send after a timeout: the result is already waiting for
+                # this rank — don't contribute again (a stale entry would
+                # poison the next same-name collective).
+                if name in self._results and rank not in self._claimed.get(name, set()):
+                    continue
+                entry = self._pending.setdefault(name, {})
+                entry[rank] = (req, arrays[name])
                 if len(entry) == self.world:
-                    ready.append(req["name"])
+                    ready.append(name)
             for name in ready:
                 self._results[name] = self._execute(name, self._pending.pop(name))
-                self._result_claims[name] = 0
+                self._claimed[name] = set()
             self._cv.notify_all()
             # Block until every requested tensor is globally ready (collective
             # semantics). A rank that never shows up trips the deadline; the
@@ -365,12 +391,12 @@ class _Coordinator:
             ):
                 self._cv.wait(timeout=0.1)
             for n in names:
-                if n in self._results:
+                if n in self._results and rank not in self._claimed[n]:
                     out[n] = self._results[n]
-                    self._result_claims[n] += 1
-                    if self._result_claims[n] == self.world:
+                    self._claimed[n].add(rank)
+                    if len(self._claimed[n]) == self.world:
                         del self._results[n]
-                        del self._result_claims[n]
+                        del self._claimed[n]
         return out
 
     def _execute(self, name: str, contributions: dict[int, tuple[dict, np.ndarray]]):
@@ -454,10 +480,28 @@ class _Client:
 
 
 def create(topo: Topology, config: Config):
-    """Factory: native C++ engine when available, Python fallback otherwise."""
-    try:
-        from ..cc import native_engine  # built extension
+    """Factory: native C++ engine when available, Python fallback otherwise.
 
-        return native_engine.NativeEngine(topo, config)
-    except Exception:
-        return PyEngine(topo, config)
+    ``HOROVOD_ENGINE=python`` forces the fallback; ``native`` (default) tries
+    native first; ``native!`` raises instead of falling back. In
+    multi-process worlds the fallback is NOT silent: the two engines speak
+    different wire protocols, so a mixed world would hang — every rank must
+    make the same choice, hence build failures raise there."""
+    impl = os.environ.get("HOROVOD_ENGINE", "native").lower()
+    if impl not in ("native", "native!", "python"):
+        log("warning", f"unknown HOROVOD_ENGINE={impl!r}; using 'native'")
+        impl = "native"
+    if impl.startswith("native"):
+        try:
+            from ..cc.native_engine import NativeEngine
+
+            return NativeEngine(topo, config)
+        except Exception as e:
+            if impl == "native!" or topo.size > 1:
+                raise HorovodInternalError(
+                    f"native engine unavailable ({e}); in multi-process worlds "
+                    "all ranks must use the same engine — fix the native build "
+                    "or set HOROVOD_ENGINE=python on every rank"
+                ) from e
+            log("debug", f"native engine unavailable ({e}); using Python engine")
+    return PyEngine(topo, config)

@@ -1,1 +1,6 @@
-"""horovod_tpu.models"""
+"""Model zoo for benchmarks and examples (the reference ships models inside
+examples/ + tf_cnn_benchmarks; here they are a first-class subpackage)."""
+
+from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152  # noqa: F401
+from .mlp import MLP, ConvNet  # noqa: F401
+from .transformer import TransformerLM  # noqa: F401

@@ -1,0 +1,41 @@
+"""Small MLP / conv net for MNIST-scale examples and tests — the analog of
+the reference's example models (reference examples/pytorch_mnist.py Net,
+examples/tensorflow_mnist.py conv_model)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 64, 10)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, f in enumerate(self.features[:-1]):
+            x = nn.relu(nn.Dense(f, dtype=self.dtype)(x))
+        return nn.Dense(self.features[-1], dtype=jnp.float32)(x)
+
+
+class ConvNet(nn.Module):
+    """The reference MNIST conv topology (two convs, two dense)."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.relu(nn.Conv(32, (5, 5), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (5, 5), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,126 @@
+"""Decoder-only transformer LM — the long-context flagship.
+
+Beyond the reference's CNN benchmark zoo: this model exists to exercise the
+sequence-parallel / long-context path (SURVEY.md §5.7 notes the reference has
+none; the TPU build makes it first-class). Design:
+
+- bfloat16 activations, float32 params;
+- attention is pluggable: dense causal attention by default, ring attention
+  (horovod_tpu.ops.ring_attention) when a sequence-parallel axis is given;
+- weights laid out for tensor parallelism: QKV and MLP-in are sharded on the
+  output feature dim, O-proj and MLP-out on the input feature dim, so tp only
+  needs one psum per block (inserted automatically by XLA under jit with
+  sharding constraints).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _rope(x, positions):
+    """Rotary position embedding on the last dim (pairs)."""
+    half = x.shape[-1] // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., T, half]
+    cos, sin = jnp.cos(angles)[..., None, :], jnp.sin(angles)[..., None, :]  # add head dim
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def causal_attention(q, k, v, seq_offset=0):
+    """Dense causal attention. q,k,v: [B, T, H, D]. Runs on-chip in one block —
+    fine up to ~8k tokens; ring attention takes over beyond that."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    t_q, t_k = q.shape[1], k.shape[1]
+    q_pos = jnp.arange(t_q) + seq_offset
+    k_pos = jnp.arange(t_k)
+    mask = q_pos[:, None] >= k_pos[None, :]
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Block(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    sp_axis: Optional[str] = None  # sequence-parallel mesh axis (ring attention)
+
+    @nn.compact
+    def __call__(self, x, positions):
+        head_dim = self.dim // self.heads
+        h = nn.RMSNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, t = x.shape[0], x.shape[1]
+        q = _rope(q.reshape(b, t, self.heads, head_dim), positions)
+        k = _rope(k.reshape(b, t, self.heads, head_dim), positions)
+        v = v.reshape(b, t, self.heads, head_dim)
+        if self.sp_axis is not None:
+            from ..ops.ring_attention import ring_attention
+
+            attn = ring_attention(q, k, v, axis_name=self.sp_axis)
+        else:
+            attn = causal_attention(q, k, v)
+        attn = attn.reshape(b, t, self.dim)
+        x = x + nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="o_proj")(attn)
+        h = nn.RMSNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_ratio * self.dim, use_bias=False, dtype=self.dtype, name="mlp_in")(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="mlp_out")(h)
+        return x
+
+
+class TransformerLM(nn.Module):
+    vocab: int = 32000
+    dim: int = 512
+    heads: int = 8
+    layers: int = 6
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    sp_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])[None, :]
+        x = nn.Embed(self.vocab, self.dim, dtype=self.dtype, name="embed")(tokens)
+        for i in range(self.layers):
+            x = Block(
+                dim=self.dim,
+                heads=self.heads,
+                mlp_ratio=self.mlp_ratio,
+                dtype=self.dtype,
+                sp_axis=self.sp_axis,
+                name=f"block_{i}",
+            )(x, positions)
+        x = nn.RMSNorm(dtype=self.dtype)(x)
+        logits = nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32, name="lm_head")(x)
+        return logits
+
+
+def tp_param_specs(params, tp_axis: str = "tp"):
+    """PartitionSpecs for tensor parallelism: shard QKV/MLP-in kernels on the
+    output dim, O-proj/MLP-out on the input dim, replicate the rest. Used as
+    jit in_shardings so XLA inserts the single per-block psum."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        joined = "/".join(str(n) for n in names)
+        if leaf.ndim == 2:
+            if "qkv" in joined or "mlp_in" in joined:
+                return P(None, tp_axis)
+            if "o_proj" in joined or "mlp_out" in joined or "lm_head" in joined:
+                return P(tp_axis, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

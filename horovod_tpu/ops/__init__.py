@@ -1,1 +1,4 @@
-"""horovod_tpu.ops"""
+"""TPU compute ops beyond stock XLA: sequence-parallel attention schedules
+(ring / Ulysses) and, as the framework grows, pallas kernels for the hot ops."""
+
+from .ring_attention import ring_attention, ulysses_attention, causal_reference  # noqa: F401

@@ -1,0 +1,117 @@
+"""Sequence-parallel attention: ring attention + Ulysses all-to-all.
+
+The reference has no sequence parallelism (SURVEY.md §5.7); the TPU build
+makes long-context first-class. Two schedules over a sequence-sharded mesh
+axis:
+
+- :func:`ring_attention` — blockwise causal attention with online softmax;
+  K/V blocks rotate around the ring via ``ppermute`` so each hop rides a
+  single ICI link while the current block's matmuls run on the MXU
+  (communication hides behind compute for T_local*D large enough).
+- :func:`ulysses_attention` — all-to-all re-shard: trade the sequence shard
+  for a head shard, run dense local attention, trade back. Cheaper at modest
+  sequence lengths when heads % devices == 0.
+
+Both take q, k, v of shape [B, T_local, H, D] (sequence already sharded on
+``axis_name``) and return [B, T_local, H, D].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_update(q, k, v, o, m, l, q_offset, k_offset, scale):
+    """One flash-attention accumulation step with global causal masking.
+
+    o: [B,T,H,D] f32 accumulator; m, l: [B,H,T] f32 running max / normalizer.
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    t_q, t_k = q.shape[1], k.shape[1]
+    q_pos = q_offset + jnp.arange(t_q)
+    k_pos = k_offset + jnp.arange(t_k)
+    mask = (q_pos[:, None] >= k_pos[None, :])[None, None]  # [1,1,Tq,Tk]
+    logits = jnp.where(mask, logits, -jnp.inf)
+
+    block_max = jnp.max(logits, axis=-1)                       # [B,H,Tq]
+    m_new = jnp.maximum(m, block_max)
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.where(mask, jnp.exp(logits - m_safe[..., None]), 0.0)
+    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v).astype(jnp.float32)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, axis_name: str):
+    """Causal ring attention over ``axis_name`` (sequence-sharded).
+
+    TODO(perf): with contiguous sequence sharding, blocks from src > rank are
+    fully masked, so ~half the ring steps do dead work. Zigzag/striped
+    sharding (each rank holds a low and a high sequence stripe) balances the
+    causal load; requires remapping positions at the caller.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = d**-0.5
+
+    o = jnp.zeros((b, t, h, d), jnp.float32)
+    m = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, t), jnp.float32)
+
+    q_offset = my * t
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    k_blk, v_blk = k, v
+    for step in range(n):
+        src = (my - step) % n
+        o, m, l = _block_update(q, k_blk, v_blk, o, m, l, q_offset, src * t, scale)
+        if step + 1 < n:
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def causal_reference(q, k, v):
+    """Single-device dense causal attention — the oracle the sequence-parallel
+    schedules are tested against. q,k,v: [B, T, H, D]."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    t = q.shape[1]
+    mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+    logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ulysses_attention(q, k, v, axis_name: str):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses schedule): re-shard
+    [B, T/n, H, D] -> [B, T, H/n, D], dense causal attention on full sequence
+    with a head shard, re-shard back."""
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(f"heads {h} not divisible by axis size {n}")
+
+    def to_heads(x):  # [B,Tl,H,D] -> [B,T,H/n,D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def to_seq(x):  # [B,T,H/n,D] -> [B,Tl,H,D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) * scale
+    t = qh.shape[1]
+    mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
+    return to_seq(out)

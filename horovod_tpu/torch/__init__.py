@@ -1,0 +1,239 @@
+"""Torch framework binding — hook-driven data parallelism on the eager engine.
+
+Parity map to the reference torch binding (horovod/torch/__init__.py):
+
+- :class:`_DistributedOptimizer` / :func:`DistributedOptimizer` — per-parameter
+  hooks fire ``allreduce_async_`` as gradients become ready
+  (torch/__init__.py:95-130); ``backward_passes_per_step`` accumulates
+  gradients locally before reducing (71-93); ``synchronize()`` drains all
+  handles (132-147); ``step()`` = synchronize + inner step (149-151).
+- :func:`broadcast_parameters` (torch/__init__.py:200-230) and
+  :func:`broadcast_optimizer_state` (232-348, including the scalar->tensor
+  wrapping for hyperparameters like lr/momentum).
+- init/rank/size/... re-exported from the shared basics, like every binding.
+
+The hook mechanism uses ``register_post_accumulate_grad_hook`` (torch >= 2.1)
+rather than the reference's grad-accumulator expand_as trick — same firing
+point, supported API.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+import torch
+
+from ..common.basics import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    mpi_threads_supported,
+)
+from .compression import Compression  # noqa: F401
+from .mpi_ops import (  # noqa: F401
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    poll,
+    synchronize,
+)
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    def __init__(self, params, named_parameters=None,
+                 compression=Compression.none, backward_passes_per_step=1,
+                 defaults=None):
+        # Base Optimizer init, not the concrete class's: `params` is already
+        # a fully-populated param_groups list from the wrapped optimizer, so
+        # per-class hyperparameter validation (lr, momentum, ...) would choke.
+        # The wrapped optimizer's defaults ride along (step wrappers read
+        # self.defaults['differentiable'] in modern torch).
+        torch.optim.Optimizer.__init__(self, params, dict(defaults or {}))
+        self._compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                (f"allreduce.noname.{i}", v)
+                for i, v in enumerate(p for group in self.param_groups
+                                      for p in group["params"])
+            ]
+        # Reference checks for duplicate names (torch/__init__.py:60-68).
+        names = [n for n, _ in named_parameters]
+        if len(names) != len(set(names)):
+            raise ValueError("parameter names must be unique")
+        self._parameter_names = {v: n for n, v in named_parameters}
+        self._handles: dict[torch.Tensor, int] = {}
+        self._grad_ctx: dict[torch.Tensor, Any] = {}
+        self._allreduce_delay: dict[torch.Tensor, int] = {}
+        self._hook_handles = []
+        self._register_hooks()
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._allreduce_delay[p] = self.backward_passes_per_step
+                    self._hook_handles.append(
+                        p.register_post_accumulate_grad_hook(self._make_hook(p))
+                    )
+
+    def _make_hook(self, p):
+        def hook(*_):
+            if p in self._handles:
+                # grad fired again before synchronize: programming error in
+                # the training loop (reference raises the same way)
+                raise AssertionError(
+                    "Gradient ready before optimizer.step(); call synchronize()"
+                )
+            self._allreduce_delay[p] -= 1
+            if self._allreduce_delay[p] == 0:
+                self._allreduce_grad_async(p)
+
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        name = self._parameter_names.get(p)
+        compressed, ctx = self._compression.compress(p.grad)
+        self._grad_ctx[p] = (compressed, ctx)
+        handle = allreduce_async_(compressed, average=True, name=name)
+        self._handles[p] = handle
+
+    def synchronize(self):
+        """Wait for all outstanding allreduces, decompress into .grad
+        (reference torch/__init__.py:132-147)."""
+        # Parameters whose hook hasn't fired enough times this step (unused
+        # branch on this rank, or mid-accumulation with
+        # backward_passes_per_step > 1): enqueue them now so every rank
+        # issues the same collectives (reference test_force_allreduce).
+        for p, delay in self._allreduce_delay.items():
+            if p in self._handles or delay <= 0:
+                continue
+            if p.grad is None:
+                if delay == self.backward_passes_per_step:
+                    # never had a gradient: contribute zeros to stay collective
+                    p.grad = p.data.new_zeros(p.shape)
+                else:  # pragma: no cover - grad exists once any pass ran
+                    continue
+            self._allreduce_grad_async(p)
+        for p, handle in list(self._handles.items()):
+            output = synchronize(handle)
+            compressed, ctx = self._grad_ctx.pop(p)
+            with torch.no_grad():
+                p.grad.copy_(self._compression.decompress(output, ctx)
+                             .reshape(p.grad.shape).to(p.grad.dtype))
+            self._allreduce_delay[p] = self.backward_passes_per_step
+        self._handles.clear()
+
+    def step(self, closure=None):
+        self.synchronize()
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "zero_grad() called while allreduces are outstanding; call "
+                "step() or synchronize() first"
+            )
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters: Optional[Iterator] = None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1):
+    """Dynamic subclass of the user's optimizer class, exactly like the
+    reference (torch/__init__.py:185-197): keeps isinstance() working and
+    inherits the inner optimizer's step math."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    obj = cls.__new__(cls)
+    _DistributedOptimizer.__init__(
+        obj, optimizer.param_groups, named_parameters, compression,
+        backward_passes_per_step, defaults=optimizer.defaults)
+    return obj
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast a state_dict or named-parameter iterable from root
+    (reference torch/__init__.py:200-230)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    for name, p in items:
+        if p is None:
+            continue
+        broadcast_(p.data if hasattr(p, "data") else p, root_rank, name=f"bp.{name}")
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast optimizer state from root (reference torch/__init__.py:232-348).
+
+    The reference wraps python scalars (lr, momentum, step counters) into
+    tensors, broadcasts, and casts back via per-entry callbacks; the same
+    dance happens here with the type preserved through numpy."""
+    import numpy as np
+
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+
+    state_dict = optimizer.state_dict()
+
+    # Newly constructed optimizers have empty state: create it by running a
+    # zero-gradient step (reference torch/__init__.py:251-268). This must
+    # happen on EVERY rank with empty state, not just root — the broadcast
+    # below is name-matched across ranks, so all ranks need identical state
+    # structure or the collective would stall.
+    if not state_dict["state"]:
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p.grad is None:
+                    p.grad = p.data.new_zeros(p.shape)
+        optimizer.step()
+        state_dict = optimizer.state_dict()
+
+    scalars: list[tuple[Any, Any, str]] = []  # (container, key, name)
+    tensors: list[tuple[torch.Tensor, str]] = []
+
+    def visit(container, key, name):
+        value = container[key]
+        if torch.is_tensor(value):
+            tensors.append((value, name))
+        elif isinstance(value, (int, float, bool, np.integer, np.floating)):
+            scalars.append((container, key, name))
+
+    for gi, group in enumerate(state_dict["param_groups"]):
+        for key in sorted(k for k in group.keys() if k != "params"):
+            visit(group, key, f"opt.group{gi}.{key}")
+    for pid in sorted(state_dict["state"].keys()):
+        pstate = state_dict["state"][pid]
+        for key in sorted(pstate.keys()):
+            visit(pstate, key, f"opt.state{pid}.{key}")
+
+    for t, name in tensors:
+        broadcast_(t, root_rank, name=name)
+    for container, key, name in scalars:
+        value = container[key]
+        wrapped = torch.tensor([float(value)], dtype=torch.float64)
+        broadcast_(wrapped, root_rank, name=name)
+        out = wrapped.item()
+        container[key] = type(value)(out) if not isinstance(value, bool) else bool(out)
+
+    optimizer.load_state_dict(state_dict)

@@ -1,0 +1,34 @@
+"""Gradient compression for the torch binding (reference
+horovod/torch/compression.py: NoneCompressor passes through, FP16Compressor
+casts to half for the wire and back after)."""
+
+from __future__ import annotations
+
+import torch
+
+
+class NoneCompressor:
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        return tensor
+
+
+class FP16Compressor:
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        if tensor.dtype in (torch.float32, torch.float64):
+            return tensor.to(torch.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        return tensor.to(ctx) if ctx is not None else tensor
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor

@@ -1,0 +1,193 @@
+"""Torch collective ops over the eager engine.
+
+Parity with the reference torch binding (horovod/torch/mpi_ops.py): sync /
+async / in-place variants of allreduce, allgather, broadcast, plus
+poll/synchronize on integer handles. The reference dispatches per-dtype C
+symbols into its background engine (mpi_ops_v2.cc:236-339); here torch CPU
+tensors view as numpy arrays (zero copy) and ride the same engine —
+native C++ when built, Python fallback otherwise — that serves every other
+eager framework.
+
+Autograd: HorovodAllreduce/Allgather/Broadcast Functions mirror the
+reference's (mpi_ops.py:110-121, 236-253, 317-333).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+import torch
+
+from ..common import basics
+
+# Keep (tensor, output) alive while an async op is in flight (reference
+# _handle_map, torch/mpi_ops.py:54).
+_handle_map: dict[int, tuple[torch.Tensor, Optional[torch.Tensor]]] = {}
+
+
+def _engine():
+    return basics.engine()
+
+
+def _to_numpy(t: torch.Tensor) -> np.ndarray:
+    if t.device.type != "cpu":
+        raise ValueError(
+            "horovod_tpu.torch operates on CPU tensors (TPU compute belongs "
+            "to the JAX binding); got device " + str(t.device)
+        )
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        return t.detach().view(torch.int16).numpy().view(ml_dtypes.bfloat16)
+    return t.detach().numpy()
+
+
+def _from_numpy(a: np.ndarray) -> torch.Tensor:
+    if a.dtype.name == "bfloat16":
+        return torch.from_numpy(a.view(np.int16).copy()).view(torch.bfloat16)
+    return torch.from_numpy(np.ascontiguousarray(a))
+
+
+def _name(name: Optional[str], op: str, tensor: torch.Tensor) -> Optional[str]:
+    # None lets the engine auto-name by handle (unique per call, consistent
+    # across ranks when op order matches — reference GetOpName semantics).
+    del op, tensor
+    return name
+
+
+# ------------------------------------------------------------------- async API
+
+def allreduce_async(tensor: torch.Tensor, average: bool = True,
+                    name: Optional[str] = None) -> int:
+    h = _engine().enqueue("allreduce", _to_numpy(tensor),
+                          _name(name, "allreduce", tensor), average=average)
+    _handle_map[h] = (tensor, None)
+    return h
+
+
+def allreduce_async_(tensor: torch.Tensor, average: bool = True,
+                     name: Optional[str] = None) -> int:
+    """In-place: the result is written back into ``tensor`` at synchronize."""
+    h = _engine().enqueue("allreduce", _to_numpy(tensor),
+                          _name(name, "allreduce", tensor), average=average)
+    _handle_map[h] = (tensor, tensor)
+    return h
+
+
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None) -> int:
+    h = _engine().enqueue("allgather", _to_numpy(tensor),
+                          _name(name, "allgather", tensor))
+    _handle_map[h] = (tensor, None)
+    return h
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None) -> int:
+    h = _engine().enqueue("broadcast", _to_numpy(tensor),
+                          _name(name, "broadcast", tensor), root_rank=root_rank)
+    _handle_map[h] = (tensor, None)
+    return h
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None) -> int:
+    h = _engine().enqueue("broadcast", _to_numpy(tensor),
+                          _name(name, "broadcast", tensor), root_rank=root_rank)
+    _handle_map[h] = (tensor, tensor)
+    return h
+
+
+def poll(handle: int) -> bool:
+    return _engine().poll(handle)
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    """Wait for an async op; returns the result tensor (the input tensor for
+    in-place variants, reference torch/mpi_ops.py:422-438)."""
+    tensor, inplace_target = _handle_map.pop(handle, (None, None))
+    result = _engine().synchronize(handle)
+    out = _from_numpy(np.asarray(result))
+    if inplace_target is not None:
+        with torch.no_grad():
+            inplace_target.copy_(out.reshape(inplace_target.shape))
+        return inplace_target
+    return out
+
+
+# -------------------------------------------------------------------- sync API
+
+class HorovodAllreduce(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, average, name):
+        ctx.average = average
+        return synchronize(allreduce_async(tensor, average, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        return (synchronize(allreduce_async(grad_output.contiguous(),
+                                            ctx.average, None)), None, None)
+
+
+class HorovodAllgather(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name):
+        dim0 = tensor.shape[0] if tensor.ndim else 1
+        # Ranks may gather different first dims: learn every rank's size so
+        # backward can slice at the right offset (reference
+        # tensorflow/mpi_ops.py:135-160 gathers the sizes the same way).
+        sizes = synchronize(allgather_async(
+            torch.tensor([dim0], dtype=torch.int64), None))
+        r = basics.rank()
+        ctx.offset = int(sizes[:r].sum())
+        ctx.dim0 = dim0
+        return synchronize(allgather_async(tensor, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # grad of allgather = allreduce(sum) then slice out our rows
+        summed = synchronize(allreduce_async(grad_output.contiguous(), False, None))
+        return summed[ctx.offset:ctx.offset + ctx.dim0], None
+
+
+class HorovodBroadcast(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return synchronize(broadcast_async(tensor, root_rank, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad = synchronize(allreduce_async(grad_output.contiguous(), False, None))
+        if basics.rank() != ctx.root_rank:
+            grad = grad * 0
+        return grad, None, None
+
+
+def allreduce(tensor: torch.Tensor, average: bool = True,
+              name: Optional[str] = None, compression: Any = None) -> torch.Tensor:
+    from .compression import Compression
+
+    compression = compression or Compression.none
+    compressed, ctx = compression.compress(tensor)
+    out = HorovodAllreduce.apply(compressed, average, name)
+    return compression.decompress(out, ctx)
+
+
+def allreduce_(tensor: torch.Tensor, average: bool = True,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, average, name))
+
+
+def allgather(tensor: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
+    return HorovodAllgather.apply(tensor, name)
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None) -> torch.Tensor:
+    return HorovodBroadcast.apply(tensor, root_rank, name)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))

@@ -1,0 +1,83 @@
+"""Autotuner tests: native GP sanity and end-to-end parameter-manager
+convergence toward a configuration with higher simulated throughput
+(reference parameter_manager.cc / optim/, SURVEY.md §2.1)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from horovod_tpu.autotune import ParameterManager, gp_fit_predict
+
+
+def test_gp_interpolates_and_is_uncertain_far_away():
+    X = [[0.0], [0.5], [1.0]]
+    y = [0.0, 1.0, 0.0]
+    mu_mid, sigma_mid = gp_fit_predict(X, y, [0.5])
+    assert abs(mu_mid - 1.0) < 0.1          # near-interpolation at a sample
+    assert sigma_mid < 0.3
+    _, sigma_far = gp_fit_predict(X, y, [3.0])
+    assert sigma_far > sigma_mid            # uncertainty grows off-sample
+
+
+def test_gp_predict_matches_numpy_reference():
+    """Cross-check the native Cholesky path against a numpy GP on random data."""
+    rng = np.random.default_rng(0)
+    X = rng.uniform(size=(12, 2))
+    y = np.sin(3 * X[:, 0]) + X[:, 1] ** 2
+    xstar = np.array([0.3, 0.7])
+
+    mu, sigma = gp_fit_predict(X.tolist(), y.tolist(), xstar.tolist())
+
+    # numpy reference with identical kernel/normalization (l=0.3, sf2=1, sn2=1e-4)
+    l2, sf2, sn2 = 0.09, 1.0, 1e-4
+    ym, ys = y.mean(), y.std(ddof=1)
+    yn = (y - ym) / ys
+    d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+    K = sf2 * np.exp(-0.5 * d2 / l2) + sn2 * np.eye(len(X))
+    ks = sf2 * np.exp(-0.5 * ((X - xstar) ** 2).sum(-1) / l2)
+    alpha = np.linalg.solve(K, yn)
+    mu_ref = ks @ alpha * ys + ym
+    v = np.linalg.solve(np.linalg.cholesky(K), ks)
+    sigma_ref = math.sqrt(max(sf2 - v @ v, 1e-12)) * ys
+    assert abs(mu - mu_ref) < 1e-6
+    assert abs(sigma - sigma_ref) < 1e-6
+
+
+def simulated_throughput(threshold: int, cycle_ms: float) -> float:
+    """Synthetic objective: best at large threshold, ~8 ms cycle."""
+    t_mb = threshold / (1 << 20)
+    return (math.log2(t_mb + 1) / 8.0) * math.exp(-((cycle_ms - 8.0) ** 2) / 50.0)
+
+
+def test_parameter_manager_converges_to_better_config():
+    pm = ParameterManager(fusion_threshold=2 << 20, cycle_time_ms=40.0)
+    start_score = simulated_throughput(2 << 20, 40.0)
+    # Feed samples: bytes/seconds chosen so bytes/us == simulated throughput.
+    for _ in range(3000):
+        if not pm.active:
+            break
+        score = simulated_throughput(pm.fusion_threshold, pm.cycle_time_ms)
+        pm.update(int(score * 1e6), 1.0)  # bytes per 1 s -> score bytes/us
+    final_score = simulated_throughput(pm.fusion_threshold, pm.cycle_time_ms)
+    assert not pm.active                    # tuner froze at its best config
+    assert final_score > start_score * 1.5  # materially better than the start
+    pm.close()
+
+
+def test_parameter_manager_respects_pins():
+    pm = ParameterManager(fusion_threshold=8 << 20, cycle_time_ms=5.0,
+                          threshold_pinned=True, cycle_pinned=False)
+    for _ in range(3000):
+        if not pm.active:
+            break
+        pm.update(1000000, 0.01)
+    assert pm.fusion_threshold == 8 << 20   # pinned knob never moved
+    pm.close()
+
+
+def test_fully_pinned_manager_is_inactive():
+    pm = ParameterManager(threshold_pinned=True, cycle_pinned=True)
+    assert not pm.active
+    assert pm.update(100, 0.1) is False
+    pm.close()

@@ -1,0 +1,257 @@
+"""Native C++ engine tests — same semantics matrix as test_engine.py, run
+against libhvd_core.so. Multi-rank worlds are real OS processes talking to
+the rank-0 TCP coordinator (the reference tests the analogous path under
+`mpirun -np 2`, SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.topology import Topology
+
+
+@pytest.fixture(scope="module")
+def native():
+    from horovod_tpu.cc import lib_path
+
+    lib_path()  # build if needed
+    from horovod_tpu.cc.native_engine import NativeEngine
+
+    return NativeEngine
+
+
+def make_engine(NativeEngine):
+    topo = Topology(0, 1, 0, 1, 0, 1)
+    return NativeEngine(topo, Config(cycle_time_ms=1.0))
+
+
+def test_native_single_process_ops(native):
+    eng = make_engine(native)
+    try:
+        a = np.arange(6.0).reshape(2, 3)
+        np.testing.assert_array_equal(eng.run("allreduce", a, "t1"), a)
+        np.testing.assert_array_equal(eng.run("allgather", a, "t2"), a)
+        np.testing.assert_array_equal(eng.run("broadcast", a, "t3"), a)
+        h = eng.enqueue("allreduce", np.ones(4, np.float32), "async")
+        out = eng.synchronize(h, timeout=10)
+        assert out.dtype == np.float32
+        np.testing.assert_array_equal(out, np.ones(4))
+    finally:
+        eng.shutdown()
+
+
+def test_native_dtypes(native):
+    eng = make_engine(native)
+    try:
+        for dt in (np.uint8, np.int8, np.int32, np.int64, np.float16,
+                   np.float32, np.float64):
+            a = np.ones((3,), dtype=dt)
+            out = eng.run("allreduce", a, f"dt.{np.dtype(dt).name}")
+            assert out.dtype == dt
+            np.testing.assert_array_equal(out, a)
+        import ml_dtypes
+
+        a = np.ones((3,), dtype=ml_dtypes.bfloat16)
+        out = eng.run("allreduce", a, "dt.bf16")
+        assert out.dtype == ml_dtypes.bfloat16
+    finally:
+        eng.shutdown()
+
+
+# --------------------------------------------------------- multi-process world
+
+WORLD = 4
+
+RANK_SCRIPT = textwrap.dedent("""
+    import json, os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    from horovod_tpu.cc.native_engine import NativeEngine, TensorShapeMismatchError
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.common.topology import Topology
+
+    rank = int(os.environ["HOROVOD_RANK"])
+    world = int(os.environ["HOROVOD_SIZE"])
+    topo = Topology(rank, world, rank, world, 0, 1)
+    eng = NativeEngine(topo, Config(cycle_time_ms=1.0))
+    out = {}
+
+    # allreduce average
+    a = np.full((3,), float(rank))
+    out["allreduce"] = eng.run("allreduce", a, "g").tolist()
+
+    # variable-dim allgather
+    ag = np.full((rank + 1, 2), float(rank))
+    out["allgather_shape"] = list(eng.run("allgather", ag, "ag").shape)
+
+    # broadcast from root 2
+    bc = np.full((2,), float(rank))
+    out["broadcast"] = eng.run("broadcast", bc, "bc", root_rank=2).tolist()
+
+    # alltoall
+    a2a = np.full((world, 2), float(rank))
+    out["alltoall"] = eng.run("alltoall", a2a, "a2a").tolist()
+
+    # reducescatter (sum)
+    rs = np.arange(world * 2, dtype=np.float64)
+    out["reducescatter"] = eng.run("reducescatter", rs, "rs", average=False).tolist()
+
+    # rank-divergent shape -> error on every rank
+    bad = np.ones((3,) if rank != 1 else (4,))
+    try:
+        eng.run("allreduce", bad, "bad")
+        out["mismatch"] = "no-error"
+    except TensorShapeMismatchError as e:
+        out["mismatch"] = "Mismatched" in str(e)
+    eng.shutdown()
+    print(json.dumps(out))
+""")
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_world(world: int, script: str, extra_env=None):
+    port = free_port()
+    procs = []
+    for rank in range(world):
+        env = dict(os.environ)
+        env.update({
+            "HVD_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(world),
+            "HOROVOD_COORD_ADDR": f"127.0.0.1:{port}",
+        })
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    outs = []
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank failed:\n{stderr[-2000:]}"
+        outs.append(json.loads(stdout.strip().splitlines()[-1]))
+    return outs
+
+
+def test_native_multiprocess_world(native):
+    outs = launch_world(WORLD, RANK_SCRIPT)
+    mean = float(np.mean(np.arange(WORLD)))
+    total_rows = sum(r + 1 for r in range(WORLD))
+    a2a_expect = np.repeat(np.arange(WORLD, dtype=np.float64), 2).reshape(WORLD, 2)
+    for rank, o in enumerate(outs):
+        np.testing.assert_allclose(o["allreduce"], np.full((3,), mean))
+        assert o["allgather_shape"] == [total_rows, 2]
+        np.testing.assert_allclose(o["broadcast"], np.full((2,), 2.0))
+        np.testing.assert_allclose(o["alltoall"], a2a_expect)
+        np.testing.assert_allclose(
+            o["reducescatter"],
+            WORLD * np.arange(WORLD * 2, dtype=np.float64)[rank * 2:(rank + 1) * 2],
+        )
+        assert o["mismatch"] is True
+
+
+def test_native_timeline(native, tmp_path):
+    """Timeline file contains negotiation + op phases (reference
+    test/test_timeline.py:41-58)."""
+    tl = tmp_path / "timeline.json"
+    script = textwrap.dedent(f"""
+        import os, sys
+        import numpy as np
+        sys.path.insert(0, os.environ["HVD_REPO"])
+        from horovod_tpu.cc.native_engine import NativeEngine
+        from horovod_tpu.common.config import Config
+        from horovod_tpu.common.topology import Topology
+
+        eng = NativeEngine(Topology(0, 1, 0, 1, 0, 1),
+                           Config(cycle_time_ms=1.0, timeline={str(tl)!r},
+                                  timeline_mark_cycles=True))
+        eng.run("allreduce", np.ones(4), "tl_tensor")
+        eng.shutdown()
+        print('{{}}')
+    """)
+    env = dict(os.environ)
+    env["HVD_REPO"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    content = tl.read_text()
+    assert "NEGOTIATE_ALLREDUCE" in content
+    assert "tl_tensor" in content
+    assert "CYCLE_START" in content
+
+
+def test_native_duplicate_name_rejected(native):
+    """Second enqueue of a live name must raise (reference duplicate-name
+    test, test_torch.py:356)."""
+    from horovod_tpu.cc.native_engine import HorovodInternalError
+
+    eng = make_engine(native)
+    try:
+        eng._lib  # engine built
+        # stall the loop long enough to have both enqueues in one cycle
+        h1 = eng.enqueue("allreduce", np.ones(4), "dup")
+        with pytest.raises(HorovodInternalError, match="Duplicate tensor name"):
+            eng.enqueue("allreduce", np.ones(4), "dup")
+        eng.synchronize(h1, timeout=10)
+        # after completion the name is reusable
+        h2 = eng.enqueue("allreduce", np.ones(4), "dup")
+        eng.synchronize(h2, timeout=10)
+    finally:
+        eng.shutdown()
+
+
+def test_native_autoname_unique(native):
+    """Unnamed tensors get unique auto-names (no silent collision)."""
+    eng = make_engine(native)
+    try:
+        h1 = eng.enqueue("allreduce", np.full(3, 1.0), None)
+        h2 = eng.enqueue("allreduce", np.full(3, 2.0), None)  # same shape!
+        np.testing.assert_array_equal(eng.synchronize(h1, timeout=10), np.full(3, 1.0))
+        np.testing.assert_array_equal(eng.synchronize(h2, timeout=10), np.full(3, 2.0))
+    finally:
+        eng.shutdown()
+
+
+def test_native_timeout_keeps_handle(native):
+    """A timed-out wait must not consume the handle; the result stays
+    claimable (review finding: stranded-result leak)."""
+    import threading
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.common.topology import Topology
+
+    eng = native(Topology(0, 1, 0, 1, 0, 1), Config(cycle_time_ms=200.0))
+    try:
+        h = eng.enqueue("allreduce", np.arange(4.0), "slowpoke")
+        with pytest.raises(TimeoutError):
+            eng.synchronize(h, timeout=0.01)  # cycle is 200ms: not done yet
+        out = eng.synchronize(h, timeout=10)  # retry wins
+        np.testing.assert_array_equal(out, np.arange(4.0))
+    finally:
+        eng.shutdown()
+
+
+def test_native_scalar_allgather_errors(native):
+    from horovod_tpu.cc.native_engine import HorovodInternalError
+
+    eng = make_engine(native)
+    try:
+        with pytest.raises((HorovodInternalError, Exception), match="rank >= 1"):
+            eng.run("allgather", np.float64(3.0), "scalar_ag")
+    finally:
+        eng.shutdown()

@@ -1,0 +1,95 @@
+"""Sequence-parallel attention correctness: ring and Ulysses schedules must
+match the dense causal oracle on a sequence-sharded virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.ops.ring_attention import (
+    causal_reference,
+    ring_attention,
+    ulysses_attention,
+)
+
+
+def qkv(b=2, t=64, h=8, d=16, seed=0):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (
+        jax.random.normal(k1, (b, t, h, d), jnp.float32),
+        jax.random.normal(k2, (b, t, h, d), jnp.float32),
+        jax.random.normal(k3, (b, t, h, d), jnp.float32),
+    )
+
+
+@pytest.fixture()
+def sp_mesh():
+    return Mesh(np.asarray(jax.devices()), ("sp",))
+
+
+def _run_sharded(fn, mesh, *args):
+    return shard_map(
+        fn, mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
+        check_vma=False,
+    )(*args)
+
+
+def test_ring_attention_matches_oracle(sp_mesh):
+    q, k, v = qkv()
+    with jax.default_matmul_precision("highest"):
+        ref = causal_reference(q, k, v)
+        out = _run_sharded(lambda a, b, c: ring_attention(a, b, c, "sp"), sp_mesh, q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_is_causal(sp_mesh):
+    """Changing future tokens must not change past outputs."""
+    q, k, v = qkv(t=32)
+    k2, v2 = k.at[:, 16:].set(0.0), v.at[:, 16:].set(0.0)
+    with jax.default_matmul_precision("highest"):
+        a = _run_sharded(lambda x, y, z: ring_attention(x, y, z, "sp"), sp_mesh, q, k, v)
+        b = _run_sharded(lambda x, y, z: ring_attention(x, y, z, "sp"), sp_mesh, q, k2, v2)
+    np.testing.assert_allclose(np.asarray(a[:, :16]), np.asarray(b[:, :16]), atol=1e-6)
+    assert not np.allclose(np.asarray(a[:, 16:]), np.asarray(b[:, 16:]))
+
+
+def test_ulysses_matches_oracle(sp_mesh):
+    q, k, v = qkv()
+    with jax.default_matmul_precision("highest"):
+        ref = causal_reference(q, k, v)
+        out = _run_sharded(
+            lambda a, b, c: ulysses_attention(a, b, c, "sp"), sp_mesh, q, k, v
+        )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_rejects_bad_heads(sp_mesh):
+    q, k, v = qkv(h=6)  # 6 % 8 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        _run_sharded(lambda a, b, c: ulysses_attention(a, b, c, "sp"), sp_mesh, q, k, v)
+
+
+def test_transformer_sp_equals_dense(sp_mesh):
+    """Full model: sp-sharded forward with ring attention == single-device
+    forward with dense attention, same params."""
+    from horovod_tpu.models import TransformerLM
+
+    dense = TransformerLM(vocab=64, dim=32, heads=4, layers=2, dtype=jnp.float32)
+    sp = TransformerLM(vocab=64, dim=32, heads=4, layers=2, dtype=jnp.float32,
+                       sp_axis="sp")
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+    params = dense.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    with jax.default_matmul_precision("highest"):
+        ref = dense.apply({"params": params}, tokens)
+
+        def fwd(tokens):
+            t_local = tokens.shape[1]
+            pos = (jax.lax.axis_index("sp") * t_local + jnp.arange(t_local))[None, :]
+            return sp.apply({"params": params}, tokens, pos)
+
+        out = shard_map(fwd, mesh=sp_mesh, in_specs=P(None, "sp"),
+                        out_specs=P(None, "sp"), check_vma=False)(tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)
