@@ -1,0 +1,181 @@
+"""Training-loop callbacks — the Keras-callback capability set
+(reference horovod/_keras/callbacks.py, re-exported under horovod.keras and
+horovod.tensorflow.keras) re-homed for the two loops this framework serves:
+
+- functional helpers + optax schedules for JAX training loops;
+- callback objects with the Keras-style on_train_begin/on_epoch_* protocol
+  for imperative (torch) loops.
+
+Parity map:
+- BroadcastGlobalVariablesCallback (reference _keras/callbacks.py:20-30)
+  -> :class:`BroadcastGlobalVariablesCallback` / hvd.jax.broadcast_parameters
+- MetricAverageCallback (33-67) -> :class:`MetricAverageCallback` /
+  :func:`average_metrics`
+- LearningRateScheduleCallback + LearningRateWarmupCallback (70-168,
+  warmup factor 1/size * (epoch * (size-1)/warmup + 1), momentum correction)
+  -> :class:`LearningRateScheduleCallback`, :class:`LearningRateWarmupCallback`,
+  :func:`warmup_schedule` (optax).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+from .common import basics
+
+
+# ------------------------------------------------------------- JAX/optax side
+
+def warmup_schedule(base_lr: float, warmup_epochs: float, steps_per_epoch: int,
+                    size: Optional[int] = None,
+                    after: Optional[Callable[[int], float]] = None):
+    """optax-compatible schedule implementing the reference's gradual warmup
+    (Goyal et al.; _keras/callbacks.py:145-161): ramp from base_lr to
+    size*base_lr over ``warmup_epochs``, then hand off to ``after`` (a
+    step->multiplier-free schedule) or hold size*base_lr."""
+    n = size if size is not None else basics.size()
+    if warmup_epochs <= 0:
+        # no warmup: constant target (or the post schedule) from step 0
+        def no_warmup(step):
+            return after(step) if after is not None else base_lr * n
+
+        return no_warmup
+    warmup_steps = max(int(warmup_epochs * steps_per_epoch), 1)
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        # reference: lr = base * 1/size * (epoch*(size-1)/warmup + 1), where
+        # base is already scaled by size; with unscaled base_lr this is
+        # base_lr * (1 + epoch*(size-1)/warmup), capped at base_lr*size.
+        epoch = step / steps_per_epoch
+        warm = base_lr * (1.0 + epoch * (n - 1) / warmup_epochs)
+        target = base_lr * n
+        post = after(step - warmup_steps) if after is not None else target
+        return jnp.where(step < warmup_steps,
+                         jnp.minimum(warm, target),
+                         post)
+
+    return schedule
+
+
+def average_metrics(metrics: Dict[str, Any], name_prefix: str = "metric.") -> Dict[str, Any]:
+    """Average a dict of host scalars across ranks via the eager engine
+    (reference MetricAverageCallback semantics at epoch end)."""
+    import numpy as np
+
+    out = {}
+    for key in sorted(metrics.keys()):
+        arr = np.asarray(metrics[key], dtype=np.float64)
+        red = basics.engine().run("allreduce", arr, f"{name_prefix}{key}",
+                                  average=True)
+        out[key] = type(metrics[key])(red) if np.isscalar(metrics[key]) else red
+    return out
+
+
+# ----------------------------------------------------------- imperative side
+
+class Callback:
+    """Keras-protocol callback base: the reference wires these into
+    keras.callbacks.Callback; here any loop can drive them."""
+
+    def on_train_begin(self, logs: Optional[dict] = None) -> None: ...
+
+    def on_epoch_begin(self, epoch: int, logs: Optional[dict] = None) -> None: ...
+
+    def on_epoch_end(self, epoch: int, logs: Optional[dict] = None) -> None: ...
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast model (and optimizer) state from root at train begin
+    (reference _keras/callbacks.py:20-30) — the checkpoint-resume consistency
+    contract (SURVEY.md §5.4)."""
+
+    def __init__(self, model, root_rank: int = 0, optimizer=None) -> None:
+        self.model = model
+        self.optimizer = optimizer
+        self.root_rank = root_rank
+
+    def on_train_begin(self, logs: Optional[dict] = None) -> None:
+        from . import torch as hvd_torch
+
+        self.model and hvd_torch.broadcast_parameters(
+            self.model.state_dict(), root_rank=self.root_rank)
+        if self.optimizer is not None:
+            hvd_torch.broadcast_optimizer_state(self.optimizer, self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Replace epoch-end metrics with their cross-rank average in place
+    (reference _keras/callbacks.py:33-67)."""
+
+    def on_epoch_end(self, epoch: int, logs: Optional[dict] = None) -> None:
+        if logs:
+            logs.update(average_metrics(logs, name_prefix=f"ep{epoch}.metric."))
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the optimizer lr by ``multiplier(epoch)`` within
+    [start_epoch, end_epoch) (reference _keras/callbacks.py:70-127).
+    ``staircase`` applies at epoch granularity (the default here)."""
+
+    def __init__(self, optimizer, multiplier: Callable[[float], float],
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 momentum_correction: bool = True) -> None:
+        self.optimizer = optimizer
+        self.multiplier = multiplier
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.momentum_correction = momentum_correction
+        self._base_lrs = [g["lr"] for g in optimizer.param_groups]
+        self._restore_momentum = None
+
+    def _adjust(self, epoch: float) -> None:
+        mult = self.multiplier(epoch)
+        old_lrs = [g["lr"] for g in self.optimizer.param_groups]
+        for group, base in zip(self.optimizer.param_groups, self._base_lrs):
+            group["lr"] = base * mult
+        # Momentum correction (reference _keras/callbacks.py:106-118): scale
+        # the momentum buffer by new_lr/old_lr so the effective update stays
+        # smooth across lr changes.
+        if self.momentum_correction:
+            for group, old in zip(self.optimizer.param_groups, old_lrs):
+                if "momentum" not in group or old == 0:
+                    continue
+                scale = group["lr"] / old
+                for p in group["params"]:
+                    state = self.optimizer.state.get(p)
+                    if state and "momentum_buffer" in state:
+                        state["momentum_buffer"].mul_(scale)
+
+    def on_epoch_begin(self, epoch: int, logs: Optional[dict] = None) -> None:
+        if epoch < self.start_epoch:
+            return
+        if self.end_epoch is not None and epoch >= self.end_epoch:
+            return
+        self._adjust(float(epoch))
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from lr to lr*size over ``warmup_epochs`` (reference
+    _keras/callbacks.py:131-168, Goyal et al. 2017)."""
+
+    def __init__(self, optimizer, warmup_epochs: float = 5, verbose: bool = False,
+                 size: Optional[int] = None, momentum_correction: bool = True) -> None:
+        self.size = size if size is not None else basics.size()
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+        def multiplier(epoch: float) -> float:
+            if epoch >= warmup_epochs:
+                return float(self.size)
+            return 1.0 + epoch * (self.size - 1) / warmup_epochs
+
+        super().__init__(optimizer, multiplier, start_epoch=0,
+                         end_epoch=None, momentum_correction=momentum_correction)
+
+    def on_epoch_end(self, epoch: int, logs: Optional[dict] = None) -> None:
+        if self.verbose and epoch < self.warmup_epochs and basics.rank() == 0:
+            lr = self.optimizer.param_groups[0]["lr"]
+            print(f"Epoch {epoch + 1}: warmup lr -> {lr:.6f}")

@@ -1,0 +1,101 @@
+"""Launcher — the horovodrun/`horovod.spark.run` capability for TPU pods.
+
+Two entry points:
+
+- :func:`run(fn, args=..., num_proc=N)` — programmatic launch (the
+  `horovod.spark.run()` analog, reference spark/__init__.py:80-196): starts a
+  driver service, spawns ``num_proc`` local worker processes (on a pod, one
+  per host via your scheduler with ``HOROVOD_DRIVER_ADDRS`` exported), ships
+  the pickled ``fn`` to each, returns results ordered by rank.
+- CLI ``python -m horovod_tpu.runner -np N -- python train.py`` — script
+  launch (the mpirun/horovodrun analog): each worker registers, learns its
+  rank/topology via env, then executes the command.
+
+No MPI, no ssh: the control plane is the HMAC-authenticated TCP service pair
+from the reference's Spark layer (SURVEY.md §2.6), which was already the
+in-repo blueprint for cluster launch without mpirun.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Callable, Optional, Sequence
+
+from .network import make_secret
+from .service import DriverService, TaskAgent, host_hash  # noqa: F401
+
+
+def _spawn_worker(index: int, driver_addrs, secret: bytes, argv: Sequence[str],
+                  extra_env: Optional[dict] = None) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["HOROVOD_DRIVER_ADDRS"] = json.dumps([list(a) for a in driver_addrs])
+    env["HOROVOD_SECRET"] = secret.hex()
+    env["HOROVOD_TASK_INDEX"] = str(index)
+    env.update(extra_env or {})
+    return subprocess.Popen(list(argv), env=env)
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        num_proc: Optional[int] = None, env: Optional[dict] = None,
+        timeout: float = 600.0) -> list:
+    """Run ``fn`` on ``num_proc`` processes; returns [result_rank0, ...]
+    (reference horovod.spark.run returns per-rank results ordered by rank,
+    spark/__init__.py:195-196)."""
+    num_proc = num_proc or os.cpu_count() or 1
+    if num_proc < 1:
+        raise ValueError(f"num_proc must be >= 1, got {num_proc}")
+    secret = make_secret()
+    driver = DriverService(num_proc, secret, fn=fn, args=args, kwargs=kwargs)
+    procs = []
+    try:
+        for index in range(num_proc):
+            procs.append(_spawn_worker(
+                index, driver.addresses(), secret,
+                [sys.executable, "-m", "horovod_tpu.runner.task_main"], env))
+
+        def liveness():
+            for i, p in enumerate(procs):
+                rc = p.poll()
+                if rc not in (None, 0):
+                    return f"worker {i} exited with code {rc} before reporting a result"
+            return None
+
+        results = driver.wait_results(timeout=timeout, liveness=liveness)
+        for p in procs:
+            p.wait(timeout=30)
+        return [results[r] for r in sorted(results)]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        driver.stop()
+
+
+def run_command(command: Sequence[str], num_proc: int,
+                env: Optional[dict] = None, timeout: Optional[float] = None) -> int:
+    """Launch ``command`` on ``num_proc`` worker processes (CLI path).
+    Returns the max exit code."""
+    if num_proc < 1:
+        raise ValueError(f"num_proc must be >= 1, got {num_proc}")
+    secret = make_secret()
+    driver = DriverService(num_proc, secret, fn=None)
+    procs = []
+    try:
+        for index in range(num_proc):
+            procs.append(_spawn_worker(
+                index, driver.addresses(), secret,
+                [sys.executable, "-m", "horovod_tpu.runner.task_exec"] + list(command),
+                env))
+        rc = 0
+        for p in procs:
+            p.wait(timeout=timeout)
+            rc = max(rc, p.returncode or 0)
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        driver.stop()

@@ -1,0 +1,40 @@
+"""CLI: python -m horovod_tpu.runner -np N [--env K=V ...] -- command ...
+
+The horovodrun analog (the reference at this version has no CLI — launch was
+raw mpirun, docs/running.md:22-43; this closes that gap TPU-side)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.runner",
+        description="Launch a command on N horovod_tpu worker processes.",
+    )
+    parser.add_argument("-np", "--num-proc", type=int, required=True,
+                        help="number of worker processes")
+    parser.add_argument("--env", action="append", default=[],
+                        metavar="K=V", help="extra env var for workers")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to run (prefix with --)")
+    args = parser.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given; usage: -np 4 -- python train.py")
+    extra_env = {}
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        extra_env[k] = v
+
+    from . import run_command
+
+    return run_command(command, num_proc=args.num_proc, env=extra_env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,152 @@
+"""Authenticated TCP service layer for the cluster launcher.
+
+Design taken from the reference's Spark network layer
+(horovod/spark/util/network.py:44-117): wire format is
+HMAC-SHA256(digest) + length + pickled body, services bind a random port,
+clients verify the digest with a shared secret before unpickling (never
+unpickle unauthenticated bytes). Used by the driver/task services in
+service.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import pickle
+import secrets as _secrets
+import socket
+import struct
+import threading
+from typing import Any, Callable, Optional
+
+
+def make_secret() -> bytes:
+    """Random shared secret (reference horovod/spark/secret.py)."""
+    return _secrets.token_bytes(32)
+
+
+def _digest(key: bytes, payload: bytes) -> bytes:
+    return hmac.new(key, payload, hashlib.sha256).digest()
+
+
+def send_obj(sock: socket.socket, key: bytes, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_digest(key, payload) + struct.pack("!Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+# Unauthenticated bytes are buffered before the digest check; cap the claimed
+# length so a secretless peer can't force unbounded allocation.
+MAX_PAYLOAD = 256 * 1024 * 1024
+
+
+def recv_obj(sock: socket.socket, key: bytes) -> Any:
+    digest = _recv_exact(sock, 32)
+    (n,) = struct.unpack("!Q", _recv_exact(sock, 8))
+    if n > MAX_PAYLOAD:
+        raise PermissionError(f"payload length {n} exceeds cap {MAX_PAYLOAD}")
+    payload = _recv_exact(sock, n)
+    if not hmac.compare_digest(digest, _digest(key, payload)):
+        raise PermissionError("HMAC digest mismatch: unauthenticated peer")
+    return pickle.loads(payload)
+
+
+class BasicService:
+    """Threaded request/response TCP server (reference BasicService,
+    network.py:79-143). Subclasses implement handle(request) -> response."""
+
+    def __init__(self, key: bytes, host: str = "0.0.0.0") -> None:
+        self.key = key
+        self.server = socket.create_server((host, 0))
+        self.port = self.server.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def addresses(self) -> list[tuple[str, int]]:
+        """All reachable (ip, port) pairs for this service (reference probes
+        every NIC, network.py:145-169)."""
+        addrs = []
+        hostname = socket.gethostname()
+        try:
+            for info in socket.getaddrinfo(hostname, None, socket.AF_INET):
+                addrs.append((info[4][0], self.port))
+        except socket.gaierror:
+            pass
+        addrs.append(("127.0.0.1", self.port))
+        # dedupe, keep order
+        seen = set()
+        out = []
+        for a in addrs:
+            if a not in seen:
+                seen.add(a)
+                out.append(a)
+        return out
+
+    def handle(self, request: Any, client_addr) -> Any:  # pragma: no cover
+        raise NotImplementedError
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self.server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn, addr), daemon=True).start()
+
+    def _serve(self, conn: socket.socket, addr) -> None:
+        try:
+            while not self._stop.is_set():
+                req = recv_obj(conn, self.key)
+                resp = self.handle(req, addr)
+                send_obj(conn, self.key, resp)
+        except (ConnectionError, OSError, EOFError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.server.close()
+        except OSError:
+            pass
+
+
+class BasicClient:
+    """Blocking request/response client with retry-capable connect."""
+
+    def __init__(self, addresses, key: bytes, timeout: float = 60.0) -> None:
+        self.key = key
+        last: Optional[Exception] = None
+        for host, port in addresses:
+            try:
+                self.sock = socket.create_connection((host, port), timeout=timeout)
+                self.sock.settimeout(timeout)
+                return
+            except OSError as e:
+                last = e
+        raise ConnectionError(f"cannot reach service at {addresses}: {last}")
+
+    def request(self, obj: Any) -> Any:
+        send_obj(self.sock, self.key, obj)
+        return recv_obj(self.sock, self.key)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

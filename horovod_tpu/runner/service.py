@@ -1,0 +1,243 @@
+"""Driver/task services for cluster launch.
+
+The reference's Spark launcher (SURVEY.md §2.6, §3.4) is a driver TCP service
+that collects task registrations, assigns ranks by host, and ships a pickled
+function to each task; task services run the command and report results
+(horovod/spark/driver/driver_service.py, horovod/spark/task/task_service.py).
+Here the same protocol launches TPU-pod training without Spark or mpirun:
+one task agent per host registers with the driver; the driver assigns
+ranks (barrel-shift so rank 0 lands on the first host, reference
+spark/__init__.py:143-152), distributes the coordinator address, and
+collects per-rank results.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from .network import BasicClient, BasicService
+
+
+class DriverService(BasicService):
+    """Rank-assignment + function-distribution service (reference
+    driver_service.py:98-234)."""
+
+    def __init__(self, num_proc: int, key: bytes, fn: Optional[Callable] = None,
+                 args: tuple = (), kwargs: Optional[dict] = None) -> None:
+        super().__init__(key)
+        self.num_proc = num_proc
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs or {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._registrations: dict[int, dict] = {}   # index -> {host_hash, addresses}
+        self._ranks: Optional[dict[int, int]] = None  # index -> rank
+        self._results: dict[int, Any] = {}
+        self.coord_addr: Optional[str] = None
+
+    # -- protocol
+
+    def handle(self, req: Any, client_addr) -> Any:
+        kind = req.get("kind")
+        if kind == "register":
+            with self._cv:
+                self._registrations[req["index"]] = {
+                    "host_hash": req["host_hash"],
+                    "addresses": req["addresses"],
+                    "coord_port": req.get("coord_port", 0),
+                }
+                if len(self._registrations) == self.num_proc:
+                    self._assign_ranks()
+                self._cv.notify_all()
+            return {"ok": True}
+        if kind == "wait_assignment":
+            with self._cv:
+                deadline = time.monotonic() + req.get("timeout", 120.0)
+                while self._ranks is None and time.monotonic() < deadline:
+                    self._cv.wait(0.5)
+                if self._ranks is None:
+                    return {"ok": False, "error": "timed out waiting for all tasks"}
+                rank = self._ranks[req["index"]]
+                topo = self._topology(req["index"], rank)
+                return {"ok": True, "rank": rank, "topology": topo,
+                        "coord_addr": self.coord_addr}
+        if kind == "get_fn":
+            # Function shipping by value (reference CodeRequest +
+            # horovod/spark/codec.py, which also uses cloudpickle).
+            try:
+                import cloudpickle as _pickler
+            except ImportError:  # pragma: no cover
+                import pickle as _pickler
+
+            return {"ok": True,
+                    "fn": _pickler.dumps((self.fn, self.args, self.kwargs))}
+        if kind == "result":
+            with self._cv:
+                self._results[req["rank"]] = req["value"]
+                self._cv.notify_all()
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown request {kind}"}
+
+    # -- rank assignment (reference spark/__init__.py:143-152)
+
+    def _assign_ranks(self) -> None:
+        by_host: dict[str, list[int]] = {}
+        for index in sorted(self._registrations):
+            by_host.setdefault(self._registrations[index]["host_hash"], []).append(index)
+        # barrel shift: hosts ordered by hash, rank 0 on the first host
+        ranks: dict[int, int] = {}
+        rank = 0
+        for host in sorted(by_host):
+            for index in by_host[host]:
+                ranks[index] = rank
+                rank += 1
+        self._ranks = ranks
+        # Coordinator = rank-0's host on the port that task probed free
+        # locally. Prefer a non-loopback address when the job spans hosts
+        # (127.x from /etc/hosts would be unreachable from other machines).
+        rank0_index = next(i for i, r in ranks.items() if r == 0)
+        reg = self._registrations[rank0_index]
+        addrs = [a for a, _ in reg["addresses"]]
+        multi_host = len(by_host) > 1
+        host = next((a for a in addrs if not a.startswith("127.")), addrs[0]) \
+            if multi_host else next((a for a in addrs if a.startswith("127.")), addrs[0])
+        port = reg["coord_port"] or _free_port()
+        self.coord_addr = f"{host}:{port}"
+
+    def _topology(self, index: int, rank: int) -> dict:
+        host = self._registrations[index]["host_hash"]
+        local = [i for i in sorted(self._registrations)
+                 if self._registrations[i]["host_hash"] == host]
+        hosts = sorted({r["host_hash"] for r in self._registrations.values()})
+        return {
+            "rank": rank,
+            "size": self.num_proc,
+            "local_rank": local.index(index),
+            "local_size": len(local),
+            "cross_rank": hosts.index(host),
+            "cross_size": len(hosts),
+        }
+
+    # -- driver-side helpers
+
+    def wait_results(self, timeout: float = 600.0,
+                     liveness: Optional[Callable[[], Optional[str]]] = None
+                     ) -> dict[int, Any]:
+        """Collect one result per rank. ``liveness`` (if given) is polled each
+        tick and may return an error string to abort early (dead worker)."""
+        with self._cv:
+            deadline = time.monotonic() + timeout
+            while len(self._results) < self.num_proc:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"only {len(self._results)}/{self.num_proc} results arrived")
+                if liveness is not None:
+                    dead = liveness()
+                    if dead:
+                        raise RuntimeError(dead)
+                self._cv.wait(0.5)
+            results = dict(self._results)
+        failures = {r: v["error"] for r, v in results.items()
+                    if isinstance(v, dict) and not v.get("ok", True)}
+        if failures:
+            rank, tb = sorted(failures.items())[0]
+            raise RuntimeError(
+                f"task on rank {rank} failed (and {len(failures) - 1} more):\n{tb}")
+        return {r: (v["value"] if isinstance(v, dict) and "value" in v else v)
+                for r, v in results.items()}
+
+
+def host_hash() -> str:
+    """Host identity for rank grouping (reference horovod/spark/host_hash.py:
+    hostname + container namespace so two containers on one VM differ)."""
+    uniq = os.environ.get("HOROVOD_HOSTNAME") or socket.gethostname()
+    cgroup = ""
+    try:
+        with open("/proc/self/cgroup") as f:
+            cgroup = f.read()[:64]
+    except OSError:
+        pass
+    import hashlib
+
+    return hashlib.sha1((uniq + cgroup).encode()).hexdigest()[:16]
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TaskAgent:
+    """Per-process agent: register with the driver, learn rank/topology,
+    fetch and run the function, report the result (reference
+    mpirun_exec_fn.py:34-48 without the mpirun/orted hop)."""
+
+    def __init__(self, index: int, driver_addresses, key: bytes) -> None:
+        self.index = index
+        # Socket timeout > the driver's 120 s wait_assignment window, so a
+        # slow straggler elsewhere doesn't kill punctual workers.
+        self.client = BasicClient(driver_addresses, key, timeout=180.0)
+
+    @staticmethod
+    def _my_addresses() -> list[tuple[str, int]]:
+        addrs: list[tuple[str, int]] = []
+        try:
+            for info in socket.getaddrinfo(socket.gethostname(), None, socket.AF_INET):
+                addrs.append((info[4][0], 0))
+        except socket.gaierror:
+            pass
+        addrs.append(("127.0.0.1", 0))
+        seen: set = set()
+        return [a for a in addrs if not (a in seen or seen.add(a))]
+
+    def register(self) -> dict:
+        self.client.request({
+            "kind": "register",
+            "index": self.index,
+            "host_hash": host_hash(),
+            "addresses": self._my_addresses(),
+            # Port probed free on THIS host: if this task becomes rank 0 the
+            # driver advertises host:port as the coordinator address (the
+            # driver's own host can't probe ports for another machine).
+            "coord_port": _free_port(),
+        })
+        assignment = self.client.request({"kind": "wait_assignment",
+                                          "index": self.index})
+        if not assignment["ok"]:
+            raise RuntimeError(assignment["error"])
+        topo = assignment["topology"]
+        os.environ["HOROVOD_RANK"] = str(topo["rank"])
+        os.environ["HOROVOD_SIZE"] = str(topo["size"])
+        os.environ["HOROVOD_LOCAL_RANK"] = str(topo["local_rank"])
+        os.environ["HOROVOD_LOCAL_SIZE"] = str(topo["local_size"])
+        os.environ["HOROVOD_CROSS_RANK"] = str(topo["cross_rank"])
+        os.environ["HOROVOD_CROSS_SIZE"] = str(topo["cross_size"])
+        os.environ["HOROVOD_COORD_ADDR"] = assignment["coord_addr"]
+        return assignment
+
+    def run(self) -> Any:
+        self.register()  # registers, waits for assignment, exports HOROVOD_* env
+        import pickle
+        import traceback
+
+        fn_resp = self.client.request({"kind": "get_fn"})
+        fn, args, kwargs = pickle.loads(fn_resp["fn"])
+        try:
+            value = fn(*args, **kwargs) if fn is not None else None
+            payload = {"ok": True, "value": value}
+        except BaseException:
+            payload = {"ok": False, "error": traceback.format_exc()}
+        self.client.request({"kind": "result",
+                             "rank": int(os.environ["HOROVOD_RANK"]),
+                             "value": payload})
+        if not payload["ok"]:
+            raise RuntimeError("task function failed")
+        return payload["value"]

@@ -1,0 +1,30 @@
+"""Worker bootstrap for the CLI launch path: register, export HOROVOD_* env,
+then exec the user command in-place (the orted->python hop of the reference,
+without orted)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    from .service import TaskAgent
+
+    index = int(os.environ["HOROVOD_TASK_INDEX"])
+    addrs = [tuple(a) for a in json.loads(os.environ["HOROVOD_DRIVER_ADDRS"])]
+    secret = bytes.fromhex(os.environ["HOROVOD_SECRET"])
+    agent = TaskAgent(index, addrs, secret)
+    agent.register()  # exports HOROVOD_RANK/.../HOROVOD_COORD_ADDR
+    agent.client.close()
+    cmd = sys.argv[1:]
+    if not cmd:
+        print("task_exec: no command given", file=sys.stderr)
+        return 2
+    os.execvp(cmd[0], cmd)
+    return 0  # unreachable
+
+
+if __name__ == "__main__":
+    sys.exit(main())

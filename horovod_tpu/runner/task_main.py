@@ -1,0 +1,40 @@
+"""Worker bootstrap for the programmatic launch path (reference
+mpirun_exec_fn.py): register with the driver, run the shipped fn, report."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+
+def _watch_parent() -> None:
+    """Exit if the parent (driver) dies (reference parent-death watchdog,
+    mpirun_exec_fn.py:26-31)."""
+    ppid = os.getppid()
+
+    def loop():
+        import time
+
+        while True:
+            if os.getppid() != ppid:
+                os._exit(1)
+            time.sleep(1.0)
+
+    threading.Thread(target=loop, daemon=True).start()
+
+
+def main() -> int:
+    from .service import TaskAgent
+
+    _watch_parent()
+    index = int(os.environ["HOROVOD_TASK_INDEX"])
+    addrs = [tuple(a) for a in json.loads(os.environ["HOROVOD_DRIVER_ADDRS"])]
+    secret = bytes.fromhex(os.environ["HOROVOD_SECRET"])
+    TaskAgent(index, addrs, secret).run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+"""Build hooks for the native core.
+
+The reference's 963-line setup.py (SURVEY.md §2.7) compiles the whole C++
+core into each framework's extension, probing mpicxx/CUDA/NCCL. None of that
+applies on TPU hosts: there is one shared library (no MPI/CUDA probes), built
+by horovod_tpu/cc/Makefile either here at install time or lazily on first
+use (horovod_tpu/cc/__init__.py). Metadata lives in pyproject.toml.
+"""
+
+import subprocess
+import os
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        cc_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "horovod_tpu", "cc")
+        try:
+            subprocess.run(["make", "-C", cc_dir], check=True)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            # Lazy build at import remains available on the target host.
+            print(f"warning: native core not prebuilt ({e}); "
+                  "it will build on first use")
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNative})

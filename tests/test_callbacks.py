@@ -1,0 +1,75 @@
+"""Callback tests (reference _keras/callbacks.py behaviors: metric averaging,
+LR warmup factor, momentum correction, broadcast-at-train-begin)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd_core
+from horovod_tpu.callbacks import (
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+    average_metrics,
+    warmup_schedule,
+)
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture()
+def hvd(hvd=None):
+    hvd_core.init()
+    yield hvd_core
+    hvd_core.shutdown()
+
+
+def test_average_metrics_single(hvd):
+    out = average_metrics({"loss": 2.0, "acc": 0.5})
+    assert out["loss"] == pytest.approx(2.0)
+    assert out["acc"] == pytest.approx(0.5)
+
+
+def test_metric_average_callback_updates_logs(hvd):
+    cb = MetricAverageCallback()
+    logs = {"loss": 1.25}
+    cb.on_epoch_end(0, logs)
+    assert logs["loss"] == pytest.approx(1.25)
+
+
+def test_warmup_callback_ramps_lr(hvd):
+    model = torch.nn.Linear(2, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    cb = LearningRateWarmupCallback(opt, warmup_epochs=4, size=8)
+    lrs = []
+    for epoch in range(6):
+        cb.on_epoch_begin(epoch)
+        lrs.append(opt.param_groups[0]["lr"])
+    # reference factor: 1 + epoch*(size-1)/warmup, capped at size
+    assert lrs[0] == pytest.approx(0.1)
+    assert lrs[1] == pytest.approx(0.1 * (1 + 7 / 4))
+    assert lrs[4] == pytest.approx(0.8)   # ramp complete: lr * size
+    assert lrs[5] == pytest.approx(0.8)
+
+
+def test_momentum_correction_scales_buffer(hvd):
+    model = torch.nn.Linear(2, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    loss = model(torch.randn(4, 2)).sum()
+    loss.backward()
+    opt.step()  # creates momentum buffers
+    buf_before = opt.state[model.weight]["momentum_buffer"].clone()
+    cb = LearningRateScheduleCallback(opt, multiplier=lambda e: 2.0)
+    cb.on_epoch_begin(0)
+    buf_after = opt.state[model.weight]["momentum_buffer"]
+    assert torch.allclose(buf_after, buf_before * 2.0)
+
+
+def test_warmup_schedule_optax(hvd):
+    sched = warmup_schedule(base_lr=0.1, warmup_epochs=2, steps_per_epoch=10, size=4)
+    assert float(sched(0)) == pytest.approx(0.1)
+    # end of warmup: base_lr * size
+    assert float(sched(20)) == pytest.approx(0.4)
+    mid = float(sched(10))
+    assert 0.1 < mid < 0.4

@@ -1,0 +1,39 @@
+"""Example scripts run end-to-end under the launcher — the reference CI runs
+its MNIST examples as integration tests (.travis.yml:116-140, shrunk via sed;
+here the examples take small shapes natively)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_example(cmd, timeout=300, env_extra=None):
+    env = dict(os.environ)
+    # Append (never replace) PYTHONPATH: the image's sitecustomize path on it
+    # registers the TPU plugin; clobbering it breaks jax in subprocesses.
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout,
+                          cwd=REPO, env=env)
+    assert proc.returncode == 0, f"stderr:\n{proc.stderr[-3000:]}"
+    return proc.stdout
+
+
+def test_pytorch_mnist_example_2proc():
+    out = run_example([
+        sys.executable, "-m", "horovod_tpu.runner", "-np", "2", "--",
+        sys.executable, "examples/pytorch_mnist.py",
+    ])
+    assert "epoch 3" in out
+    assert "averaged over 2 ranks" in out
+
+
+def test_jax_mnist_example_single():
+    out = run_example([sys.executable, "examples/jax_mnist.py"])
+    assert "epoch 2" in out

@@ -1,0 +1,159 @@
+"""Launcher tests — the reference tests `horovod.spark.run` end-to-end on a
+local cluster (test/test_spark.py:51 test_happy_run asserts allgather
+results); same shape here without Spark."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.runner.network import (
+    BasicClient,
+    BasicService,
+    make_secret,
+    recv_obj,
+    send_obj,
+)
+
+
+def test_run_happy_path():
+    """4-process programmatic launch: ranks assigned, collective correct,
+    results ordered by rank (reference test_happy_run)."""
+    from horovod_tpu.runner import run
+
+    # Defined inside the test so cloudpickle ships it by value (module-level
+    # functions in test modules aren't importable from worker processes).
+    def train_fn(scale):
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        hvd.init()
+        out = hvd.allreduce(np.full((2,), float(hvd.rank()) * scale), average=True)
+        result = (hvd.rank(), hvd.size(), out.tolist())
+        hvd.shutdown()
+        return result
+
+    results = run(train_fn, args=(2.0,), num_proc=4, timeout=120)
+    assert len(results) == 4
+    mean = sum(r * 2.0 for r in range(4)) / 4
+    for rank, (r, size, reduced) in enumerate(results):
+        assert r == rank
+        assert size == 4
+        assert reduced == [mean, mean]
+
+
+def test_run_command_cli():
+    """CLI path: each worker gets rank env and runs the command."""
+    from horovod_tpu.runner import run_command
+
+    script = (
+        "import os, sys; sys.path.insert(0, os.environ['HVD_REPO']);\n"
+        "import numpy as np, horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "out = hvd.allreduce(np.ones(2) * hvd.rank())\n"
+        "assert out.tolist() == [0.5, 0.5], out\n"
+        "hvd.shutdown()\n"
+    )
+    rc = run_command(
+        [sys.executable, "-c", script], num_proc=2,
+        env={"HVD_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__)))},
+    )
+    assert rc == 0
+
+
+def test_hmac_rejects_wrong_secret():
+    """Unauthenticated peers are rejected before unpickling (reference
+    spark/util/network.py digest check)."""
+
+    class Echo(BasicService):
+        def handle(self, request, client_addr):
+            return request
+
+    svc = Echo(make_secret())
+    try:
+        import socket as s
+
+        conn = s.create_connection(("127.0.0.1", svc.port), timeout=10)
+        send_obj(conn, make_secret(), {"evil": True})  # wrong key
+        with pytest.raises((ConnectionError, OSError)):
+            recv_obj(conn, make_secret())  # server dropped us
+    finally:
+        svc.stop()
+
+
+def test_hmac_happy_roundtrip():
+    class Echo(BasicService):
+        def handle(self, request, client_addr):
+            return {"echo": request}
+
+    key = make_secret()
+    svc = Echo(key)
+    try:
+        client = BasicClient([("127.0.0.1", svc.port)], key)
+        assert client.request({"x": 1}) == {"echo": {"x": 1}}
+        client.close()
+    finally:
+        svc.stop()
+
+
+def test_cli_requires_command():
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode != 0
+    assert "no command given" in proc.stderr
+
+
+def test_run_surfaces_worker_exception():
+    """A failing rank must surface its traceback quickly, not a bare
+    10-minute TimeoutError (reference spark timeout test, test_spark.py:71)."""
+    from horovod_tpu.runner import run
+
+    def failing_fn():
+        import horovod_tpu as hvd
+
+        hvd.init()
+        if hvd.rank() == 1:
+            raise ValueError("intentional rank-1 explosion")
+        hvd.shutdown()
+        return "ok"
+
+    with pytest.raises(RuntimeError, match="intentional rank-1 explosion"):
+        run(failing_fn, num_proc=2, timeout=120)
+
+
+def test_run_rejects_bad_num_proc():
+    from horovod_tpu.runner import run_command
+
+    with pytest.raises(ValueError, match="num_proc"):
+        run_command(["echo", "hi"], num_proc=0)
+
+
+def test_payload_cap():
+    """Oversized claimed lengths are rejected before allocation."""
+    import socket as s
+    import struct
+
+    from horovod_tpu.runner.network import BasicService, make_secret
+
+    class Echo(BasicService):
+        def handle(self, request, client_addr):
+            return request
+
+    svc = Echo(make_secret())
+    try:
+        conn = s.create_connection(("127.0.0.1", svc.port), timeout=10)
+        conn.sendall(b"\0" * 32 + struct.pack("!Q", 1 << 40))  # 1 TiB claim
+        conn.settimeout(5)
+        with pytest.raises((ConnectionError, ConnectionResetError, OSError, TimeoutError)):
+            data = conn.recv(1)
+            if not data:
+                raise ConnectionError("server closed on oversized claim")
+    finally:
+        svc.stop()
