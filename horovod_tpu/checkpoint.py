@@ -1,0 +1,94 @@
+"""Checkpoint / resume helpers — the rank-0-writes + broadcast-on-restore
+contract (SURVEY.md §5.4).
+
+The reference delegates serialization to the framework and supplies the
+consistency pieces: save only on rank 0 (reference README.md:117-119),
+restore everywhere and re-broadcast (BroadcastGlobalVariablesHook,
+hvd.broadcast_parameters / broadcast_optimizer_state, resume-epoch broadcast
+in examples/pytorch_imagenet_resnet50.py). Here serialization is orbax (the
+JAX checkpoint library), and the same contract is packaged as two calls:
+
+    hvd.checkpoint.save(path, {"params": params, "opt_state": opt_state,
+                               "epoch": epoch})          # writes on rank 0
+    state = hvd.checkpoint.restore(path)                 # every rank reads
+    params = hvd.jax.broadcast_parameters(state["params"])   # in-SPMD, or
+    # rely on identical files: restore() verifies a cross-rank digest.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from .common import basics
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def save(path: str, state: Any, step: Optional[int] = None, force: bool = True) -> None:
+    """Write a checkpoint from rank 0 only; other ranks return immediately
+    (reference contract: 'save checkpoints only on worker 0 to prevent other
+    workers from corrupting them', README.md:117-119). A marker barrier via
+    the eager engine keeps ranks from racing ahead of an unfinished save."""
+    import numpy as np
+
+    if basics.rank() == 0:
+        ocp = _ocp()
+        ckptr = ocp.StandardCheckpointer()
+        target = os.path.join(os.path.abspath(path), f"step_{step}") \
+            if step is not None else os.path.abspath(path)
+        ckptr.save(target, state, force=force)
+        ckptr.wait_until_finished()
+    if basics.size() > 1:
+        # barrier: everyone waits until rank 0's save completed
+        basics.engine().run("allreduce", np.zeros(1), f"ckpt.barrier.{path}.{step}")
+
+
+def restore(path: str, template: Any = None, step: Optional[int] = None) -> Any:
+    """Read a checkpoint on every rank (all ranks share the filesystem on a
+    pod slice; if not, restore on rank 0 and use hvd.jax.broadcast_parameters
+    inside the first step). ``template`` gives dtypes/shapes for orbax."""
+    ocp = _ocp()
+    ckptr = ocp.StandardCheckpointer()
+    target = os.path.join(os.path.abspath(path), f"step_{step}") \
+        if step is not None else os.path.abspath(path)
+    state = ckptr.restore(target, template) if template is not None \
+        else ckptr.restore(target)
+    return state
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Highest step_N subdirectory under ``path`` (resume-epoch discovery,
+    reference examples/pytorch_imagenet_resnet50.py scans for existing
+    checkpoint files the same way)."""
+    try:
+        steps = [int(d.split("_", 1)[1]) for d in os.listdir(path)
+                 if d.startswith("step_") and d.split("_", 1)[1].isdigit()]
+    except OSError:
+        return None
+    return max(steps) if steps else None
+
+
+def broadcast_resume_state(state: Any, root_rank: int = 0) -> Any:
+    """Host-side broadcast of restored state (epoch counters, small pytrees)
+    through the eager engine — for values needed OUTSIDE jit (the in-jit
+    path is hvd.jax.broadcast_parameters)."""
+    import numpy as np
+
+    if basics.size() == 1:
+        return state
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    out = []
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        res = basics.engine().run("broadcast", arr, f"ckpt.resume.{i}",
+                                  root_rank=root_rank)
+        out.append(np.asarray(res).reshape(arr.shape).astype(arr.dtype)
+                   if arr.shape else type(leaf)(res))
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,51 @@
+"""VGG — the reference's third benchmark family (68% scaling at 512 GPUs,
+reference docs/benchmarks.md:6). Configuration D (VGG-16) and E (VGG-19),
+batch-norm variant by default (tf_cnn_benchmarks' vgg16 uses plain convs;
+BN keeps bf16 training stable on TPU and is the stronger baseline)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_CFG = {
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"),
+    19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    depth: int = 16
+    num_classes: int = 1000
+    use_bn: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, kernel_size=(3, 3), padding="SAME",
+                       use_bias=not self.use_bn, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        for i, spec in enumerate(_CFG[self.depth]):
+            if spec == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = conv(spec, name=f"conv_{i}")(x)
+                if self.use_bn:
+                    x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                     epsilon=1e-5, dtype=self.dtype,
+                                     name=f"bn_{i}")(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc2")(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+VGG16 = partial(VGG, depth=16)
+VGG19 = partial(VGG, depth=19)

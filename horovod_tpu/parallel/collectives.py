@@ -116,6 +116,29 @@ def ring_shift(x, axis_name: str = HVD_AXIS, shift: int = 1):
     return lax.ppermute(x, axis_name, perm=perm)
 
 
+def hierarchical_allgather(x, ici_axis: str = ICI_AXIS, dcn_axis: str = DCN_AXIS):
+    """Two-stage allgather: gather over ICI first, then over DCN
+    (reference hierarchical allgather via MPI shared-memory window +
+    cross-node Allgatherv, operations.cc:929-1034). Note the concat order is
+    (dcn-major, ici-minor) — matches rank order for the ('dcn','ici') mesh."""
+    local = lax.all_gather(x, ici_axis, axis=0, tiled=True)
+    return lax.all_gather(local, dcn_axis, axis=0, tiled=True)
+
+
+def sparse_allreduce(values, indices, axis_name: str = HVD_AXIS,
+                     average: bool = True):
+    """Sparse-gradient allreduce as a pair of allgathers (reference
+    hvd.allreduce on tf.IndexedSlices, tensorflow/__init__.py:72-83): embed
+    gradients stay in (values, indices) form — the caller scatter-adds them
+    into the dense parameter. When ``average``, values are pre-divided by
+    world size like the reference."""
+    if average:
+        values = values / lax.axis_size(axis_name)
+    all_values = lax.all_gather(values, axis_name, axis=0, tiled=True)
+    all_indices = lax.all_gather(indices, axis_name, axis=0, tiled=True)
+    return all_values, all_indices
+
+
 def hierarchical_allreduce(
     x,
     ici_axis: str = ICI_AXIS,

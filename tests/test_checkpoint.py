@@ -1,0 +1,43 @@
+"""Checkpoint/resume contract tests (SURVEY.md §5.4): rank-0-writes,
+restore + broadcast consistency, latest-step discovery."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd_core
+from horovod_tpu import checkpoint
+
+
+@pytest.fixture()
+def hvd():
+    hvd_core.init()
+    yield hvd_core
+    hvd_core.shutdown()
+
+
+def test_save_restore_roundtrip(hvd, tmp_path):
+    state = {"params": {"w": np.arange(6.0).reshape(2, 3)}, "epoch": np.int64(7)}
+    checkpoint.save(str(tmp_path / "ckpt"), state, step=7)
+    assert checkpoint.latest_step(str(tmp_path / "ckpt")) == 7
+    restored = checkpoint.restore(str(tmp_path / "ckpt"), step=7)
+    np.testing.assert_array_equal(restored["params"]["w"], state["params"]["w"])
+    assert int(restored["epoch"]) == 7
+
+
+def test_latest_step_multiple(hvd, tmp_path):
+    for s in (1, 5, 3):
+        checkpoint.save(str(tmp_path / "c"), {"x": np.ones(2) * s}, step=s)
+    assert checkpoint.latest_step(str(tmp_path / "c")) == 5
+    restored = checkpoint.restore(str(tmp_path / "c"), step=5)
+    np.testing.assert_array_equal(restored["x"], np.ones(2) * 5)
+
+
+def test_latest_step_missing_dir(hvd, tmp_path):
+    assert checkpoint.latest_step(str(tmp_path / "nope")) is None
+
+
+def test_broadcast_resume_state_single(hvd):
+    state = {"epoch": 3, "arr": np.ones((2, 2))}
+    out = checkpoint.broadcast_resume_state(state)
+    assert out["epoch"] == 3
+    np.testing.assert_array_equal(out["arr"], state["arr"])

@@ -222,3 +222,57 @@ def test_fused_allreduce_hierarchical(mesh_2x4):
     out = f(tree)
     np.testing.assert_allclose(np.asarray(out["a"]), np.ones((N, 7)), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(out["b"]), np.ones((N, 13)), rtol=1e-6)
+
+
+def test_hierarchical_allgather(mesh_2x4):
+    """Two-stage allgather over ('dcn','ici') must match rank-order concat."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.parallel import collectives
+
+    x = jnp.arange(16.0).reshape(8, 2)  # one row per device
+
+    def body(x):
+        return collectives.hierarchical_allgather(x)
+
+    out = shard_map(body, mesh=mesh_2x4, in_specs=P(("dcn", "ici")),
+                    out_specs=P(("dcn", "ici")), check_vma=False)(x)
+    # every device holds the full concat; with out_specs sharding the global
+    # result back, we get x stacked per device -> compare one shard
+    full = shard_map(body, mesh=mesh_2x4, in_specs=P(("dcn", "ici")),
+                     out_specs=P(None), check_vma=False)(x)[:8]
+    np.testing.assert_allclose(np.asarray(full), np.asarray(x))
+
+
+def test_sparse_allreduce(mesh8):
+    """values/indices allgather parity with the reference's IndexedSlices
+    path (tensorflow/__init__.py:72-83): scatter-adding the gathered pairs
+    equals the dense average."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.parallel import collectives
+
+    vocab, dim, n = 16, 4, 8
+    rng = np.random.default_rng(0)
+    # per-rank sparse grads: 2 rows each
+    values = jnp.asarray(rng.normal(size=(n * 2, dim)).astype(np.float32))
+    indices = jnp.asarray(rng.integers(0, vocab, size=(n * 2,)).astype(np.int32))
+
+    def body(v, i):
+        av, ai = collectives.sparse_allreduce(v, i)
+        dense = jnp.zeros((vocab, dim), jnp.float32).at[ai].add(av)
+        return dense
+
+    out = shard_map(body, mesh=mesh8, in_specs=(P("hvd"), P("hvd")),
+                    out_specs=P(None), check_vma=False)(values, indices)
+    expect = np.zeros((vocab, dim), np.float32)
+    np.add.at(expect, np.asarray(indices), np.asarray(values) / n)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-5)
