@@ -53,9 +53,15 @@ def _load():
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_double,
         ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-        ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_double, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
     ]
+    for fn in ("hvd_knob_version", "hvd_ring_passes", "hvd_ring_bytes_sent",
+               "hvd_fusion_threshold"):
+        getattr(lib, fn).restype = ctypes.c_longlong
+        getattr(lib, fn).argtypes = []
+    lib.hvd_cycle_time_ms.restype = ctypes.c_double
+    lib.hvd_cycle_time_ms.argtypes = []
     lib.hvd_shutdown.restype = None
     lib.hvd_enqueue.restype = ctypes.c_longlong
     lib.hvd_enqueue.argtypes = [
@@ -104,8 +110,9 @@ class NativeEngine:
             topo.cross_rank, topo.cross_size, host.encode(), port,
             float(config.cycle_time_ms), int(config.fusion_threshold),
             timeline.encode(), int(config.timeline_mark_cycles),
-            int(config.stall_check_disable), int(config.autotune),
-            config.autotune_log.encode(),
+            int(config.stall_check_disable),
+            float(getattr(config, "stall_warning_s", 60.0)),
+            int(config.autotune), config.autotune_log.encode(),
             int("HOROVOD_FUSION_THRESHOLD" in pinned),
             int("HOROVOD_CYCLE_TIME" in pinned), err, 1024,
         )
@@ -166,6 +173,17 @@ class NativeEngine:
 
     def run(self, op: str, array: np.ndarray, name: str, **kw) -> Any:
         return self.synchronize(self.enqueue(op, array, name, **kw))
+
+    def stats(self) -> dict:
+        """Live engine counters: ring passes executed, bytes sent to the
+        next neighbour, autotuner knob state."""
+        return {
+            "ring_passes": int(self._lib.hvd_ring_passes()),
+            "ring_bytes_sent": int(self._lib.hvd_ring_bytes_sent()),
+            "knob_version": int(self._lib.hvd_knob_version()),
+            "fusion_threshold": int(self._lib.hvd_fusion_threshold()),
+            "cycle_time_ms": float(self._lib.hvd_cycle_time_ms()),
+        }
 
     def shutdown(self) -> None:
         self._lib.hvd_shutdown()

@@ -210,10 +210,12 @@ class BayesianOptimizer {
 
 // Tunes (fusion_threshold, cycle_time_ms) by measured collective throughput
 // (reference parameter_manager.cc:145-233: warmup discard, samples of many
-// cycles, median score in bytes/us, rank-0 tunes and broadcasts). Here every
-// rank runs the same deterministic tuner on the same (bytes, seconds) inputs
-// fed from the coordinator tick, so no broadcast step is needed for the
-// eager engine; the compiled path reads the tuned values between steps.
+// cycles, median score in bytes/us, rank-0 tunes and broadcasts). In
+// multi-process worlds exactly one instance runs, inside the rank-0
+// coordinator, and the tuned knobs ride the per-tick ResponseList broadcast
+// so every rank applies the same values on the same tick — the socket
+// analog of the reference's MPI_Bcast in SyncParams
+// (parameter_manager.cc:213-233). Single-process engines tune locally.
 class ParameterManager {
  public:
   struct Knobs {

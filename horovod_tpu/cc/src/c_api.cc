@@ -35,8 +35,9 @@ int hvd_init(int rank, int size, int local_rank, int local_size, int cross_rank,
              int cross_size, const char* coord_host, int coord_port,
              double cycle_time_ms, long long fusion_threshold,
              const char* timeline_path, int timeline_mark_cycles,
-             int stall_check_disable, int autotune, const char* autotune_log,
-             int threshold_pinned, int cycle_pinned, char* err, int errcap) {
+             int stall_check_disable, double stall_warning_s, int autotune,
+             const char* autotune_log, int threshold_pinned, int cycle_pinned,
+             char* err, int errcap) {
   std::lock_guard<std::mutex> g(g_mu);
   if (g_engine) return 0;  // idempotent (reference InitializeHorovodOnce)
   try {
@@ -47,6 +48,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size, int cross_rank,
     c.timeline_path = timeline_path ? timeline_path : "";
     c.timeline_mark_cycles = timeline_mark_cycles != 0;
     c.stall_check_disable = stall_check_disable != 0;
+    if (stall_warning_s > 0) c.stall_warning_s = stall_warning_s;
     c.autotune = autotune != 0;
     c.autotune_log = autotune_log ? autotune_log : "";
     c.threshold_pinned = threshold_pinned != 0;
@@ -140,7 +142,8 @@ void hvd_release(long long handle) {
   if (eng) eng->release(handle);
 }
 
-// Live knob values (the autotuner may have moved them).
+// Live knob values (the coordinator's autotuner broadcasts them; every rank
+// applies the same values on the same tick).
 double hvd_cycle_time_ms() {
   auto eng = engine();
   return eng ? eng->cycle_time_ms() : -1.0;
@@ -148,6 +151,21 @@ double hvd_cycle_time_ms() {
 long long hvd_fusion_threshold() {
   auto eng = engine();
   return eng ? (long long)eng->fusion_threshold() : -1;
+}
+long long hvd_knob_version() {
+  auto eng = engine();
+  return eng ? (long long)eng->knob_version() : -1;
+}
+
+// Ring data-plane counters (tests prove fusion reduces ring passes and that
+// bytes move peer-to-peer, not through a rank-0 relay).
+long long hvd_ring_passes() {
+  auto eng = engine();
+  return eng ? (long long)eng->stats().passes.load() : -1;
+}
+long long hvd_ring_bytes_sent() {
+  auto eng = engine();
+  return eng ? (long long)eng->stats().bytes_sent.load() : -1;
 }
 
 // ---- standalone autotuner objects (tests + compiled-path tuning) ----

@@ -76,57 +76,32 @@ void HandleManager::release(int64_t h) {
   done_.erase(h);
 }
 
-void HandleManager::fail_all(const std::string& reason) {
-  // placeholder: outstanding handles are failed by the engine on shutdown
-  (void)reason;
+// -------------------------------------------------------- dtype conversions
+
+// The ring reduces in a "work dtype": f16/bf16 contributions are widened to
+// f32 before reduction and narrowed after (the reference reduces fp16
+// through a f32-accumulating custom MPI op for the same precision reason,
+// half.h:135).
+static DataType work_dtype(DataType d) {
+  return (d == DataType::F16 || d == DataType::BF16) ? DataType::F32 : d;
 }
 
-// -------------------------------------------------------------- reductions
-
-// Elementwise sum across rank contributions, accumulating in double for
-// floats (the Python engine does the same; beats the reference's in-dtype
-// MPI_SUM on precision) and in int64 for ints.
-template <typename T, typename Acc>
-static void reduce_typed(const std::vector<const uint8_t*>& srcs, size_t n,
-                         uint8_t* dst, bool average) {
-  size_t world = srcs.size();
-  for (size_t i = 0; i < n; i++) {
-    Acc acc = 0;
-    for (size_t r = 0; r < world; r++) {
-      acc += (Acc)((const T*)srcs[r])[i];
-    }
-    if (average) acc = acc / (Acc)world;
-    ((T*)dst)[i] = (T)acc;
+static void widen_to_f32(DataType d, const uint8_t* src, size_t n, float* dst) {
+  const uint16_t* s = (const uint16_t*)src;
+  if (d == DataType::F16) {
+    for (size_t i = 0; i < n; i++) dst[i] = half_to_float(s[i]);
+  } else {
+    for (size_t i = 0; i < n; i++) dst[i] = bf16_to_float(s[i]);
   }
 }
 
-static void reduce_f16(const std::vector<const uint8_t*>& srcs, size_t n,
-                       uint8_t* dst, bool average, bool bf16) {
-  size_t world = srcs.size();
-  for (size_t i = 0; i < n; i++) {
-    float acc = 0.f;
-    for (size_t r = 0; r < world; r++) {
-      uint16_t bits = ((const uint16_t*)srcs[r])[i];
-      acc += bf16 ? bf16_to_float(bits) : half_to_float(bits);
-    }
-    if (average) acc /= (float)world;
-    ((uint16_t*)dst)[i] = bf16 ? float_to_bf16(acc) : float_to_half(acc);
-  }
-}
-
-static void reduce_buffers(DataType dtype,
-                           const std::vector<const uint8_t*>& srcs, size_t count,
-                           uint8_t* dst, bool average) {
-  switch (dtype) {
-    case DataType::F32: reduce_typed<float, double>(srcs, count, dst, average); break;
-    case DataType::F64: reduce_typed<double, double>(srcs, count, dst, average); break;
-    case DataType::I32: reduce_typed<int32_t, int64_t>(srcs, count, dst, average); break;
-    case DataType::I64: reduce_typed<int64_t, int64_t>(srcs, count, dst, average); break;
-    case DataType::U8: reduce_typed<uint8_t, int64_t>(srcs, count, dst, average); break;
-    case DataType::I8: reduce_typed<int8_t, int64_t>(srcs, count, dst, average); break;
-    case DataType::BOOL: reduce_typed<uint8_t, int64_t>(srcs, count, dst, average); break;
-    case DataType::F16: reduce_f16(srcs, count, dst, average, false); break;
-    case DataType::BF16: reduce_f16(srcs, count, dst, average, true); break;
+static void narrow_from_f32(DataType d, const float* src, size_t n,
+                            uint8_t* dst) {
+  uint16_t* o = (uint16_t*)dst;
+  if (d == DataType::F16) {
+    for (size_t i = 0; i < n; i++) o[i] = float_to_half(src[i]);
+  } else {
+    for (size_t i = 0; i < n; i++) o[i] = float_to_bf16(src[i]);
   }
 }
 
@@ -136,14 +111,6 @@ Engine::Engine(const Topology& topo, const EngineConfig& cfg)
     : topo_(topo), cfg_(cfg) {
   cycle_time_ms_ = cfg_.cycle_time_ms;
   fusion_threshold_ = (int64_t)cfg_.fusion_threshold;
-  if (cfg_.autotune) {
-    pm_ = std::make_unique<ParameterManager>(
-        fusion_threshold_, cycle_time_ms_, cfg_.threshold_pinned,
-        cfg_.cycle_pinned);
-    if (!cfg_.autotune_log.empty() && topo_.rank == 0) {
-      pm_->set_log_path(cfg_.autotune_log);
-    }
-  }
   if (!cfg_.timeline_path.empty() && topo_.rank == 0) {
     timeline_.init(cfg_.timeline_path, cfg_.timeline_mark_cycles);
   }
@@ -152,16 +119,37 @@ Engine::Engine(const Topology& topo, const EngineConfig& cfg)
       throw std::runtime_error(
           "multi-process engine needs HOROVOD_COORD_ADDR (set by the launcher)");
     }
+    std::string secret = job_secret();
+    if (secret.empty()) {
+      // Same policy as the Python engine: multi-process collectives move
+      // over the network, so they require the launcher-distributed secret.
+      // Running unauthenticated would let any peer claim a rank and inject
+      // gradients.
+      throw std::runtime_error(
+          "multi-process collectives authenticate with HOROVOD_SECRET, which "
+          "is unset; launch through the horovod_tpu runner (which "
+          "distributes it) or export the same secret on every rank");
+    }
+    ring_.open_listener();
+    std::vector<std::pair<std::string, int>> peers;
     if (topo_.rank == 0) {
       coord_ = std::make_unique<Coordinator>(topo_.size, cfg_.coord_host,
-                                             cfg_.coord_port, &timeline_,
-                                             cfg_.fusion_threshold);
+                                             cfg_.coord_port, &timeline_, cfg_);
+      peers = coord_->hello(0, cfg_.coord_host, ring_.port());
     } else {
       client_ = std::make_unique<Client>(cfg_.coord_host, cfg_.coord_port,
                                          topo_.rank, 60.0);
+      peers = client_->hello(client_->local_host(), ring_.port());
     }
+    ring_.establish(topo_.rank, topo_.size, peers, secret);
+  } else if (cfg_.autotune) {
+    // Single-process world: tune locally (multi-process tuning lives in the
+    // coordinator so every rank flips knobs on the same tick).
+    pm_ = std::make_unique<ParameterManager>(
+        fusion_threshold_, cycle_time_ms_, cfg_.threshold_pinned,
+        cfg_.cycle_pinned);
+    if (!cfg_.autotune_log.empty()) pm_->set_log_path(cfg_.autotune_log);
   }
-  last_stall_check_ = std::chrono::steady_clock::now();
   bg_ = std::thread([this] { loop(); });
 }
 
@@ -171,9 +159,11 @@ int64_t Engine::enqueue(OpType op, const std::string& name, DataType dtype,
                         const std::vector<int64_t>& shape, const void* data,
                         int root_rank, bool average) {
   if (shutdown_.load()) throw std::runtime_error("Horovod has been shut down");
-  if (op == OpType::ALLGATHER && shape.empty()) {
-    throw std::runtime_error(
-        "Allgather requires tensors of rank >= 1 (got a scalar)");
+  if (shape.empty() &&
+      (op == OpType::ALLGATHER || op == OpType::REDUCESCATTER ||
+       op == OpType::ALLTOALL)) {
+    throw std::runtime_error(std::string(op_name(op)) +
+                             " requires tensors of rank >= 1 (got a scalar)");
   }
   Entry e;
   e.req.rank = topo_.rank;
@@ -189,7 +179,7 @@ int64_t Engine::enqueue(OpType op, const std::string& name, DataType dtype,
   e.req.average = average ? 1 : 0;
   e.req.shape = shape;
   size_t nbytes = e.req.elements() * dtype_size(dtype);
-  e.req.data.assign((const uint8_t*)data, (const uint8_t*)data + nbytes);
+  e.data.assign((const uint8_t*)data, (const uint8_t*)data + nbytes);
   int64_t handle = e.handle;
   e.enqueued = std::chrono::steady_clock::now();
   {
@@ -214,71 +204,126 @@ void Engine::finish(Entry& e, Status st, Response res) {
   handles_.mark_done(e.handle, std::move(st), std::move(res));
 }
 
-void Engine::shutdown() {
-  if (shutdown_.exchange(true)) return;
-  if (bg_.joinable()) bg_.join();
-  // Fail outstanding entries (reference SHUT_DOWN_ERROR, operations.cc:263-268)
+void Engine::fail_everything(const std::string& reason) {
   std::deque<Entry> rest;
   {
     std::lock_guard<std::mutex> g(qmu_);
     rest.swap(queue_);
   }
-  for (auto& e : rest) {
-    finish(e, Status::Aborted("Horovod has been shut down"), Response{});
+  for (auto& e : rest) finish(e, Status::Aborted(reason), Response{});
+  for (auto& kv : table_) {
+    finish(kv.second, Status::Aborted(reason), Response{});
   }
-  if (client_) client_.reset();
-  if (coord_) coord_.reset();
+  table_.clear();
+}
+
+void Engine::shutdown() {
+  if (shutdown_.exchange(true)) {
+    // Second caller: just make sure the thread is gone before returning.
+    if (bg_.joinable() && std::this_thread::get_id() != bg_.get_id()) {
+      try { bg_.join(); } catch (const std::system_error&) {}
+    }
+    return;
+  }
+  if (bg_.joinable()) bg_.join();
+  if (coord_) {
+    // Keep the control plane alive until every rank has taken its shutdown
+    // response (reference: all ranks exit the loop together,
+    // operations.cc:2125-2128, 2374-2376).
+    coord_->await_departure(15.0);
+    coord_.reset();
+  }
+  client_.reset();
+  ring_.close();
   timeline_.shutdown();
 }
 
 void Engine::loop() {
-  while (!shutdown_.load()) {
-    std::this_thread::sleep_for(
-        std::chrono::duration<double, std::milli>(cycle_time_ms_));
+  while (true) {
+    bool shutting = shutdown_.load();
+    if (!shutting) {
+      std::this_thread::sleep_for(
+          std::chrono::duration<double, std::milli>(cycle_time_ms_.load()));
+      shutting = shutdown_.load();
+    }
     timeline_.mark_cycle_start();
-    std::vector<Entry> batch;
-    {
-      std::lock_guard<std::mutex> g(qmu_);
-      batch.assign(std::make_move_iterator(queue_.begin()),
-                   std::make_move_iterator(queue_.end()));
-      queue_.clear();
-    }
-    auto tick_start = std::chrono::steady_clock::now();
-    int64_t tick_bytes = 0;
-    for (auto& e : batch) tick_bytes += (int64_t)e.req.data.size();
-    if (batch.empty()) {
-      // fall through to the stall check
-    } else if (topo_.size == 1) {
-      for (auto& e : batch) complete_local(e);
-    } else {
-      negotiate_and_execute(batch);
-    }
-    if (pm_ && pm_->active() && !batch.empty()) {
-      double secs = std::chrono::duration<double>(
-                        std::chrono::steady_clock::now() - tick_start)
-                        .count();
-      if (pm_->update(tick_bytes, secs)) {
-        auto k = pm_->knobs();
-        cycle_time_ms_ = k.cycle_time_ms;
-        fusion_threshold_ = k.fusion_threshold;
-        HVD_DEBUG("autotune: fusion_threshold=" +
-                  std::to_string(fusion_threshold_) +
-                  " cycle_time_ms=" + std::to_string(cycle_time_ms_));
+    if (topo_.size == 1) {
+      std::deque<Entry> batch;
+      {
+        std::lock_guard<std::mutex> g(qmu_);
+        batch.swap(queue_);
       }
+      auto tick_start = std::chrono::steady_clock::now();
+      int64_t tick_bytes = 0;
+      for (auto& e : batch) tick_bytes += (int64_t)e.data.size();
+      for (auto& e : batch) complete_local(e);
+      if (pm_ && pm_->active() && !batch.empty()) {
+        double secs = std::chrono::duration<double>(
+                          std::chrono::steady_clock::now() - tick_start)
+                          .count();
+        if (pm_->update(tick_bytes, secs)) {
+          auto k = pm_->knobs();
+          cycle_time_ms_ = k.cycle_time_ms;
+          fusion_threshold_ = k.fusion_threshold;
+          applied_knob_version_++;
+        }
+      }
+      if (shutting) break;
+      continue;
     }
-    auto now = std::chrono::steady_clock::now();
-    if (!cfg_.stall_check_disable &&
-        std::chrono::duration<double>(now - last_stall_check_).count() >
-            cfg_.stall_warning_s) {
-      check_stalled();
-      last_stall_check_ = now;
-    }
+    if (!tick_multiprocess(shutting)) break;
   }
+  fail_everything("Horovod has been shut down");
+}
+
+bool Engine::tick_multiprocess(bool shutting) {
+  TickRequest t;
+  t.rank = topo_.rank;
+  t.shutdown = shutting ? 1 : 0;
+  std::deque<Entry> fresh;
+  {
+    std::lock_guard<std::mutex> g(qmu_);
+    fresh.swap(queue_);
+  }
+  for (auto& e : fresh) {
+    t.reqs.push_back(e.req);
+    std::string name = e.req.name;
+    table_.emplace(std::move(name), std::move(e));
+  }
+  ResponseList out;
+  try {
+    out = coord_ ? coord_->tick(topo_.rank, t) : client_->tick(t);
+  } catch (const std::exception& ex) {
+    fail_everything(std::string("control plane failed: ") + ex.what());
+    shutdown_.store(true);
+    return false;
+  }
+  if (out.knob_version != applied_knob_version_.load()) {
+    applied_knob_version_ = out.knob_version;
+    fusion_threshold_ = out.fusion_threshold;
+    cycle_time_ms_ = out.cycle_time_ms;
+    HVD_DEBUG("autotune sync: fusion_threshold=" +
+              std::to_string(out.fusion_threshold) +
+              " cycle_time_ms=" + std::to_string(out.cycle_time_ms));
+  }
+  // Stall warnings: the coordinator process (us, when coord_ is set) already
+  // logged them at creation; only worker ranks log on receipt.
+  if (!coord_) {
+    for (auto& w : out.stall_warnings) HVD_WARN(w);
+  }
+  execute_list(out);
+  if (out.shutdown && !shutting) {
+    // Another rank initiated shutdown; exit together (reference
+    // operations.cc:2125-2128). New enqueues fail from here on.
+    shutdown_.store(true);
+    return false;
+  }
+  return !shutting;
 }
 
 void Engine::complete_local(Entry& e) {
   // Single-process world: every collective is the identity (average of one,
-  // gather of one, broadcast from self).
+  // gather of one, broadcast from self, scatter of the whole).
   if (timeline_.healthy()) {
     timeline_.negotiate_end(e.req.name);
     timeline_.start(e.req.name, op_name(e.req.op));
@@ -288,77 +333,263 @@ void Engine::complete_local(Entry& e) {
   res.name = e.req.name;
   res.dtype = e.req.dtype;
   res.shape = e.req.shape;
-  res.data = std::move(e.req.data);
+  res.data = std::move(e.data);
   if (timeline_.healthy()) timeline_.end(e.req.name);
   finish(e, Status::OK_(), std::move(res));
 }
 
-void Engine::negotiate_and_execute(std::vector<Entry>& batch) {
-  std::vector<Request> reqs;
-  reqs.reserve(batch.size());
-  for (auto& e : batch) reqs.push_back(e.req);  // copy: batch keeps data for requeue
-  std::vector<Response> out;
-  try {
-    if (coord_) {
-      out = coord_->exchange(0, std::move(reqs));
-    } else {
-      out = client_->exchange(reqs);
-    }
-  } catch (const std::exception& ex) {
-    for (auto& e : batch) {
-      finish(e, Status::Unknown(ex.what()), Response{});
-    }
-    return;
-  }
-  std::map<std::string, Response*> by_name;
-  for (auto& r : out) by_name[r.name] = &r;
-  for (auto& e : batch) {
-    auto it = by_name.find(e.req.name);
-    if (it == by_name.end()) {
-      // Not globally ready this tick: requeue (stall checker warns if a rank
-      // never shows up).
-      std::lock_guard<std::mutex> g(qmu_);
-      queue_.push_back(std::move(e));
+void Engine::execute_list(const ResponseList& list) {
+  for (auto& re : list.entries) execute_entry(re);
+}
+
+void Engine::execute_entry(const ResponseEntry& re) {
+  // Pull this rank's contributions out of the tensor table. The coordinator
+  // only emits an entry when every rank (including us) contributed, so a
+  // miss is an engine bug, not a runtime condition.
+  std::vector<Entry> ents;
+  ents.reserve(re.names.size());
+  for (auto& name : re.names) {
+    auto it = table_.find(name);
+    if (it == table_.end()) {
+      HVD_WARN("response for unknown tensor " + name + " (engine bug)");
       continue;
     }
-    Response& r = *it->second;
-    if (r.kind == Response::ERROR) {
-      finish(e, Status::Precondition(r.error), Response{});
-    } else {
-      finish(e, Status::OK_(), std::move(r));
+    ents.push_back(std::move(it->second));
+    table_.erase(it);
+  }
+  if (ents.empty()) return;
+  if (timeline_.healthy()) {
+    for (auto& e : ents) {
+      timeline_.negotiate_end(e.req.name);
+      timeline_.start(e.req.name, op_name(re.op));
     }
+  }
+  try {
+    if (re.kind == ResponseEntry::ERROR) {
+      for (auto& e : ents) {
+        finish(e, Status::Precondition(re.error), Response{});
+      }
+    } else {
+      switch (re.op) {
+        case OpType::ALLREDUCE: execute_allreduce(re, ents); break;
+        case OpType::ALLGATHER: execute_allgather(re, ents[0]); break;
+        case OpType::BROADCAST: execute_broadcast(re, ents[0]); break;
+        case OpType::REDUCESCATTER: execute_reducescatter(re, ents[0]); break;
+        case OpType::ALLTOALL: execute_alltoall(re, ents[0]); break;
+      }
+    }
+  } catch (const std::exception& ex) {
+    for (auto& e : ents) {
+      finish(e, Status::Unknown(std::string("ring collective failed: ") +
+                                ex.what()),
+             Response{});
+    }
+  }
+  if (timeline_.healthy()) {
+    for (auto& e : ents) timeline_.end(e.req.name);
   }
 }
 
-void Engine::check_stalled() {
-  auto now = std::chrono::steady_clock::now();
-  std::vector<std::string> stalled;
-  {
-    std::lock_guard<std::mutex> g(qmu_);
-    for (auto& e : queue_) {
-      if (std::chrono::duration<double>(now - e.enqueued).count() >
-          cfg_.stall_warning_s) {
-        stalled.push_back(e.req.name);
-      }
+// One fused bucket: memcpy every tensor into the fusion buffer (widening
+// f16/bf16 to f32), one ring allreduce over the whole buffer, memcpy back
+// out. This is the executed analog of the reference's fused MPI path
+// (operations.cc:798-814, 1491-1586) — round 1 only simulated it.
+void Engine::execute_allreduce(const ResponseEntry& re,
+                               std::vector<Entry>& ents) {
+  DataType d = re.dtype;
+  DataType w = work_dtype(d);
+  size_t wes = dtype_size(w);
+  // Fast path: a single tensor that needs no dtype widening ring-reduces in
+  // place over its own contribution buffer and moves it into the response —
+  // no fusion-buffer round trip (2x full-size memcpy) on the big-gradient
+  // hot path.
+  if (ents.size() == 1 && w == d) {
+    Entry& e = ents[0];
+    size_t n = e.req.elements();
+    if (timeline_.healthy())
+      timeline_.activity_start(e.req.name, "RING_ALLREDUCE");
+    ring_allreduce(ring_, topo_.rank, topo_.size, e.data.data(), n, wes, w,
+                   re.average != 0, &stats_);
+    if (timeline_.healthy()) timeline_.activity_end(e.req.name);
+    Response res;
+    res.kind = Response::OK;
+    res.name = e.req.name;
+    res.dtype = d;
+    res.shape = e.req.shape;
+    res.data = std::move(e.data);
+    finish(e, Status::OK_(), std::move(res));
+    return;
+  }
+  size_t total = 0;
+  for (auto& e : ents) total += e.req.elements();
+  uint8_t* buf = fusion_buf_.get(total * wes);
+  size_t off = 0;
+  for (auto& e : ents) {
+    size_t n = e.req.elements();
+    if (timeline_.healthy())
+      timeline_.activity_start(e.req.name, "MEMCPY_IN_FUSION_BUFFER");
+    if (w == d) {
+      std::memcpy(buf + off * wes, e.data.data(), n * wes);
+    } else {
+      widen_to_f32(d, e.data.data(), n, (float*)(buf + off * wes));
     }
+    if (timeline_.healthy()) timeline_.activity_end(e.req.name);
+    off += n;
   }
-  if (!stalled.empty()) {
-    std::string names;
-    for (auto& s : stalled) names += (names.empty() ? "" : ", ") + s;
-    HVD_WARN(
-        "One or more tensors were submitted to be reduced, gathered or "
-        "broadcasted by subset of ranks and are waiting for remainder of "
-        "ranks. Stalled ops: " + names);
+  if (timeline_.healthy()) {
+    for (auto& e : ents) timeline_.activity_start(e.req.name, "RING_ALLREDUCE");
   }
+  ring_allreduce(ring_, topo_.rank, topo_.size, buf, total, wes, w,
+                 re.average != 0, &stats_);
+  if (timeline_.healthy()) {
+    for (auto& e : ents) timeline_.activity_end(e.req.name);
+  }
+  off = 0;
+  for (auto& e : ents) {
+    size_t n = e.req.elements();
+    Response res;
+    res.kind = Response::OK;
+    res.name = e.req.name;
+    res.dtype = d;
+    res.shape = e.req.shape;
+    res.data.resize(n * dtype_size(d));
+    if (timeline_.healthy())
+      timeline_.activity_start(e.req.name, "MEMCPY_OUT_FUSION_BUFFER");
+    if (w == d) {
+      std::memcpy(res.data.data(), buf + off * wes, n * wes);
+    } else {
+      narrow_from_f32(d, (const float*)(buf + off * wes), n, res.data.data());
+    }
+    if (timeline_.healthy()) timeline_.activity_end(e.req.name);
+    off += n;
+    finish(e, Status::OK_(), std::move(res));
+  }
+}
+
+void Engine::execute_allgather(const ResponseEntry& re, Entry& ent) {
+  size_t esize = dtype_size(ent.req.dtype);
+  int64_t dim0 = ent.req.shape[0];
+  size_t row_elems = dim0 > 0 ? ent.req.elements() / (size_t)dim0 : 0;
+  if (row_elems == 0) {
+    // degenerate trailing dims (some dim is 0): recompute from shape tail
+    row_elems = 1;
+    for (size_t i = 1; i < ent.req.shape.size(); i++)
+      row_elems *= (size_t)ent.req.shape[i];
+  }
+  std::vector<size_t> counts(re.tensor_sizes.size());
+  int64_t total0 = 0;
+  for (size_t i = 0; i < re.tensor_sizes.size(); i++) {
+    counts[i] = (size_t)re.tensor_sizes[i] * row_elems;
+    total0 += re.tensor_sizes[i];
+  }
+  auto offs = offsets_of(counts);
+  Response res;
+  res.kind = Response::OK;
+  res.name = ent.req.name;
+  res.dtype = ent.req.dtype;
+  res.shape = ent.req.shape;
+  res.shape[0] = total0;
+  res.data.resize(offs.back() * esize);
+  std::memcpy(res.data.data() + offs[(size_t)topo_.rank] * esize,
+              ent.data.data(), ent.data.size());
+  stats_.passes++;
+  ring_allgather(ring_, topo_.rank, topo_.size, res.data.data(), counts, offs,
+                 esize, &stats_);
+  finish(ent, Status::OK_(), std::move(res));
+}
+
+void Engine::execute_broadcast(const ResponseEntry& re, Entry& ent) {
+  Response res;
+  res.kind = Response::OK;
+  res.name = ent.req.name;
+  res.dtype = ent.req.dtype;
+  res.shape = ent.req.shape;
+  res.data = std::move(ent.data);
+  ring_broadcast(ring_, topo_.rank, topo_.size, re.root_rank, res.data.data(),
+                 res.data.size(), &stats_);
+  finish(ent, Status::OK_(), std::move(res));
+}
+
+void Engine::execute_reducescatter(const ResponseEntry& re, Entry& ent) {
+  DataType d = ent.req.dtype;
+  DataType w = work_dtype(d);
+  size_t wes = dtype_size(w);
+  size_t n = ent.req.elements();
+  int64_t dim0 = ent.req.shape[0];
+  size_t row_elems = dim0 > 0 ? n / (size_t)dim0 : 0;
+  auto rows = split_counts((size_t)dim0, topo_.size);
+  std::vector<size_t> counts(rows.size());
+  for (size_t i = 0; i < rows.size(); i++) counts[i] = rows[i] * row_elems;
+  auto offs = offsets_of(counts);
+  uint8_t* buf = fusion_buf_.get(n * wes);
+  if (w == d) {
+    std::memcpy(buf, ent.data.data(), n * wes);
+  } else {
+    widen_to_f32(d, ent.data.data(), n, (float*)buf);
+  }
+  stats_.passes++;
+  ring_reduce_scatter(ring_, topo_.rank, topo_.size, buf, counts, offs, wes, w,
+                      &stats_);
+  size_t mine = counts[(size_t)topo_.rank];
+  uint8_t* my_chunk = buf + offs[(size_t)topo_.rank] * wes;
+  if (re.average) scale_chunk(w, my_chunk, mine, topo_.size);
+  Response res;
+  res.kind = Response::OK;
+  res.name = ent.req.name;
+  res.dtype = d;
+  res.shape = ent.req.shape;
+  res.shape[0] = (int64_t)rows[(size_t)topo_.rank];
+  res.data.resize(mine * dtype_size(d));
+  if (w == d) {
+    std::memcpy(res.data.data(), my_chunk, mine * wes);
+  } else {
+    narrow_from_f32(d, (const float*)my_chunk, mine, res.data.data());
+  }
+  finish(ent, Status::OK_(), std::move(res));
+}
+
+void Engine::execute_alltoall(const ResponseEntry& re, Entry& ent) {
+  (void)re;
+  int64_t dim0 = ent.req.shape[0];
+  size_t row_bytes = dim0 > 0 ? ent.data.size() / (size_t)dim0 : 0;
+  auto rows = split_counts((size_t)dim0, topo_.size);
+  std::vector<size_t> dest_bytes(rows.size());
+  for (size_t i = 0; i < rows.size(); i++) dest_bytes[i] = rows[i] * row_bytes;
+  auto dest_offs = offsets_of(dest_bytes);
+  size_t my_rows = rows[(size_t)topo_.rank];
+  Response res;
+  res.kind = Response::OK;
+  res.name = ent.req.name;
+  res.dtype = ent.req.dtype;
+  res.shape = ent.req.shape;
+  res.shape[0] = (int64_t)(my_rows * (size_t)topo_.size);
+  res.data.resize(my_rows * row_bytes * (size_t)topo_.size);
+  ring_alltoall(ring_, topo_.rank, topo_.size, ent.data.data(), dest_bytes,
+                dest_offs, res.data.data(), &stats_);
+  finish(ent, Status::OK_(), std::move(res));
 }
 
 // -------------------------------------------------------------- Coordinator
 
 Coordinator::Coordinator(int world, const std::string& host, int port,
-                         Timeline* timeline, size_t fusion_threshold)
-    : world_(world), timeline_(timeline), fusion_threshold_(fusion_threshold) {
-  (void)host;  // coordinator binds all interfaces; host is the clients' view
-  listen_fd_ = listen_on("", port, world + 4);
+                         Timeline* timeline, const EngineConfig& cfg)
+    : world_(world),
+      timeline_(timeline),
+      cfg_(cfg),
+      secret_(job_secret()),
+      peers_((size_t)world),
+      knob_threshold_((int64_t)cfg.fusion_threshold),
+      knob_cycle_ms_(cfg.cycle_time_ms) {
+  if (cfg_.autotune) {
+    pm_ = std::make_unique<ParameterManager>(knob_threshold_, knob_cycle_ms_,
+                                             cfg_.threshold_pinned,
+                                             cfg_.cycle_pinned);
+    if (!cfg_.autotune_log.empty()) pm_->set_log_path(cfg_.autotune_log);
+  }
+  current_.fusion_threshold = knob_threshold_;
+  current_.cycle_time_ms = knob_cycle_ms_;
+  listen_fd_ = listen_on(host, port, world + 4);
+  last_barrier_ = std::chrono::steady_clock::now();
   accept_thread_ = std::thread([this] { accept_loop(); });
 }
 
@@ -371,11 +602,23 @@ void Coordinator::stop() {
     ::close(listen_fd_);
     listen_fd_ = -1;
   }
+  {
+    // Unblock serve threads parked in recv_frame on healthy sockets (a rank
+    // that is alive but wedged would otherwise pin join() forever).
+    std::lock_guard<std::mutex> g(mu_);
+    for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+  }
   cv_.notify_all();
   if (accept_thread_.joinable()) accept_thread_.join();
   for (auto& t : serve_threads_) {
     if (t.joinable()) t.join();
   }
+}
+
+void Coordinator::await_departure(double timeout_s) {
+  std::unique_lock<std::mutex> lk(mu_);
+  cv_.wait_for(lk, std::chrono::duration<double>(timeout_s),
+               [&] { return (int)departed_.size() >= world_; });
 }
 
 void Coordinator::accept_loop() {
@@ -389,231 +632,333 @@ void Coordinator::accept_loop() {
 }
 
 void Coordinator::serve(int fd) {
+  int rank = -1;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    client_fds_.push_back(fd);
+  }
   try {
+    // Authenticate before parsing a single payload byte (ADVICE finding:
+    // the round-1 coordinator accepted unauthenticated exchanges).
+    if (!auth_accept(fd, secret_, "hvd-ctrl")) {
+      std::lock_guard<std::mutex> g(mu_);
+      client_fds_.erase(
+          std::remove(client_fds_.begin(), client_fds_.end(), fd),
+          client_fds_.end());
+      ::close(fd);
+      return;
+    }
+    {
+      auto frame = recv_frame(fd);
+      Reader r(frame.data(), frame.size());
+      if (r.u8() != 0) throw std::runtime_error("expected hello");
+      rank = r.i32();
+      std::string host = r.str();
+      int port = r.i32();
+      if (rank <= 0 || rank >= world_)
+        throw std::runtime_error("hello from invalid rank");
+      auto peers = hello(rank, host, port);
+      Writer w;
+      w.u32((uint32_t)peers.size());
+      for (auto& p : peers) {
+        w.str(p.first);
+        w.i32(p.second);
+      }
+      send_frame(fd, w.buf);
+    }
     while (!stop_.load()) {
       auto frame = recv_frame(fd);
       Reader r(frame.data(), frame.size());
-      uint8_t kind = r.u8();
-      if (kind == 2) break;  // bye
-      int32_t rank = r.i32();
-      uint32_t n = r.u32();
-      std::vector<Request> reqs;
-      reqs.reserve(n);
-      for (uint32_t i = 0; i < n; i++) reqs.push_back(Request::read(r));
-      auto out = exchange(rank, std::move(reqs));
+      if (r.u8() != 1) throw std::runtime_error("expected tick");
+      TickRequest t = TickRequest::read(r);
+      if (t.rank != rank) throw std::runtime_error("tick rank mismatch");
+      ResponseList out = tick(rank, t);
       Writer w;
-      w.u32((uint32_t)out.size());
-      for (auto& res : out) res.write(w);
+      out.write(w);
       send_frame(fd, w.buf);
+      if (t.shutdown) break;  // rank departed cleanly
     }
   } catch (const std::exception&) {
-    // peer closed; engine on that rank will surface the error
+    if (rank >= 0) mark_departed(rank);
+  }
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    client_fds_.erase(std::remove(client_fds_.begin(), client_fds_.end(), fd),
+                      client_fds_.end());
   }
   ::close(fd);
 }
 
-std::vector<Response> Coordinator::exchange(int rank,
-                                            std::vector<Request> reqs) {
-  std::vector<std::string> names;
-  std::vector<std::string> ready;
+std::vector<std::pair<std::string, int>> Coordinator::hello(
+    int rank, const std::string& host, int port) {
   std::unique_lock<std::mutex> lk(mu_);
-  for (auto& q : reqs) {
-    names.push_back(q.name);
-    auto r_it = results_.find(q.name);
-    if (r_it != results_.end() && !claimed_[q.name].count(rank)) {
-      continue;  // re-send after timeout: result already waiting for us
+  if (peers_[(size_t)rank].second == 0) hello_count_++;
+  peers_[(size_t)rank] = {host, port};
+  cv_.notify_all();
+  cv_.wait(lk, [&] { return hello_count_ >= world_ || stop_.load(); });
+  if (hello_count_ < world_)
+    throw std::runtime_error("coordinator stopped during registration");
+  return peers_;
+}
+
+void Coordinator::mark_departed(int rank) {
+  std::lock_guard<std::mutex> g(mu_);
+  departed_.insert(rank);
+  if (barrier_complete() && !contributed_.empty()) build_response_list();
+  cv_.notify_all();
+}
+
+bool Coordinator::barrier_complete() const {
+  for (int r = 0; r < world_; r++) {
+    if (!contributed_.count(r) && !departed_.count(r)) return false;
+  }
+  return true;
+}
+
+ResponseList Coordinator::tick(int rank, const TickRequest& req) {
+  std::unique_lock<std::mutex> lk(mu_);
+  auto now = std::chrono::steady_clock::now();
+  for (auto& q : req.reqs) {
+    auto [it, fresh] = pending_.try_emplace(q.name);
+    if (fresh) {
+      it->second.first_seen = now;
+      arrival_order_.push_back(q.name);
     }
-    auto& entry = pending_[q.name];
-    if (timeline_ && timeline_->healthy()) {
+    if (timeline_ && timeline_->healthy())
       timeline_->negotiate_rank_ready(q.name, q.rank);
-    }
-    entry[q.rank] = std::move(q);
-    if ((int)entry.size() == world_) ready.push_back(names.back());
+    it->second.contribs[rank] = q;
   }
-  if (!ready.empty()) {
-    execute_ready(ready);  // fills results_, holds lock
+  if (req.shutdown) {
+    shutdown_seen_ = true;
+    departed_.insert(rank);
+  }
+  contributed_.insert(rank);
+  uint64_t my_gen = gen_;
+  if (barrier_complete()) {
+    build_response_list();
     cv_.notify_all();
-  }
-  // Block until every requested tensor is ready (collective semantics); a
-  // missing rank trips the deadline and the caller requeues.
-  auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(30);
-  std::vector<Response> out;
-  cv_.wait_until(lk, deadline, [&] {
-    for (auto& n : names) {
-      if (!results_.count(n)) return false;
+  } else {
+    while (gen_ == my_gen && !stop_.load()) {
+      cv_.wait_for(lk, std::chrono::seconds(1));
+      // Barrier stuck (a rank stopped ticking): run the stall scan on a
+      // timer so rank 0 gets diagnostics even though build_response_list
+      // can't run; the warnings also ride the next successful broadcast.
+      if (gen_ == my_gen && !cfg_.stall_check_disable) {
+        auto warns = scan_stalls(std::chrono::steady_clock::now());
+        for (auto& w : warns) {
+          log_msg(3, "warning", w);
+          deferred_warnings_.push_back(w);
+        }
+      }
     }
-    return true;
-  });
-  for (auto& n : names) {
-    auto it = results_.find(n);
-    if (it == results_.end()) continue;
-    if (claimed_[n].count(rank)) continue;  // already delivered to this rank
-    out.push_back(it->second[(size_t)rank]);
-    claimed_[n].insert(rank);
-    if ((int)claimed_[n].size() == world_) {
-      results_.erase(n);
-      claimed_.erase(n);
+    if (gen_ == my_gen) {
+      throw std::runtime_error("coordinator stopped mid-tick");
+    }
+  }
+  return current_;
+}
+
+std::vector<std::string> Coordinator::scan_stalls(
+    std::chrono::steady_clock::time_point now) {
+  std::vector<std::string> out;
+  for (auto& [name, p] : pending_) {
+    double age = std::chrono::duration<double>(now - p.first_seen).count();
+    double since_warn =
+        p.warned ? std::chrono::duration<double>(now - p.last_warned).count()
+                 : 1e9;
+    if (age > cfg_.stall_warning_s && since_warn > cfg_.stall_warning_s) {
+      std::string missing;
+      for (int r = 0; r < world_; r++) {
+        if (!p.contribs.count(r))
+          missing += (missing.empty() ? "" : ", ") + std::to_string(r);
+      }
+      out.push_back(
+          "One or more tensors were submitted to be reduced, gathered or "
+          "broadcasted by subset of ranks and are waiting for remainder of "
+          "ranks for more than " +
+          std::to_string((int)cfg_.stall_warning_s) + " seconds. Op: " + name +
+          ", missing ranks: " + missing);
+      p.warned = true;
+      p.last_warned = now;
     }
   }
   return out;
 }
 
-void Coordinator::execute_ready(const std::vector<std::string>& ready) {
-  // Fusion accounting: bucket ready allreduces by dtype under the threshold
-  // (reference fusion loop, operations.cc:2154-2266). Execution below is
-  // per-tensor over host memory, but buckets drive the timeline's
-  // MEMCPY_IN_FUSION_BUFFER spans so traces read like the reference's.
-  for (auto& name : ready) {
-    auto& contribs = pending_[name];
-    if (timeline_ && timeline_->healthy()) {
-      timeline_->negotiate_end(name);
-      timeline_->start(name, op_name(contribs.begin()->second.op));
+// Build the per-tick broadcast while holding mu_: ready detection in
+// arrival order, validation, fusion planning, stall diagnostics, knob sync.
+void Coordinator::build_response_list() {
+  auto now = std::chrono::steady_clock::now();
+  ResponseList out;
+  out.shutdown = shutdown_seen_ ? 1 : 0;
+
+  // 1. ready tensors, in first-arrival order (the coordinator's total order,
+  //    reference operations.cc:2071-2129)
+  std::vector<std::pair<std::string, ResponseEntry>> ready;
+  std::set<std::string> consumed;
+  for (auto& name : arrival_order_) {
+    auto it = pending_.find(name);
+    if (it == pending_.end()) continue;
+    if ((int)it->second.contribs.size() < world_) continue;
+    ResponseEntry entry;
+    if (shutdown_seen_) {
+      entry.kind = ResponseEntry::ERROR;
+      entry.op = it->second.contribs.begin()->second.op;
+      entry.names = {name};
+      entry.error = "Horovod has been shut down";
+    } else {
+      validate(name, it->second.contribs, &entry);
     }
-    results_[name] = execute(name, contribs);
-    claimed_[name].clear();
-    if (timeline_ && timeline_->healthy()) timeline_->end(name);
-    pending_.erase(name);
+    ready.emplace_back(name, std::move(entry));
+    consumed.insert(name);
   }
+  int64_t ready_bytes = 0;
+  for (auto& [name, entry] : ready) {
+    if (entry.kind == ResponseEntry::OK)
+      ready_bytes += (int64_t)pending_[name].contribs.begin()->second.nbytes();
+  }
+  for (auto& name : consumed) pending_.erase(name);
+  if (!consumed.empty()) {
+    std::vector<std::string> keep;
+    keep.reserve(arrival_order_.size() - consumed.size());
+    for (auto& n : arrival_order_) {
+      if (!consumed.count(n)) keep.push_back(n);
+    }
+    arrival_order_.swap(keep);
+  }
+
+  // 2. fusion plan over the ready allreduces (reference fusion negotiation,
+  //    operations.cc:2154-2266): same-dtype same-mode buckets under the
+  //    live threshold; every rank executes each bucket as one ring pass.
+  std::vector<FusionItem> items;
+  for (size_t i = 0; i < ready.size(); i++) {
+    auto& e = ready[i].second;
+    if (e.kind == ResponseEntry::OK && e.op == OpType::ALLREDUCE) {
+      // fused_nbytes (work-dtype payload size) is stashed by validate()
+      items.push_back(
+          FusionItem{i, e.dtype, e.average, (size_t)e.fused_nbytes});
+    }
+  }
+  auto buckets = plan_fusion(items, (size_t)knob_threshold_);
+  std::map<size_t, std::vector<size_t>> bucket_of_leader;  // leader idx -> members
+  std::set<size_t> member;
+  for (auto& b : buckets) {
+    if (b.size() <= 1) continue;
+    std::vector<size_t> idxs;
+    for (auto& it : b) idxs.push_back(it.index);
+    for (size_t k = 1; k < idxs.size(); k++) member.insert(idxs[k]);
+    bucket_of_leader[idxs[0]] = std::move(idxs);
+  }
+  for (size_t i = 0; i < ready.size(); i++) {
+    if (member.count(i)) continue;
+    auto lead = bucket_of_leader.find(i);
+    if (lead == bucket_of_leader.end()) {
+      out.entries.push_back(std::move(ready[i].second));
+    } else {
+      ResponseEntry merged = ready[i].second;
+      for (size_t k = 1; k < lead->second.size(); k++) {
+        auto& other = ready[lead->second[k]].second;
+        merged.names.push_back(other.names[0]);
+      }
+      out.entries.push_back(std::move(merged));
+    }
+  }
+
+  // 3. stall diagnostics with missing-rank lists (reference
+  //    CheckForStalledTensors, operations.cc:1643-1665 — the repo's round-1
+  //    version named tensors only; the missing ranks are the useful part).
+  //    Includes any warnings the timer-driven scans collected while the
+  //    barrier was stuck, so every rank sees them, not just rank 0.
+  out.stall_warnings = std::move(deferred_warnings_);
+  deferred_warnings_.clear();
+  if (!cfg_.stall_check_disable) {
+    for (auto& w : scan_stalls(now)) {
+      log_msg(3, "warning", w);  // rank 0 logs at creation; workers on receipt
+      out.stall_warnings.push_back(std::move(w));
+    }
+  }
+
+  // 4. knob sync (reference SyncParams, parameter_manager.cc:213-233): the
+  //    coordinator owns the tuner; knobs ride the broadcast so every rank
+  //    applies the same values on the same tick.
+  if (pm_ && pm_->active() && ready_bytes > 0) {
+    double secs =
+        std::chrono::duration<double>(now - last_barrier_).count();
+    if (pm_->update(ready_bytes, secs)) {
+      auto k = pm_->knobs();
+      knob_threshold_ = k.fusion_threshold;
+      knob_cycle_ms_ = k.cycle_time_ms;
+      knob_version_++;
+    }
+  }
+  last_barrier_ = now;
+  out.knob_version = knob_version_;
+  out.fusion_threshold = knob_threshold_;
+  out.cycle_time_ms = knob_cycle_ms_;
+
+  current_ = std::move(out);
+  gen_++;
+  contributed_.clear();
 }
 
-static std::vector<size_t> split_sizes(size_t n, int parts) {
-  // np.array_split semantics: first n%parts chunks get one extra
-  std::vector<size_t> out(parts, n / parts);
-  for (size_t i = 0; i < n % (size_t)parts; i++) out[i]++;
-  return out;
-}
-
-std::vector<Response> Coordinator::execute(const std::string& name,
-                                           std::map<int, Request>& contribs) {
-  std::vector<const Request*> by_rank;
-  for (auto& kv : contribs) by_rank.push_back(&kv.second);
-  const Request& first = *by_rank[0];
-
-  auto error_all = [&](const std::string& msg) {
-    Response e;
-    e.kind = Response::ERROR;
-    e.name = name;
-    e.error = msg;
-    return std::vector<Response>((size_t)world_, e);
+bool Coordinator::validate(const std::string& name,
+                           const std::map<int, Request>& contribs,
+                           ResponseEntry* entry) {
+  const Request& first = contribs.begin()->second;
+  entry->op = first.op;
+  entry->names = {name};
+  auto fail = [&](const std::string& msg) {
+    entry->kind = ResponseEntry::ERROR;
+    entry->error = msg;
+    return false;
   };
-
-  // Cross-rank validation (ConstructResponse, operations.cc:321-523).
-  for (auto* q : by_rank) {
-    if (q->op != first.op)
-      return error_all("Mismatched collective operations for tensor " + name);
-    if (q->dtype != first.dtype)
-      return error_all("Mismatched data types for tensor " + name);
+  for (auto& [r, q] : contribs) {
+    if (q.op != first.op)
+      return fail("Mismatched collective operations for tensor " + name);
+    if (q.dtype != first.dtype)
+      return fail("Mismatched data types for tensor " + name);
   }
   if (first.op == OpType::ALLGATHER) {
     if (first.shape.empty())
-      return error_all("Allgather requires tensors of rank >= 1: " + name);
-    for (auto* q : by_rank) {
-      if (q->shape.size() != first.shape.size() || q->shape.empty() ||
-          !std::equal(q->shape.begin() + 1, q->shape.end(),
+      return fail("Allgather requires tensors of rank >= 1: " + name);
+    for (auto& [r, q] : contribs) {
+      if (q.shape.size() != first.shape.size() || q.shape.empty() ||
+          !std::equal(q.shape.begin() + 1, q.shape.end(),
                       first.shape.begin() + 1))
-        return error_all("Mismatched non-first dimensions for allgather " + name);
+        return fail("Mismatched non-first dimensions for allgather " + name);
     }
   } else {
-    for (auto* q : by_rank) {
-      if (q->shape != first.shape)
-        return error_all("Mismatched tensor shapes for tensor " + name);
+    for (auto& [r, q] : contribs) {
+      if (q.shape != first.shape)
+        return fail("Mismatched tensor shapes for tensor " + name);
     }
   }
   if (first.op == OpType::BROADCAST) {
-    for (auto* q : by_rank) {
-      if (q->root_rank != first.root_rank)
-        return error_all("Mismatched root ranks for broadcast " + name);
+    for (auto& [r, q] : contribs) {
+      if (q.root_rank != first.root_rank)
+        return fail("Mismatched root ranks for broadcast " + name);
     }
   }
-
-  Response ok;
-  ok.kind = Response::OK;
-  ok.name = name;
-  ok.dtype = first.dtype;
-  size_t esize = dtype_size(first.dtype);
-
-  switch (first.op) {
-    case OpType::ALLREDUCE: {
-      if (timeline_ && timeline_->healthy())
-        timeline_->activity_start(name, "MEMCPY_IN_FUSION_BUFFER");
-      std::vector<const uint8_t*> srcs;
-      for (auto* q : by_rank) srcs.push_back(q->data.data());
-      size_t count = first.elements();
-      uint8_t* dst = fusion_buf_.get(count * esize);
-      if (timeline_ && timeline_->healthy()) {
-        timeline_->activity_end(name);
-        timeline_->activity_start(name, "ALLREDUCE");
-      }
-      reduce_buffers(first.dtype, srcs, count, dst, first.average != 0);
-      if (timeline_ && timeline_->healthy()) timeline_->activity_end(name);
-      ok.shape = first.shape;
-      ok.data.assign(dst, dst + count * esize);
-      return std::vector<Response>((size_t)world_, ok);
-    }
-    case OpType::ALLGATHER: {
-      int64_t total0 = 0;
-      for (auto* q : by_rank) total0 += q->shape.empty() ? 1 : q->shape[0];
-      ok.shape = first.shape;
-      if (!ok.shape.empty()) ok.shape[0] = total0;
-      for (auto* q : by_rank)
-        ok.data.insert(ok.data.end(), q->data.begin(), q->data.end());
-      return std::vector<Response>((size_t)world_, ok);
-    }
-    case OpType::BROADCAST: {
-      const Request* root = nullptr;
-      for (auto* q : by_rank) {
-        if (q->rank == first.root_rank) root = q;
-      }
-      if (!root) return error_all("Root rank missing for broadcast " + name);
-      ok.shape = root->shape;
-      ok.data = root->data;
-      return std::vector<Response>((size_t)world_, ok);
-    }
-    case OpType::REDUCESCATTER: {
-      std::vector<const uint8_t*> srcs;
-      for (auto* q : by_rank) srcs.push_back(q->data.data());
-      size_t count = first.elements();
-      uint8_t* dst = fusion_buf_.get(count * esize);
-      reduce_buffers(first.dtype, srcs, count, dst, first.average != 0);
-      int64_t dim0 = first.shape.empty() ? 1 : first.shape[0];
-      size_t row = (size_t)(count / (dim0 ? dim0 : 1)) * esize;
-      auto rows = split_sizes((size_t)dim0, world_);
-      std::vector<Response> out;
-      size_t off = 0;
-      for (int r = 0; r < world_; r++) {
-        Response res = ok;
-        res.shape = first.shape;
-        if (!res.shape.empty()) res.shape[0] = (int64_t)rows[(size_t)r];
-        res.data.assign(dst + off, dst + off + rows[(size_t)r] * row);
-        off += rows[(size_t)r] * row;
-        out.push_back(std::move(res));
-      }
-      return out;
-    }
-    case OpType::ALLTOALL: {
-      int64_t dim0 = first.shape.empty() ? 1 : first.shape[0];
-      size_t row = first.elements() / (size_t)(dim0 ? dim0 : 1) * esize;
-      auto rows = split_sizes((size_t)dim0, world_);
-      std::vector<size_t> offs(world_ + 1, 0);
-      for (int p = 0; p < world_; p++) offs[p + 1] = offs[p] + rows[p] * row;
-      std::vector<Response> out;
-      for (int r = 0; r < world_; r++) {
-        Response res = ok;
-        res.shape = first.shape;
-        res.data.clear();
-        int64_t got = 0;
-        for (int s = 0; s < world_; s++) {
-          const auto& d = by_rank[(size_t)s]->data;
-          res.data.insert(res.data.end(), d.begin() + offs[r], d.begin() + offs[r + 1]);
-          got += (int64_t)rows[(size_t)r];
-        }
-        if (!res.shape.empty()) res.shape[0] = got;
-        out.push_back(std::move(res));
-      }
-      return out;
+  if ((first.op == OpType::REDUCESCATTER || first.op == OpType::ALLTOALL) &&
+      first.shape.empty()) {
+    return fail(std::string(op_name(first.op)) +
+                " requires tensors of rank >= 1: " + name);
+  }
+  entry->kind = ResponseEntry::OK;
+  entry->dtype = first.dtype;
+  entry->root_rank = first.root_rank;
+  entry->average = first.average;
+  if (first.op == OpType::ALLGATHER) {
+    entry->tensor_sizes.resize((size_t)world_);
+    for (auto& [r, q] : contribs) {
+      entry->tensor_sizes[(size_t)r] = q.shape.empty() ? 1 : q.shape[0];
     }
   }
-  return error_all("unknown op");
+  // Stash the per-rank payload size for the fusion planner (work-dtype
+  // bytes: f16/bf16 widen to f32 in the fusion buffer).
+  size_t elems = first.elements();
+  entry->fused_nbytes = (int64_t)(elems * dtype_size(work_dtype(first.dtype)));
+  return true;
 }
 
 // ------------------------------------------------------------------- Client
@@ -621,35 +966,61 @@ std::vector<Response> Coordinator::execute(const std::string& name,
 Client::Client(const std::string& host, int port, int rank, double timeout_s)
     : rank_(rank) {
   fd_ = connect_to(host, port, timeout_s);
+  try {
+    // Short deadline during the handshake: a secret mismatch (e.g. the
+    // server has no secret and never sends a nonce) must error, not hang.
+    timeval hs{30, 0};
+    ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &hs, sizeof(hs));
+    ::setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &hs, sizeof(hs));
+    auth_connect(fd_, job_secret(), "hvd-ctrl");
+  } catch (...) {
+    ::close(fd_);
+    fd_ = -1;
+    throw;
+  }
+  // Generous receive deadline from here: a barrier stall beyond this means
+  // the coordinator or a peer is gone for good.
+  timeval tv{600, 0};
+  ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
 }
 
 Client::~Client() {
-  if (fd_ >= 0) {
-    try {
-      Writer w;
-      w.u8(2);  // bye
-      send_frame(fd_, w.buf);
-    } catch (...) {
-    }
-    ::close(fd_);
-  }
+  if (fd_ >= 0) ::close(fd_);
 }
 
-std::vector<Response> Client::exchange(const std::vector<Request>& reqs) {
+std::string Client::local_host() const { return local_addr(fd_); }
+
+std::vector<std::pair<std::string, int>> Client::hello(
+    const std::string& data_host, int data_port) {
   std::lock_guard<std::mutex> g(mu_);
   Writer w;
-  w.u8(1);
+  w.u8(0);
   w.i32(rank_);
-  w.u32((uint32_t)reqs.size());
-  for (auto& q : reqs) q.write(w);
+  w.str(data_host);
+  w.i32(data_port);
   send_frame(fd_, w.buf);
   auto frame = recv_frame(fd_);
   Reader r(frame.data(), frame.size());
   uint32_t n = r.u32();
-  std::vector<Response> out;
-  out.reserve(n);
-  for (uint32_t i = 0; i < n; i++) out.push_back(Response::read(r));
-  return out;
+  std::vector<std::pair<std::string, int>> peers;
+  peers.reserve(n);
+  for (uint32_t i = 0; i < n; i++) {
+    std::string host = r.str();
+    int port = r.i32();
+    peers.emplace_back(std::move(host), port);
+  }
+  return peers;
+}
+
+ResponseList Client::tick(const TickRequest& req) {
+  std::lock_guard<std::mutex> g(mu_);
+  Writer w;
+  w.u8(1);
+  req.write(w);
+  send_frame(fd_, w.buf);
+  auto frame = recv_frame(fd_);
+  Reader r(frame.data(), frame.size());
+  return ResponseList::read(r);
 }
 
 }  // namespace hvd

@@ -1,4 +1,5 @@
-// The native eager engine: background thread + coordinator negotiation.
+// The native eager engine: background thread + coordinator negotiation +
+// peer-to-peer ring data plane.
 //
 // This is the TPU-host re-design of the reference's core runtime
 // (horovod/common/operations.cc): a tensor table + message queue drained by a
@@ -7,15 +8,21 @@
 // cross-rank consistency (IncrementTensorCount/ConstructResponse,
 // operations.cc:287-523), fusion of small same-dtype tensors
 // (operations.cc:2154-2266), a handle table for async callers
-// (torch/handle_manager.{cc,h}), stall detection
-// (CheckForStalledTensors, operations.cc:1625-1672) and a timeline.
+// (torch/handle_manager.{cc,h}), stall detection with missing-rank lists
+// (CheckForStalledTensors, operations.cc:1625-1672), cross-rank autotuner
+// synchronization (ParameterManager::SyncParams, parameter_manager.cc:213-233)
+// and a timeline.
 //
-// Differences by design (TPU host, no MPI/NCCL):
-// - control plane is a TCP coordinator (Spark-service blueprint, SURVEY §2.6)
-//   instead of MPI_Gatherv/Bcast ticks;
-// - the data plane for this engine is host memory (eager torch/numpy
-//   tensors); the relay carries tensor bytes with the request, so
-//   negotiation + execution complete in one round trip;
+// Architecture (mirrors the reference's control/data-plane split):
+// - control plane: every rank sends a METADATA-ONLY request list to the
+//   rank-0 coordinator each tick and receives the identical ResponseList —
+//   the socket analog of the per-tick MPI_Gatherv + MPI_Bcast
+//   (operations.cc:2088-2109, 2282-2287). The response carries execution
+//   order, fusion assignments, autotuner knobs and stall warnings.
+// - data plane: tensor bytes move only between ring neighbours (ring.h) —
+//   reduce-scatter + allgather for allreduce, exactly the shape of the
+//   reference's NCCL ring (operations.cc:1221-1446). Rank 0 carries O(bytes),
+//   not O(N·bytes): the round-1 star relay is gone.
 // - the compiled JAX path bypasses all of this (XLA collectives).
 #ifndef HVD_ENGINE_H
 #define HVD_ENGINE_H
@@ -37,6 +44,7 @@
 #include "autotuner.h"
 #include "fusion.h"
 #include "hvd_common.h"
+#include "ring.h"
 #include "timeline.h"
 #include "wire.h"
 
@@ -69,12 +77,11 @@ class HandleManager {
   void mark_done(int64_t h, Status status, Response result);
   bool poll(int64_t h);
   // timeout_s < 0: wait forever; == 0: immediate poll. Timeout returns
-  // Aborted WITHOUT consuming the handle (the op is still in flight and its
-  // result must stay claimable — a later wait/release owns it).
+  // IN_PROGRESS WITHOUT consuming the handle (the op is still in flight and
+  // its result must stay claimable — a later wait/release owns it).
   Status wait(int64_t h, double timeout_s);   // leaves result in place
   const Response* peek(int64_t h);
   void release(int64_t h);
-  void fail_all(const std::string& reason);
 
  private:
   std::mutex mu_;
@@ -104,30 +111,46 @@ class Engine {
 
   void shutdown();
   const Topology& topology() const { return topo_; }
-  // Live knob values (autotuner may move them; reference ParameterManager
-  // overrides unless env-pinned, operations.cc:1840-1879).
+  // Live knob values (the coordinator's autotuner broadcasts these; every
+  // rank applies the same values on the same tick).
   double cycle_time_ms() const { return cycle_time_ms_; }
   int64_t fusion_threshold() const { return fusion_threshold_; }
+  uint32_t knob_version() const { return applied_knob_version_; }
+  const RingStats& stats() const { return stats_; }
 
  private:
   struct Entry {
     Request req;
-    int64_t handle;
+    std::vector<uint8_t> data;  // this rank's contribution (host bytes)
+    int64_t handle = 0;
     std::chrono::steady_clock::time_point enqueued;
   };
 
   void loop();                       // reference BackgroundThreadLoop/RunLoopOnce
   void complete_local(Entry& e);     // size==1 fast path
-  void negotiate_and_execute(std::vector<Entry>& batch);
-  void check_stalled();
+  // One cycle of the multi-process path: exchange metadata, execute the
+  // broadcast list over the ring. Returns false when the loop must exit.
+  bool tick_multiprocess(bool shutting);
+  void execute_list(const ResponseList& list);
+  void execute_entry(const ResponseEntry& re);
+  void execute_allreduce(const ResponseEntry& re, std::vector<Entry>& ents);
+  void execute_allgather(const ResponseEntry& re, Entry& ent);
+  void execute_broadcast(const ResponseEntry& re, Entry& ent);
+  void execute_reducescatter(const ResponseEntry& re, Entry& ent);
+  void execute_alltoall(const ResponseEntry& re, Entry& ent);
   void finish(Entry& e, Status st, Response res);  // mark done + release name
+  void fail_everything(const std::string& reason);
 
   Topology topo_;
   EngineConfig cfg_;
   HandleManager handles_;
   Timeline timeline_;
   std::mutex qmu_;
-  std::deque<Entry> queue_;
+  std::deque<Entry> queue_;  // newly enqueued, not yet negotiated
+  // Sent to the coordinator, awaiting a ResponseList entry. Owned by the
+  // loop thread exclusively — no lock (reference tensor_table is the same
+  // idea guarded by its global mutex; here single ownership replaces it).
+  std::map<std::string, Entry> table_;
   // Names queued or in flight: a second enqueue of a live name is a caller
   // bug the reference rejects loudly (test_torch.py:356 duplicate-name test).
   std::set<std::string> inflight_;
@@ -135,59 +158,112 @@ class Engine {
   std::thread bg_;
   std::unique_ptr<Coordinator> coord_;
   std::unique_ptr<Client> client_;
-  std::chrono::steady_clock::time_point last_stall_check_;
-  std::unique_ptr<ParameterManager> pm_;
-  double cycle_time_ms_ = 5.0;
-  int64_t fusion_threshold_ = 64 << 20;
+  RingLinks ring_;
+  RingStats stats_;
+  FusionBuffer fusion_buf_;
+  std::unique_ptr<ParameterManager> pm_;  // single-process tuning only
+  std::atomic<double> cycle_time_ms_{5.0};
+  std::atomic<int64_t> fusion_threshold_{64 << 20};
+  std::atomic<uint32_t> applied_knob_version_{0};
 };
 
 // ---------------------------------------------------------------- coordinator
 
-// Rank-0 control-plane server. Holds the message table (tensor name ->
-// per-rank contributions); when a tensor has contributions from every rank it
-// is validated (ConstructResponse semantics: mismatched op/dtype/shape/root
-// across ranks produce an ERROR response for every rank instead of a
-// deadlock, operations.cc:321-523), executed on the host, and the results
-// are handed back to each rank's serve thread.
+// Rank-0 control-plane server. Per tick it gathers every rank's request
+// list, matches names across ranks in arrival order, validates
+// (ConstructResponse semantics: mismatched op/dtype/shape/root across ranks
+// produce an ERROR response for every rank instead of a deadlock,
+// operations.cc:321-523), plans fusion buckets, tunes knobs, detects stalls
+// with missing-rank lists, and broadcasts one identical ResponseList to all
+// ranks. It never sees tensor bytes.
 class Coordinator {
  public:
   Coordinator(int world, const std::string& host, int port, Timeline* timeline,
-              size_t fusion_threshold);
+              const EngineConfig& cfg);
   ~Coordinator();
   void stop();
 
-  // In-process exchange for rank 0 (no socket round trip).
-  std::vector<Response> exchange(int rank, std::vector<Request> reqs);
+  // Registration: blocks until every rank reported its ring endpoint, then
+  // returns the full peer map (rank-indexed host:port).
+  std::vector<std::pair<std::string, int>> hello(int rank,
+                                                 const std::string& host,
+                                                 int port);
+  // One tick: contribute this rank's request list, block on the generation
+  // barrier, return the broadcast ResponseList. In-process for rank 0,
+  // called from serve threads for the rest.
+  ResponseList tick(int rank, const TickRequest& req);
+  // A rank's connection dropped or it sent shutdown: stop waiting for it.
+  void mark_departed(int rank);
+  // Grace for Engine::shutdown: wait until all ranks departed (or timeout)
+  // so the final ResponseLists get delivered before the listener dies.
+  void await_departure(double timeout_s);
 
  private:
   void accept_loop();
   void serve(int fd);
-  void execute_ready(const std::vector<std::string>& ready);
-  // Returns one Response per rank (broadcast results are identical; scatter
-  // results differ per rank).
-  std::vector<Response> execute(const std::string& name,
-                                std::map<int, Request>& contribs);
+  bool barrier_complete() const;         // callers hold mu_
+  void build_response_list();            // callers hold mu_
+  // Scan the message table for tensors stalled past the warning window and
+  // collect fresh warnings (callers hold mu_). Runs both at barrier
+  // completion and from the 1 s wakeups of waiting ticks, so a rank that
+  // stops ticking entirely still produces diagnostics on rank 0.
+  std::vector<std::string> scan_stalls(std::chrono::steady_clock::time_point now);
+  // Validation; returns an ERROR entry or fills `ok`.
+  bool validate(const std::string& name,
+                const std::map<int, Request>& contribs, ResponseEntry* entry);
+
+  struct PendingTensor {
+    std::map<int, Request> contribs;     // rank -> metadata
+    std::chrono::steady_clock::time_point first_seen;
+    std::chrono::steady_clock::time_point last_warned;
+    bool warned = false;
+  };
 
   int world_;
   int listen_fd_ = -1;
   Timeline* timeline_;
-  size_t fusion_threshold_;
-  FusionBuffer fusion_buf_;
+  EngineConfig cfg_;
+  std::string secret_;
   std::atomic<bool> stop_{false};
   std::thread accept_thread_;
   std::vector<std::thread> serve_threads_;
+  std::vector<int> client_fds_;  // live client sockets, unblocked on stop()
   std::mutex mu_;
   std::condition_variable cv_;
-  std::map<std::string, std::map<int, Request>> pending_;   // message table
-  std::map<std::string, std::vector<Response>> results_;    // per-rank results
-  std::map<std::string, std::set<int>> claimed_;            // ranks that took it
+  // hello stage
+  std::vector<std::pair<std::string, int>> peers_;
+  int hello_count_ = 0;
+  // tick stage
+  uint64_t gen_ = 0;
+  std::set<int> contributed_;
+  std::set<int> departed_;
+  bool shutdown_seen_ = false;
+  ResponseList current_;
+  std::map<std::string, PendingTensor> pending_;   // the message table
+  std::vector<std::string> arrival_order_;
+  // Warnings produced by timer-driven scans while the barrier is stuck;
+  // drained into the next ResponseList so every rank eventually sees them.
+  std::vector<std::string> deferred_warnings_;
+  // knobs (reference ParameterManager::SyncParams: tuned once, applied
+  // everywhere on the same tick — here the tick IS the broadcast)
+  std::unique_ptr<ParameterManager> pm_;
+  uint32_t knob_version_ = 0;
+  int64_t knob_threshold_;
+  double knob_cycle_ms_;
+  std::chrono::steady_clock::time_point last_barrier_;
 };
 
 class Client {
  public:
   Client(const std::string& host, int port, int rank, double timeout_s);
   ~Client();
-  std::vector<Response> exchange(const std::vector<Request>& reqs);
+  // Registration round-trip; returns the rank-indexed peer map.
+  std::vector<std::pair<std::string, int>> hello(const std::string& data_host,
+                                                 int data_port);
+  ResponseList tick(const TickRequest& req);
+  // Local address of the control connection — the interface that routes to
+  // the coordinator, advertised for this rank's ring listener.
+  std::string local_host() const;
 
  private:
   int fd_ = -1;

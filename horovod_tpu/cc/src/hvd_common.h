@@ -170,6 +170,12 @@ inline float bf16_to_float(uint16_t b) {
 inline uint16_t float_to_bf16(float f) {
   uint32_t bits;
   std::memcpy(&bits, &f, 4);
+  if (((bits >> 23) & 0xff) == 0xff) {
+    // inf/NaN: rounding could carry through an all-ones mantissa into the
+    // sign bit (0x7FFFFFFF + 0x8000 -> -0.0), silently zeroing NaNs in
+    // reductions. Preserve the class; quiet the NaN.
+    return (uint16_t)((bits >> 16) | ((bits & 0x7fffff) ? 0x40 : 0));
+  }
   // round-to-nearest-even on the dropped 16 bits
   uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
   return (uint16_t)((bits + rounding) >> 16);

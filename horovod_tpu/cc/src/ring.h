@@ -1,0 +1,365 @@
+// Peer-to-peer ring data plane for the eager engine.
+//
+// This replaces round 1's rank-0 star relay with the bandwidth-optimal
+// topology the reference uses: every rank talks only to its ring
+// neighbours, so per-rank traffic for an allreduce is O(2·bytes·(N-1)/N)
+// regardless of world size — the same property as the NCCL ring allreduce
+// the reference runs on GPUs (operations.cc:1221-1446) and the
+// MPI_Allreduce it runs on CPUs (operations.cc:1491-1586).
+//
+// Topology: rank r owns two TCP links — it connects to rank (r+1)%N
+// ("next") and accepts one authenticated connection from rank (r-1+N)%N
+// ("prev"). All collectives are sequences of (send-to-next ‖
+// recv-from-prev) steps executed in the coordinator-broadcast order, which
+// is identical on every rank, so no message tags are needed and chunk sizes
+// are deterministic on both sides of every transfer (hence no per-chunk
+// framing: a desync is a build/protocol bug, not a runtime condition).
+//
+// Algorithms:
+//   allreduce      = ring reduce-scatter + ring allgather (2(N-1) steps)
+//   reducescatter  = ring reduce-scatter over row-aligned chunks
+//   allgather      = ring allgather over per-rank slots (N-1 steps)
+//   broadcast      = chunked store-and-forward pipeline from the root
+//   alltoall       = shrinking-parcel rotation (chunk for the receiver is
+//                    peeled off the front, the remainder is forwarded)
+#ifndef HVD_RING_H
+#define HVD_RING_H
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "hvd_common.h"
+#include "net.h"
+
+namespace hvd {
+
+struct RingStats {
+  std::atomic<uint64_t> passes{0};      // ring collectives executed
+  std::atomic<uint64_t> bytes_sent{0};  // bytes pushed to the next neighbour
+};
+
+// numpy array_split semantics: the first n % parts chunks get one extra.
+inline std::vector<size_t> split_counts(size_t n, int parts) {
+  std::vector<size_t> out((size_t)parts, n / (size_t)parts);
+  for (size_t i = 0; i < n % (size_t)parts; i++) out[i]++;
+  return out;
+}
+
+inline std::vector<size_t> offsets_of(const std::vector<size_t>& counts) {
+  std::vector<size_t> off(counts.size() + 1, 0);
+  for (size_t i = 0; i < counts.size(); i++) off[i + 1] = off[i] + counts[i];
+  return off;
+}
+
+// The two neighbour links. Establishment is bootstrap-ordered by the
+// coordinator: every rank learns the full (host, port) map in its hello
+// response, then connects to next while accepting from prev.
+class RingLinks {
+ public:
+  RingLinks() = default;
+  ~RingLinks() { close(); }
+
+  // Open the listener before registering with the coordinator, so the
+  // advertised port is live by the time any peer sees it.
+  void open_listener() {
+    listen_fd_ = listen_on("", 0, 4);
+    port_ = bound_port(listen_fd_);
+  }
+  int port() const { return port_; }
+
+  // Connect to next and accept prev (world > 1). Peer addresses come from
+  // the coordinator's hello response. Throws on timeout or auth failure.
+  void establish(int rank, int world,
+                 const std::vector<std::pair<std::string, int>>& peers,
+                 const std::string& secret, double timeout_s = 60.0) {
+    if (world <= 1) return;
+    int next = (rank + 1) % world;
+    int prev = (rank - 1 + world) % world;
+    std::string conn_error;
+    std::thread connector([&] {
+      try {
+        int fd = connect_to(peers[(size_t)next].first, peers[(size_t)next].second,
+                            timeout_s);
+        auth_connect(fd, secret, "hvd-ring");
+        int32_t my_rank = rank;
+        send_all(fd, &my_rank, 4);
+        next_fd_ = fd;
+      } catch (const std::exception& ex) {
+        conn_error = ex.what();
+      }
+    });
+    try {
+      // Accept until the authenticated prev neighbour shows up; reject
+      // strangers (wrong MAC or wrong claimed rank).
+      auto deadline = std::chrono::steady_clock::now() +
+                      std::chrono::duration<double>(timeout_s);
+      while (prev_fd_ < 0) {
+        if (std::chrono::steady_clock::now() > deadline)
+          throw std::runtime_error("timed out waiting for ring neighbour " +
+                                   std::to_string(prev));
+        pollfd p{listen_fd_, POLLIN, 0};
+        int rc = ::poll(&p, 1, 200);
+        if (rc <= 0) continue;
+        int fd = ::accept(listen_fd_, nullptr, nullptr);
+        if (fd < 0) continue;
+        // Bound the handshake: a connection that sends nothing (scanner,
+        // probe, hostile peer) must not wedge init past the deadline.
+        timeval tv{10, 0};
+        ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+        ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+        if (!auth_accept(fd, secret, "hvd-ring")) {
+          ::close(fd);
+          continue;
+        }
+        int32_t claimed = -1;
+        try {
+          recv_all(fd, &claimed, 4);
+        } catch (const std::exception&) {
+          ::close(fd);
+          continue;
+        }
+        if (claimed != prev) {
+          ::close(fd);
+          continue;
+        }
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        // Handshake done: drop the short deadline; ring transfers use
+        // poll-based timeouts of their own (duplex).
+        timeval none{0, 0};
+        ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &none, sizeof(none));
+        ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &none, sizeof(none));
+        prev_fd_ = fd;
+      }
+    } catch (...) {
+      connector.join();
+      throw;
+    }
+    connector.join();
+    if (next_fd_ < 0)
+      throw std::runtime_error("ring connect to rank " + std::to_string(next) +
+                               " failed: " + conn_error);
+  }
+
+  void close() {
+    for (int* fd : {&prev_fd_, &next_fd_, &listen_fd_}) {
+      if (*fd >= 0) {
+        ::close(*fd);
+        *fd = -1;
+      }
+    }
+  }
+
+  bool active() const { return next_fd_ >= 0 && prev_fd_ >= 0; }
+
+  void transfer(const uint8_t* out, size_t n, uint8_t* in, size_t m,
+                RingStats* stats) {
+    duplex(next_fd_, out, n, prev_fd_, in, m);
+    if (stats) stats->bytes_sent += n;
+  }
+  void send(const uint8_t* p, size_t n, RingStats* stats) {
+    send_all(next_fd_, p, n);
+    if (stats) stats->bytes_sent += n;
+  }
+  void recv(uint8_t* p, size_t n) { recv_all(prev_fd_, p, n); }
+
+ private:
+  int listen_fd_ = -1;
+  int prev_fd_ = -1;
+  int next_fd_ = -1;
+  int port_ = 0;
+};
+
+// ------------------------------------------------------------ typed arithmetic
+// Ring reduction runs in a "work dtype": f16/bf16 buffers are pre-converted
+// to f32 by the engine (the reference reduces fp16 through a f32-accumulating
+// custom MPI op for the same reason, half.h:135), so only these types appear.
+
+template <typename T>
+static void add_chunk_t(uint8_t* dst, const uint8_t* src, size_t count) {
+  T* d = (T*)dst;
+  const T* s = (const T*)src;
+  for (size_t i = 0; i < count; i++) d[i] += s[i];
+}
+
+inline void add_chunk(DataType t, uint8_t* dst, const uint8_t* src,
+                      size_t count) {
+  switch (t) {
+    case DataType::F32: add_chunk_t<float>(dst, src, count); return;
+    case DataType::F64: add_chunk_t<double>(dst, src, count); return;
+    case DataType::I32: add_chunk_t<int32_t>(dst, src, count); return;
+    case DataType::I64: add_chunk_t<int64_t>(dst, src, count); return;
+    case DataType::U8:
+    case DataType::BOOL: add_chunk_t<uint8_t>(dst, src, count); return;
+    case DataType::I8: add_chunk_t<int8_t>(dst, src, count); return;
+    default:
+      throw std::runtime_error("ring reduction on unsupported work dtype");
+  }
+}
+
+template <typename T>
+static void scale_chunk_t(uint8_t* p, size_t count, int world) {
+  T* d = (T*)p;
+  for (size_t i = 0; i < count; i++) d[i] = (T)(d[i] / (T)world);
+}
+
+inline void scale_chunk(DataType t, uint8_t* p, size_t count, int world) {
+  switch (t) {
+    case DataType::F32: scale_chunk_t<float>(p, count, world); return;
+    case DataType::F64: scale_chunk_t<double>(p, count, world); return;
+    case DataType::I32: scale_chunk_t<int32_t>(p, count, world); return;
+    case DataType::I64: scale_chunk_t<int64_t>(p, count, world); return;
+    case DataType::U8:
+    case DataType::BOOL: scale_chunk_t<uint8_t>(p, count, world); return;
+    case DataType::I8: scale_chunk_t<int8_t>(p, count, world); return;
+    default:
+      throw std::runtime_error("ring scaling on unsupported work dtype");
+  }
+}
+
+// ----------------------------------------------------------------- collectives
+
+// Ring reduce-scatter over explicit element chunks (counts/offs in elements).
+// After N-1 steps rank r holds the fully reduced chunk r. Flat equal-ish
+// chunks give allreduce; row-aligned chunks give reducescatter semantics.
+inline void ring_reduce_scatter(RingLinks& links, int rank, int world,
+                                uint8_t* buf, const std::vector<size_t>& counts,
+                                const std::vector<size_t>& offs, size_t esize,
+                                DataType work, RingStats* stats) {
+  size_t max_chunk = 0;
+  for (auto c : counts) max_chunk = std::max(max_chunk, c);
+  std::vector<uint8_t> scratch(max_chunk * esize);
+  auto mod = [&](int v) { return ((v % world) + world) % world; };
+  for (int s = 0; s < world - 1; s++) {
+    int send_idx = mod(rank - 1 - s);
+    int recv_idx = mod(rank - 2 - s);
+    links.transfer(buf + offs[(size_t)send_idx] * esize,
+                   counts[(size_t)send_idx] * esize, scratch.data(),
+                   counts[(size_t)recv_idx] * esize, stats);
+    add_chunk(work, buf + offs[(size_t)recv_idx] * esize, scratch.data(),
+              counts[(size_t)recv_idx]);
+  }
+}
+
+// Ring allgather over chunks: rank r starts owning chunk r (complete) and
+// after N-1 steps every rank holds every chunk. Receives land directly in
+// the destination buffer — no scratch copy.
+inline void ring_allgather(RingLinks& links, int rank, int world, uint8_t* buf,
+                           const std::vector<size_t>& counts,
+                           const std::vector<size_t>& offs, size_t esize,
+                           RingStats* stats) {
+  auto mod = [&](int v) { return ((v % world) + world) % world; };
+  for (int s = 0; s < world - 1; s++) {
+    int send_idx = mod(rank - s);
+    int recv_idx = mod(rank - s - 1);
+    links.transfer(buf + offs[(size_t)send_idx] * esize,
+                   counts[(size_t)send_idx] * esize,
+                   buf + offs[(size_t)recv_idx] * esize,
+                   counts[(size_t)recv_idx] * esize, stats);
+  }
+}
+
+// Full ring allreduce: reduce-scatter, scale own chunk (average), allgather.
+inline void ring_allreduce(RingLinks& links, int rank, int world, uint8_t* buf,
+                           size_t count, size_t esize, DataType work,
+                           bool average, RingStats* stats) {
+  if (stats) stats->passes++;
+  auto counts = split_counts(count, world);
+  auto offs = offsets_of(counts);
+  ring_reduce_scatter(links, rank, world, buf, counts, offs, esize, work, stats);
+  if (average) {
+    scale_chunk(work, buf + offs[(size_t)rank] * esize, counts[(size_t)rank],
+                world);
+  }
+  ring_allgather(links, rank, world, buf, counts, offs, esize, stats);
+}
+
+// Chunked store-and-forward pipeline broadcast. The root pushes ~1 MiB
+// chunks to next; intermediate ranks forward chunk c-1 while receiving
+// chunk c (duplex), so all N-1 hops stream concurrently.
+inline void ring_broadcast(RingLinks& links, int rank, int world, int root,
+                           uint8_t* buf, size_t nbytes, RingStats* stats) {
+  if (world <= 1 || nbytes == 0) return;  // empty tensor: nothing on the wire
+  if (stats) stats->passes++;
+  constexpr size_t kChunk = 1 << 20;
+  int dist = ((rank - root) % world + world) % world;
+  size_t nchunks = (nbytes + kChunk - 1) / kChunk;
+  auto chunk_at = [&](size_t c) {
+    size_t off = c * kChunk;
+    return std::make_pair(buf + off, std::min(kChunk, nbytes - off));
+  };
+  if (dist == 0) {
+    for (size_t c = 0; c < nchunks; c++) {
+      auto [p, n] = chunk_at(c);
+      links.send(p, n, stats);
+    }
+  } else if (dist == world - 1) {
+    for (size_t c = 0; c < nchunks; c++) {
+      auto [p, n] = chunk_at(c);
+      links.recv(p, n);
+    }
+  } else {
+    for (size_t c = 0; c < nchunks; c++) {
+      auto [p, n] = chunk_at(c);
+      if (c == 0) {
+        links.recv(p, n);
+      } else {
+        auto [pp, pn] = chunk_at(c - 1);
+        links.transfer(pp, pn, p, n, stats);
+      }
+    }
+    auto [lp, ln] = chunk_at(nchunks - 1);
+    links.send(lp, ln, stats);
+  }
+}
+
+// Shrinking-parcel ring alltoall. `in` holds this rank's input split into
+// world destination chunks (row-aligned, sizes in dest_bytes); `out` must
+// have world origin slots of dest_bytes[rank] each (out[o] = origin o's
+// chunk addressed to this rank). Per-link traffic is sum_{s=1}^{N-1}
+// (parcel_s) ≈ N/2 · input bytes — acceptable for the eager/host path; the
+// compiled path uses XLA's all_to_all over ICI instead.
+inline void ring_alltoall(RingLinks& links, int rank, int world,
+                          const uint8_t* in,
+                          const std::vector<size_t>& dest_bytes,
+                          const std::vector<size_t>& dest_offs, uint8_t* out,
+                          RingStats* stats) {
+  if (stats) stats->passes++;
+  auto mod = [&](int v) { return ((v % world) + world) % world; };
+  size_t my_bytes = dest_bytes[(size_t)rank];
+  // own chunk: straight copy into slot `rank`
+  std::memcpy(out + (size_t)rank * my_bytes, in + dest_offs[(size_t)rank],
+              my_bytes);
+  // first parcel: my chunks for destinations at distance 1..N-1, in
+  // increasing distance order
+  std::vector<uint8_t> parcel;
+  for (int d = 1; d < world; d++) {
+    int dest = mod(rank + d);
+    parcel.insert(parcel.end(), in + dest_offs[(size_t)dest],
+                  in + dest_offs[(size_t)dest] + dest_bytes[(size_t)dest]);
+  }
+  std::vector<uint8_t> incoming;
+  for (int s = 1; s < world; s++) {
+    int origin = mod(rank - s);
+    // incoming parcel = origin's chunks for distances s..N-1, i.e. for
+    // destinations rank, rank+1, ..., in that order
+    size_t in_size = 0;
+    for (int t = s; t < world; t++) in_size += dest_bytes[(size_t)mod(origin + t)];
+    incoming.resize(in_size);
+    links.transfer(parcel.data(), parcel.size(), incoming.data(), in_size,
+                   stats);
+    // peel off the front chunk (addressed to me, from `origin`)
+    std::memcpy(out + (size_t)origin * my_bytes, incoming.data(), my_bytes);
+    // forward the remainder next step
+    parcel.assign(incoming.begin() + (ptrdiff_t)my_bytes, incoming.end());
+  }
+}
+
+}  // namespace hvd
+
+#endif  // HVD_RING_H

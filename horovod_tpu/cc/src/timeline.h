@@ -114,6 +114,31 @@ class Timeline {
     }
   }
 
+  // Tensor names come from user code: escape them so a quote or backslash
+  // cannot corrupt the trace JSON.
+  static std::string json_escape(const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    for (char c : s) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\n': out += "\\n"; break;
+        case '\t': out += "\\t"; break;
+        case '\r': out += "\\r"; break;
+        default:
+          if ((unsigned char)c < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+          } else {
+            out += c;
+          }
+      }
+    }
+    return out;
+  }
+
   void write_event(const Event& e) {
     int pid = pid_for(e.tensor);
     if (e.phase == 'E') {
@@ -122,7 +147,8 @@ class Timeline {
     } else {
       std::fprintf(file_,
                    "{\"ph\":\"%c\",\"pid\":%d,\"ts\":%lld,\"name\":\"%s\"%s},\n",
-                   e.phase, pid, (long long)e.ts_us, e.name.c_str(),
+                   e.phase, pid, (long long)e.ts_us,
+                   json_escape(e.name).c_str(),
                    e.phase == 'i' ? ",\"s\":\"p\"" : "");
     }
     std::fflush(file_);
@@ -137,7 +163,7 @@ class Timeline {
     std::fprintf(file_,
                  "{\"ph\":\"M\",\"pid\":%d,\"name\":\"process_name\","
                  "\"args\":{\"name\":\"%s\"}},\n",
-                 pid, tensor.c_str());
+                 pid, json_escape(tensor).c_str());
     return pid;
   }
 

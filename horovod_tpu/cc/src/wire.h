@@ -3,10 +3,16 @@
 // The reference serializes Request/RequestList/Response/ResponseList with
 // FlatBuffers (horovod/common/wire/message.fbs:41-101, message.{cc,h}).
 // Here the schema is the same shape — Request{rank, op, dtype, name, root,
-// shape}, Response{type, names, error, sizes} — but the encoding is a plain
+// shape}, RequestList{shutdown}, Response{type, tensor_names, error,
+// tensor_sizes}, ResponseList{shutdown} — but the encoding is a plain
 // length-prefixed little-endian stream: the messages are rank-local,
 // version-locked to the build, and never persisted, so a schema compiler
 // buys nothing on TPU hosts.
+//
+// Unlike round 1, requests carry METADATA ONLY: tensor bytes never transit
+// the coordinator. The data plane is the peer-to-peer ring (ring.h), which
+// matches the reference's split between the MPI control plane and the
+// MPI/NCCL data plane (operations.cc:2030-2380 vs 1221-1586).
 #ifndef HVD_WIRE_H
 #define HVD_WIRE_H
 
@@ -34,10 +40,6 @@ class Writer {
     u32((uint32_t)s.size());
     raw(s.data(), s.size());
   }
-  void bytes(const void* p, size_t n) {
-    u64(n);
-    raw(p, n);
-  }
   void raw(const void* p, size_t n) {
     const uint8_t* c = (const uint8_t*)p;
     buf.insert(buf.end(), c, c + n);
@@ -59,11 +61,6 @@ class Reader {
     const uint8_t* p = take(n);
     return std::string((const char*)p, n);
   }
-  std::vector<uint8_t> bytes() {
-    uint64_t n = u64();
-    const uint8_t* p = take(n);
-    return std::vector<uint8_t>(p, p + n);
-  }
   bool done() const { return off_ == n_; }
 
  private:
@@ -78,7 +75,8 @@ class Reader {
   size_t off_ = 0;
 };
 
-// A collective request from one rank (reference message.h:44-120).
+// A collective request from one rank — metadata only (reference
+// message.h:44-120).
 struct Request {
   int32_t rank = 0;
   OpType op = OpType::ALLREDUCE;
@@ -87,13 +85,13 @@ struct Request {
   int32_t root_rank = 0;
   uint8_t average = 1;
   std::vector<int64_t> shape;
-  std::vector<uint8_t> data;  // relay data plane: tensor bytes ride along
 
   size_t elements() const {
     size_t n = 1;
     for (auto d : shape) n *= (size_t)d;
     return n;
   }
+  size_t nbytes() const { return elements() * dtype_size(dtype); }
 
   void write(Writer& w) const {
     w.i32(rank);
@@ -104,7 +102,6 @@ struct Request {
     w.u8(average);
     w.u8((uint8_t)shape.size());
     for (auto d : shape) w.i64(d);
-    w.bytes(data.data(), data.size());
   }
   static Request read(Reader& r) {
     Request q;
@@ -117,13 +114,131 @@ struct Request {
     uint8_t nd = r.u8();
     q.shape.resize(nd);
     for (int i = 0; i < nd; i++) q.shape[i] = r.i64();
-    q.data = r.bytes();
     return q;
   }
 };
 
-// Result for one tensor (reference Response, message.h:146-209: OK with
-// payload metadata, or ERROR with reason delivered to every rank).
+// One rank's per-tick message list (reference RequestList, message.h:122-144:
+// requests + shutdown flag).
+struct TickRequest {
+  int32_t rank = 0;
+  uint8_t shutdown = 0;
+  std::vector<Request> reqs;
+
+  void write(Writer& w) const {
+    w.i32(rank);
+    w.u8(shutdown);
+    w.u32((uint32_t)reqs.size());
+    for (auto& q : reqs) q.write(w);
+  }
+  static TickRequest read(Reader& r) {
+    TickRequest t;
+    t.rank = r.i32();
+    t.shutdown = r.u8();
+    uint32_t n = r.u32();
+    t.reqs.reserve(n);
+    for (uint32_t i = 0; i < n; i++) t.reqs.push_back(Request::read(r));
+    return t;
+  }
+};
+
+// One execution order from the coordinator: a single tensor, or a fused
+// bucket of same-dtype allreduces (reference Response.tensor_names after the
+// fusion loop, operations.cc:2154-2266). Carries no tensor bytes — every
+// rank already holds its contribution; this tells it what to run, in what
+// order, against the ring.
+struct ResponseEntry {
+  enum Kind : uint8_t { OK = 0, ERROR = 1 };
+  Kind kind = OK;
+  OpType op = OpType::ALLREDUCE;
+  std::vector<std::string> names;
+  std::string error;                 // ERROR only, delivered to every rank
+  DataType dtype = DataType::F32;
+  int32_t root_rank = 0;             // broadcast
+  uint8_t average = 1;               // allreduce / reducescatter
+  // allgather: first-dimension size contributed by each rank, in rank order
+  // (reference Response.tensor_sizes, message.h:188-195).
+  std::vector<int64_t> tensor_sizes;
+  // Coordinator-local scratch for the fusion planner (per-rank payload in
+  // work-dtype bytes); never serialized.
+  int64_t fused_nbytes = 0;
+
+  void write(Writer& w) const {
+    w.u8((uint8_t)kind);
+    w.u8((uint8_t)op);
+    w.u32((uint32_t)names.size());
+    for (auto& n : names) w.str(n);
+    if (kind == ERROR) {
+      w.str(error);
+      return;
+    }
+    w.u8((uint8_t)dtype);
+    w.i32(root_rank);
+    w.u8(average);
+    w.u32((uint32_t)tensor_sizes.size());
+    for (auto v : tensor_sizes) w.i64(v);
+  }
+  static ResponseEntry read(Reader& r) {
+    ResponseEntry e;
+    e.kind = (Kind)r.u8();
+    e.op = (OpType)r.u8();
+    uint32_t n = r.u32();
+    e.names.reserve(n);
+    for (uint32_t i = 0; i < n; i++) e.names.push_back(r.str());
+    if (e.kind == ERROR) {
+      e.error = r.str();
+      return e;
+    }
+    e.dtype = (DataType)r.u8();
+    e.root_rank = r.i32();
+    e.average = r.u8();
+    uint32_t m = r.u32();
+    e.tensor_sizes.resize(m);
+    for (uint32_t i = 0; i < m; i++) e.tensor_sizes[i] = r.i64();
+    return e;
+  }
+};
+
+// The coordinator's per-tick broadcast (reference ResponseList,
+// message.h:211-234, plus the parameter sync the reference does over
+// MPI_Bcast in ParameterManager::SyncParams, parameter_manager.cc:213-233,
+// and the stall warnings of CheckForStalledTensors, operations.cc:1625-1672
+// — here surfaced to every rank, not just the coordinator's stderr).
+struct ResponseList {
+  uint8_t shutdown = 0;
+  uint32_t knob_version = 0;         // bumps when the autotuner moves knobs
+  int64_t fusion_threshold = 0;
+  double cycle_time_ms = 0.0;
+  std::vector<std::string> stall_warnings;
+  std::vector<ResponseEntry> entries;
+
+  void write(Writer& w) const {
+    w.u8(shutdown);
+    w.u32(knob_version);
+    w.i64(fusion_threshold);
+    w.f64(cycle_time_ms);
+    w.u32((uint32_t)stall_warnings.size());
+    for (auto& s : stall_warnings) w.str(s);
+    w.u32((uint32_t)entries.size());
+    for (auto& e : entries) e.write(w);
+  }
+  static ResponseList read(Reader& r) {
+    ResponseList l;
+    l.shutdown = r.u8();
+    l.knob_version = r.u32();
+    l.fusion_threshold = r.i64();
+    l.cycle_time_ms = r.f64();
+    uint32_t ns = r.u32();
+    l.stall_warnings.reserve(ns);
+    for (uint32_t i = 0; i < ns; i++) l.stall_warnings.push_back(r.str());
+    uint32_t n = r.u32();
+    l.entries.reserve(n);
+    for (uint32_t i = 0; i < n; i++) l.entries.push_back(ResponseEntry::read(r));
+    return l;
+  }
+};
+
+// A completed tensor handed back to the caller through the handle table.
 struct Response {
   enum Kind : uint8_t { OK = 0, ERROR = 1 };
   Kind kind = OK;
@@ -132,34 +247,6 @@ struct Response {
   DataType dtype = DataType::F32;
   std::vector<int64_t> shape;
   std::vector<uint8_t> data;
-
-  void write(Writer& w) const {
-    w.u8((uint8_t)kind);
-    w.str(name);
-    if (kind == ERROR) {
-      w.str(error);
-      return;
-    }
-    w.u8((uint8_t)dtype);
-    w.u8((uint8_t)shape.size());
-    for (auto d : shape) w.i64(d);
-    w.bytes(data.data(), data.size());
-  }
-  static Response read(Reader& r) {
-    Response res;
-    res.kind = (Kind)r.u8();
-    res.name = r.str();
-    if (res.kind == ERROR) {
-      res.error = r.str();
-      return res;
-    }
-    res.dtype = (DataType)r.u8();
-    uint8_t nd = r.u8();
-    res.shape.resize(nd);
-    for (int i = 0; i < nd; i++) res.shape[i] = r.i64();
-    res.data = r.bytes();
-    return res;
-  }
 };
 
 }  // namespace hvd

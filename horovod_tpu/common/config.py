@@ -65,6 +65,7 @@ class Config:
     autotune: bool = False                                # HOROVOD_AUTOTUNE
     autotune_log: str = ""                                # HOROVOD_AUTOTUNE_LOG
     stall_check_disable: bool = False                     # HOROVOD_STALL_CHECK_DISABLE
+    stall_warning_s: float = STALL_WARNING_TIME_S         # HOROVOD_STALL_WARNING_TIME
     hierarchical_allreduce: bool = False                  # HOROVOD_HIERARCHICAL_ALLREDUCE
     hierarchical_allgather: bool = False                  # HOROVOD_HIERARCHICAL_ALLGATHER
     log_level: str = "warning"                            # HOROVOD_LOG_LEVEL
@@ -83,6 +84,7 @@ class Config:
             autotune=_env_bool("HOROVOD_AUTOTUNE"),
             autotune_log=os.environ.get("HOROVOD_AUTOTUNE_LOG", ""),
             stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
+            stall_warning_s=_env_float("HOROVOD_STALL_WARNING_TIME", STALL_WARNING_TIME_S),
             hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
             log_level=os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(),

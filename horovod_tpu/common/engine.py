@@ -17,18 +17,28 @@ Two implementations behind one interface:
 
 Control plane: rank 0 is coordinator over TCP (replaces the per-tick
 MPI_Gather/MPI_Bcast of RequestLists/ResponseLists, operations.cc:2088-2109,
-2282-2287). Data plane: the coordinator relays reduced buffers (correct,
-simple); the native engine upgrades this to a ring.
+2282-2287). Data plane: the coordinator relays reduced buffers — a correct,
+simple star that is O(N*bytes) through rank 0 per collective, which is why
+this engine is the *fallback*: the native engine (horovod_tpu/cc) moves
+tensor bytes over a peer-to-peer ring with a metadata-only control plane
+and is the default in multi-process worlds.
+
+Every frame on this channel is authenticated: HMAC-SHA256 over the pickled
+payload, keyed by the launcher-distributed ``HOROVOD_SECRET``, verified
+before unpickling (the repo rule set by runner/network.py: never unpickle
+unauthenticated bytes), with a hard payload cap against allocation abuse.
 """
 
 from __future__ import annotations
 
+import hmac
 import os
 import pickle
 import socket
 import struct
 import threading
 import time
+from hashlib import sha256
 from typing import Any, Optional
 
 import numpy as np
@@ -51,9 +61,22 @@ class TensorShapeMismatchError(HorovodInternalError):
 
 # ---------------------------------------------------------------- wire helpers
 
-def _send_msg(sock: socket.socket, obj: Any) -> None:
+# Cap on a single frame (same role as the native engine's
+# HOROVOD_MAX_FRAME_BYTES): a peer-claimed length above this aborts the
+# connection instead of allocating.
+_MAX_PAYLOAD = int(os.environ.get("HOROVOD_MAX_FRAME_BYTES", str(8 << 30)))
+_DIGEST_LEN = 32
+
+
+def _secret_from_env() -> bytes:
+    s = os.environ.get("HOROVOD_SECRET", "")
+    return s.encode() if s else b""
+
+
+def _send_msg(sock: socket.socket, obj: Any, key: bytes) -> None:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+    digest = hmac.new(key, payload, sha256).digest()
+    sock.sendall(digest + struct.pack("!Q", len(payload)) + payload)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -66,9 +89,17 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
-def _recv_msg(sock: socket.socket) -> Any:
+def _recv_msg(sock: socket.socket, key: bytes) -> Any:
+    digest = _recv_exact(sock, _DIGEST_LEN)
     (n,) = struct.unpack("!Q", _recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, n))
+    if n > _MAX_PAYLOAD:
+        raise ConnectionError(
+            f"frame length {n} exceeds HOROVOD_MAX_FRAME_BYTES cap")
+    payload = _recv_exact(sock, n)
+    if not hmac.compare_digest(digest, hmac.new(key, payload, sha256).digest()):
+        # Authentication failed: drop the connection without ever unpickling.
+        raise ConnectionError("frame failed HOROVOD_SECRET authentication")
+    return pickle.loads(payload)
 
 
 # ------------------------------------------------------------------ handles
@@ -143,11 +174,19 @@ class PyEngine:
                     "multi-process eager collectives need HOROVOD_COORD_ADDR "
                     "(set by the horovod_tpu launcher)"
                 )
+            key = _secret_from_env()
+            if not key:
+                raise HorovodInternalError(
+                    "the Python eager engine authenticates its coordinator "
+                    "channel with HOROVOD_SECRET, which is unset; launch "
+                    "through the horovod_tpu runner (which distributes it) "
+                    "or export the same secret on every rank"
+                )
             host, port = addr.rsplit(":", 1)
             if topo.rank == 0:
-                self._coord = _Coordinator(topo.size, host, int(port))
+                self._coord = _Coordinator(topo.size, host, int(port), key=key)
                 self._coord.start()
-            self._client = _Client(host, int(port), topo.rank)
+            self._client = _Client(host, int(port), topo.rank, key=key)
         self._thread = threading.Thread(
             target=self._loop, name="horovod_tpu_engine", daemon=True
         )
@@ -233,8 +272,9 @@ class PyEngine:
                     self._complete_local(e)
             else:
                 self._negotiate_and_execute(batch)
+            stall_s = getattr(self.config, "stall_warning_s", STALL_WARNING_TIME_S)
             if (not self.config.stall_check_disable
-                    and time.monotonic() - last_stall_check > STALL_WARNING_TIME_S):
+                    and time.monotonic() - last_stall_check > stall_s):
                 self._check_stalled()
                 last_stall_check = time.monotonic()
 
@@ -244,18 +284,14 @@ class PyEngine:
         self.handles.mark_done(e["handle"], error, result)
 
     def _complete_local(self, e: dict) -> None:
+        # Single-process world: every collective is the identity — the
+        # average of one, the gather of one, the broadcast from self, and
+        # the scatter of the whole array to the only rank.
         name, arr = e["name"], e["array"]
         if self._timeline:
             self._timeline.start(name, e["op"].upper())
-        if e["op"] == "allgather":
-            result = arr
-        elif e["op"] == "alltoall":
-            result = arr
-        else:
-            result = arr
-        if self._timeline:
             self._timeline.end(name)
-        self._finish(e, None, result)
+        self._finish(e, None, arr)
 
     def _negotiate_and_execute(self, batch: list[dict]) -> None:
         # Workers ship their request list to the coordinator (MPI_Gatherv
@@ -294,14 +330,15 @@ class PyEngine:
     def _check_stalled(self) -> None:
         """Reference CheckForStalledTensors (operations.cc:1625-1672)."""
         now = time.monotonic()
+        stall_s = getattr(self.config, "stall_warning_s", STALL_WARNING_TIME_S)
         with self._lock:
-            stalled = [e["name"] for e in self._queue if now - e["t"] > STALL_WARNING_TIME_S]
+            stalled = [e["name"] for e in self._queue if now - e["t"] > stall_s]
         if stalled:
             log(
                 "warning",
                 "One or more tensors were submitted to be reduced, gathered or "
                 "broadcasted by subset of ranks and are waiting for remainder of "
-                f"ranks for more than {int(STALL_WARNING_TIME_S)} seconds. Stalled ops: "
+                f"ranks for more than {int(stall_s)} seconds. Stalled ops: "
                 + ", ".join(stalled),
                 rank=self.topo.rank,
             )
@@ -315,8 +352,13 @@ class _Coordinator:
     returns results. Plays the reference's coordinator role
     (IncrementTensorCount/ConstructResponse, operations.cc:287-523)."""
 
-    def __init__(self, world: int, host: str, port: int) -> None:
+    def __init__(self, world: int, host: str, port: int,
+                 key: bytes = b"") -> None:
         self.world = world
+        self.key = key or _secret_from_env()
+        if not self.key:
+            raise HorovodInternalError(
+                "coordinator requires a shared HOROVOD_SECRET key")
         self.server = socket.create_server((host, port), backlog=world + 4, reuse_port=False)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -352,14 +394,21 @@ class _Coordinator:
     def _serve(self, conn: socket.socket) -> None:
         try:
             while not self._stop.is_set():
-                msg = _recv_msg(conn)
+                msg = _recv_msg(conn, self.key)
                 if msg["kind"] == "exchange":
                     out = self._handle_exchange(msg["rank"], msg["requests"], msg["arrays"])
-                    _send_msg(conn, out)
+                    _send_msg(conn, out, self.key)
                 elif msg["kind"] == "bye":
                     return
         except (ConnectionError, EOFError, OSError):
             return
+        finally:
+            # Always close — in particular on auth failure, so the peer sees
+            # a clean rejection instead of a hung connection.
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _handle_exchange(self, rank: int, requests: list[dict], arrays: dict) -> dict:
         ready: list[str] = []
@@ -444,8 +493,13 @@ class _Coordinator:
 
 
 class _Client:
-    def __init__(self, host: str, port: int, rank: int) -> None:
+    def __init__(self, host: str, port: int, rank: int,
+                 key: bytes = b"") -> None:
         self.rank = rank
+        self.key = key or _secret_from_env()
+        if not self.key:
+            raise HorovodInternalError(
+                "client requires a shared HOROVOD_SECRET key")
         deadline = time.monotonic() + 60.0
         last: Optional[Exception] = None
         while time.monotonic() < deadline:
@@ -463,8 +517,9 @@ class _Client:
     def exchange(self, requests: list[dict], arrays: dict) -> dict:
         with self._lock:
             _send_msg(self.sock, {"kind": "exchange", "rank": self.rank,
-                                  "requests": requests, "arrays": arrays})
-            out = _recv_msg(self.sock)
+                                  "requests": requests, "arrays": arrays},
+                      self.key)
+            out = _recv_msg(self.sock, self.key)
         # Unwrap per-rank results (reducescatter / alltoall)
         for name, (err, val) in list(out.items()):
             if err is None and isinstance(val, dict) and "__per_rank__" in val:
@@ -473,7 +528,7 @@ class _Client:
 
     def close(self) -> None:
         try:
-            _send_msg(self.sock, {"kind": "bye"})
+            _send_msg(self.sock, {"kind": "bye"}, self.key)
             self.sock.close()
         except OSError:
             pass

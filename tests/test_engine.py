@@ -80,9 +80,12 @@ def test_shutdown_fails_pending():
 WORLD = 4
 
 
+KEY = b"test-secret"
+
+
 def run_ranks(fn):
     """Run fn(rank, client) on WORLD threads against one coordinator."""
-    coord = _Coordinator(WORLD, "127.0.0.1", 0)
+    coord = _Coordinator(WORLD, "127.0.0.1", 0, key=KEY)
     port = coord.server.getsockname()[1]
     coord.start()
     results: dict[int, object] = {}
@@ -90,7 +93,7 @@ def run_ranks(fn):
 
     def worker(rank):
         try:
-            client = _Client("127.0.0.1", port, rank)
+            client = _Client("127.0.0.1", port, rank, key=KEY)
             try:
                 results[rank] = fn(rank, client)
             finally:
@@ -200,3 +203,43 @@ def test_coordinator_alltoall_reducescatter():
         assert err is None
         np.testing.assert_allclose(
             val, WORLD * np.arange(WORLD * 2, dtype=np.float64)[r * 2:(r + 1) * 2])
+
+
+def test_coordinator_rejects_unauthenticated_frames():
+    """A frame with a bad HMAC must be dropped without unpickling (ADVICE
+    high: the round-1 channel unpickled unauthenticated bytes — remote code
+    execution via pickle). The authenticated client still works after."""
+    import socket as socket_mod
+    import struct as struct_mod
+
+    coord = _Coordinator(1, "127.0.0.1", 0, key=KEY)
+    port = coord.server.getsockname()[1]
+    coord.start()
+    try:
+        # attacker: valid pickle, wrong key
+        raw = socket_mod.create_connection(("127.0.0.1", port), timeout=5)
+        import pickle as pickle_mod
+
+        payload = pickle_mod.dumps({"kind": "exchange", "rank": 0,
+                                    "requests": [], "arrays": {}})
+        import hmac as hmac_mod
+        from hashlib import sha256 as sha256_mod
+
+        bad_digest = hmac_mod.new(b"wrong-key", payload, sha256_mod).digest()
+        raw.sendall(bad_digest + struct_mod.pack("!Q", len(payload)) + payload)
+        # server must close the connection without answering
+        raw.settimeout(5)
+        assert raw.recv(1) == b"", "coordinator answered an unauthenticated frame"
+        raw.close()
+        # a properly keyed client is unaffected
+        client = _Client("127.0.0.1", port, 0, key=KEY)
+        out = client.exchange(
+            [{"name": "t", "op": "allreduce", "shape": (2,),
+              "dtype": "float64", "root": 0, "average": False}],
+            {"t": np.ones(2)})
+        err, val = out["t"]
+        assert err is None
+        np.testing.assert_allclose(val, np.ones(2))
+        client.close()
+    finally:
+        coord.stop()

@@ -126,7 +126,10 @@ def free_port() -> int:
 
 
 def launch_world(world: int, script: str, extra_env=None):
+    import secrets as secrets_mod
+
     port = free_port()
+    secret = secrets_mod.token_hex(16)
     procs = []
     for rank in range(world):
         env = dict(os.environ)
@@ -135,6 +138,7 @@ def launch_world(world: int, script: str, extra_env=None):
             "HOROVOD_RANK": str(rank),
             "HOROVOD_SIZE": str(world),
             "HOROVOD_COORD_ADDR": f"127.0.0.1:{port}",
+            "HOROVOD_SECRET": secret,
         })
         env.update(extra_env or {})
         procs.append(subprocess.Popen(
