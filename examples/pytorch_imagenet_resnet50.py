@@ -1,0 +1,207 @@
+"""ImageNet-style ResNet training with checkpoint/resume — the end-to-end
+resume story (reference examples/pytorch_imagenet_resnet50.py:60-100: resume
+-epoch discovery, broadcast of the resume epoch, rank-0 checkpointing,
+broadcast_parameters + broadcast_optimizer_state after restore, gradual LR
+warmup per Goyal et al. arXiv:1706.02677, rank-0-only verbose output).
+
+Differences from the reference, by design:
+- data is synthetic ImageNet-shaped tensors (the image has no torchvision
+  and the point of the example is the distributed/resume flow, not IO);
+- the model is an in-file compact ResNet so the script runs anywhere the
+  framework does (CPU torch included) — swap in any nn.Module;
+- launch is `hvdrun -np N -- python examples/pytorch_imagenet_resnet50.py`
+  (no mpirun).
+
+Resume drill (what the test in tests/test_resume_example.py automates):
+
+    hvdrun -np 2 -- python examples/pytorch_imagenet_resnet50.py \
+        --epochs 4 --stop-after-epoch 2 --checkpoint-dir /tmp/ck   # "crash"
+    hvdrun -np 2 -- python examples/pytorch_imagenet_resnet50.py \
+        --epochs 4 --checkpoint-dir /tmp/ck                        # resumes @3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+import torch.utils.data
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # run from repo without install
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+# --------------------------------------------------------------------- model
+
+class Block(nn.Module):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.c1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.b1 = nn.BatchNorm2d(cout)
+        self.c2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.b2 = nn.BatchNorm2d(cout)
+        self.proj = None
+        if stride != 1 or cin != cout:
+            self.proj = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False), nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        y = F.relu(self.b1(self.c1(x)))
+        y = self.b2(self.c2(y))
+        return F.relu(y + (self.proj(x) if self.proj else x))
+
+
+class SmallResNet(nn.Module):
+    """Compact residual net (width scales with --width); stands in for
+    torchvision.models.resnet50 in the reference script."""
+
+    def __init__(self, num_classes=1000, width=16):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2d(3, width, 3, 1, 1, bias=False), nn.BatchNorm2d(width), nn.ReLU())
+        self.stages = nn.Sequential(
+            Block(width, width),
+            Block(width, 2 * width, stride=2),
+            Block(2 * width, 4 * width, stride=2),
+        )
+        self.head = nn.Linear(4 * width, num_classes)
+
+    def forward(self, x):
+        x = self.stages(self.stem(x))
+        x = x.mean(dim=(2, 3))
+        return self.head(x)
+
+
+# ---------------------------------------------------------------------- main
+
+def parse_args():
+    p = argparse.ArgumentParser(description="ImageNet-style resume example")
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--num-classes", type=int, default=10)
+    p.add_argument("--samples-per-rank", type=int, default=256)
+    p.add_argument("--base-lr", type=float, default=0.0125,
+                   help="learning rate for a single chip (scaled by size)")
+    p.add_argument("--warmup-epochs", type=float, default=1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--wd", type=float, default=5e-5)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    p.add_argument("--checkpoint-dir", default="./checkpoints")
+    p.add_argument("--stop-after-epoch", type=int, default=0,
+                   help="exit after saving this epoch's checkpoint "
+                        "(simulates a preempted/killed job for the resume drill)")
+    p.add_argument("--seed", type=int, default=42)
+    return p.parse_args()
+
+
+def checkpoint_path(args, epoch: int) -> str:
+    return os.path.join(args.checkpoint_dir, f"checkpoint-{epoch}.pt")
+
+
+def adjust_learning_rate(args, optimizer, epoch, batch_idx, batches_per_epoch):
+    """Gradual warmup (Goyal et al. arXiv:1706.02677): ramp from base_lr to
+    base_lr*size over warmup_epochs, then stay (a full schedule would decay)."""
+    size = hvd.size()
+    progress = epoch + batch_idx / batches_per_epoch
+    if progress < args.warmup_epochs:
+        factor = 1.0 + (size - 1.0) * progress / max(args.warmup_epochs, 1e-9)
+    else:
+        factor = float(size)
+    for group in optimizer.param_groups:
+        group["lr"] = args.base_lr * factor
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+    torch.manual_seed(args.seed)
+    verbose = hvd.rank() == 0
+
+    # Resume-epoch discovery: highest epoch with a checkpoint file, found on
+    # rank 0 and broadcast so every rank resumes from the same place.
+    resume_from_epoch = 0
+    for try_epoch in range(args.epochs, 0, -1):
+        if os.path.exists(checkpoint_path(args, try_epoch)):
+            resume_from_epoch = try_epoch
+            break
+    resume_from_epoch = int(hvd.broadcast(
+        torch.tensor(resume_from_epoch), root_rank=0, name="resume_from_epoch"))
+
+    # Synthetic ImageNet-shaped dataset, partitioned with DistributedSampler
+    # exactly as the real-data script would be.
+    g = torch.Generator().manual_seed(args.seed)  # same data on every rank...
+    data = torch.randn(args.samples_per_rank * hvd.size(), 3,
+                       args.image_size, args.image_size, generator=g)
+    target = torch.randint(0, args.num_classes,
+                           (args.samples_per_rank * hvd.size(),), generator=g)
+    dataset = torch.utils.data.TensorDataset(data, target)
+    sampler = torch.utils.data.distributed.DistributedSampler(
+        dataset, num_replicas=hvd.size(), rank=hvd.rank())  # ...sharded here
+    loader = torch.utils.data.DataLoader(
+        dataset, batch_size=args.batch_size, sampler=sampler)
+
+    model = SmallResNet(num_classes=args.num_classes)
+    optimizer = torch.optim.SGD(model.parameters(), lr=args.base_lr,
+                                momentum=args.momentum, weight_decay=args.wd)
+    compression = hvd.Compression.fp16 if args.fp16_allreduce else hvd.Compression.none
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression)
+
+    # Restore on rank 0 only; broadcast fills in every other rank.
+    if resume_from_epoch > 0 and hvd.rank() == 0:
+        ck = torch.load(checkpoint_path(args, resume_from_epoch),
+                        weights_only=True)
+        model.load_state_dict(ck["model"])
+        optimizer.load_state_dict(ck["optimizer"])
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    for epoch in range(resume_from_epoch, args.epochs):
+        model.train()
+        sampler.set_epoch(epoch)
+        running_loss, batches = 0.0, 0
+        for batch_idx, (x, y) in enumerate(loader):
+            adjust_learning_rate(args, optimizer, epoch, batch_idx, len(loader))
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            optimizer.step()
+            running_loss += float(loss.detach())
+            batches += 1
+        # epoch metric averaged across ranks (MetricAverageCallback semantics)
+        avg_loss = float(hvd.allreduce(
+            torch.tensor(running_loss / max(batches, 1)),
+            name=f"epoch_loss.{epoch}", average=True))
+        if verbose:
+            print(json.dumps({"epoch": epoch + 1, "train_loss": round(avg_loss, 6),
+                              "resumed_from": resume_from_epoch}), flush=True)
+
+        # Rank 0 writes the checkpoint; the engine barrier inside keeps ranks
+        # from racing past an unfinished save.
+        if hvd.rank() == 0:
+            os.makedirs(args.checkpoint_dir, exist_ok=True)
+            torch.save({"model": model.state_dict(),
+                        "optimizer": optimizer.state_dict(),
+                        "epoch": epoch + 1},
+                       checkpoint_path(args, epoch + 1))
+        # barrier so every rank sees the file before anyone may exit
+        hvd.allreduce(torch.zeros(1), name=f"ckpt_barrier.{epoch}")
+
+        if args.stop_after_epoch and epoch + 1 >= args.stop_after_epoch:
+            if verbose:
+                print(json.dumps({"stopped_after_epoch": epoch + 1}), flush=True)
+            hvd.shutdown()
+            sys.exit(0)
+
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -70,7 +70,10 @@ def average_metrics(metrics: Dict[str, Any], name_prefix: str = "metric.") -> Di
         arr = np.asarray(metrics[key], dtype=np.float64)
         red = basics.engine().run("allreduce", arr, f"{name_prefix}{key}",
                                   average=True)
-        out[key] = type(metrics[key])(red) if np.isscalar(metrics[key]) else red
+        if np.isscalar(metrics[key]):
+            out[key] = type(metrics[key])(np.asarray(red).item())
+        else:
+            out[key] = red
     return out
 
 

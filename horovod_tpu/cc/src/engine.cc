@@ -312,6 +312,15 @@ bool Engine::tick_multiprocess(bool shutting) {
     for (auto& w : out.stall_warnings) HVD_WARN(w);
   }
   execute_list(out);
+  if (!ring_error_.empty() && !shutdown_.load()) {
+    // Data plane is dead: fail everything queued and leave the job
+    // coordinately. Keep looping for one more tick — that tick runs with
+    // shutting=true and ships t.shutdown=1, so the coordinator marks this
+    // rank departed instead of stalling the tick barrier for the peers.
+    fail_everything(ring_error_);
+    shutdown_.store(true);
+    return true;
+  }
   if (out.shutdown && !shutting) {
     // Another rank initiated shutdown; exit together (reference
     // operations.cc:2125-2128). New enqueues fail from here on.
@@ -358,6 +367,14 @@ void Engine::execute_entry(const ResponseEntry& re) {
     table_.erase(it);
   }
   if (ents.empty()) return;
+  // Once a ring transport error happened, the peer byte streams may be
+  // mid-message (ring.h carries no per-chunk framing by design): executing
+  // anything further over those sockets could silently deliver one entry's
+  // bytes as another's payload. Fail fast instead.
+  if (!ring_error_.empty() && re.kind != ResponseEntry::ERROR) {
+    for (auto& e : ents) finish(e, Status::Aborted(ring_error_), Response{});
+    return;
+  }
   if (timeline_.healthy()) {
     for (auto& e : ents) {
       timeline_.negotiate_end(e.req.name);
@@ -379,10 +396,14 @@ void Engine::execute_entry(const ResponseEntry& re) {
       }
     }
   } catch (const std::exception& ex) {
+    // Transport failure mid-collective: the ring is desynced and cannot be
+    // trusted for any later collective. Latch the error; the tick loop
+    // fails every outstanding tensor and departs the job (the reference
+    // likewise treats a data-plane error as fatal to the rank rather than
+    // recoverable — a half-written NCCL/MPI stream has no resync point).
+    ring_error_ = std::string("ring data plane failed: ") + ex.what();
     for (auto& e : ents) {
-      finish(e, Status::Unknown(std::string("ring collective failed: ") +
-                                ex.what()),
-             Response{});
+      finish(e, Status::Aborted(ring_error_), Response{});
     }
   }
   if (timeline_.healthy()) {
@@ -638,6 +659,11 @@ void Coordinator::serve(int fd) {
     client_fds_.push_back(fd);
   }
   try {
+    // Bound the pre-auth handshake (same guard as the ring listener): a
+    // connection that sends nothing must not pin this serve thread forever.
+    timeval hs{10, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &hs, sizeof(hs));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &hs, sizeof(hs));
     // Authenticate before parsing a single payload byte (ADVICE finding:
     // the round-1 coordinator accepted unauthenticated exchanges).
     if (!auth_accept(fd, secret_, "hvd-ctrl")) {
@@ -666,6 +692,12 @@ void Coordinator::serve(int fd) {
       }
       send_frame(fd, w.buf);
     }
+    // Handshake done: drop the deadline — an authenticated worker may
+    // legitimately go quiet between ticks for longer than the handshake
+    // bound (long compute, debugger, GC pause).
+    timeval none{0, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &none, sizeof(none));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &none, sizeof(none));
     while (!stop_.load()) {
       auto frame = recv_frame(fd);
       Reader r(frame.data(), frame.size());

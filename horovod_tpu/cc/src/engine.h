@@ -141,6 +141,11 @@ class Engine {
   void finish(Entry& e, Status st, Response res);  // mark done + release name
   void fail_everything(const std::string& reason);
 
+  // Non-empty after a ring transport failure: the peer streams may be
+  // desynced (no per-chunk framing), so every later collective fails fast
+  // and the loop departs the job instead of risking silent corruption.
+  std::string ring_error_;
+
   Topology topo_;
   EngineConfig cfg_;
   HandleManager handles_;

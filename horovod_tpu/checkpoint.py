@@ -10,9 +10,11 @@ JAX checkpoint library), and the same contract is packaged as two calls:
 
     hvd.checkpoint.save(path, {"params": params, "opt_state": opt_state,
                                "epoch": epoch})          # writes on rank 0
-    state = hvd.checkpoint.restore(path)                 # every rank reads
-    params = hvd.jax.broadcast_parameters(state["params"])   # in-SPMD, or
-    # rely on identical files: restore() verifies a cross-rank digest.
+    state = hvd.checkpoint.restore(path)                 # every rank reads;
+    # restore() allgathers a digest of the restored leaves and fails loudly
+    # if any rank read divergent state. Alternative on non-shared
+    # filesystems: restore(path, verify=False) on rank 0 only, then
+    # hvd.jax.broadcast_parameters / broadcast_resume_state.
 """
 
 from __future__ import annotations
@@ -48,17 +50,73 @@ def save(path: str, state: Any, step: Optional[int] = None, force: bool = True) 
         basics.engine().run("allreduce", np.zeros(1), f"ckpt.barrier.{path}.{step}")
 
 
-def restore(path: str, template: Any = None, step: Optional[int] = None) -> Any:
+def restore(path: str, template: Any = None, step: Optional[int] = None,
+            verify: bool = True) -> Any:
     """Read a checkpoint on every rank (all ranks share the filesystem on a
-    pod slice; if not, restore on rank 0 and use hvd.jax.broadcast_parameters
-    inside the first step). ``template`` gives dtypes/shapes for orbax."""
+    pod slice). ``template`` gives dtypes/shapes for orbax.
+
+    With ``verify=True`` (default) every rank hashes the restored leaves and
+    the digests are allgathered and compared, so ranks that read divergent
+    files (stale NFS caches, non-shared filesystems) fail loudly instead of
+    training from inconsistent state. The check is collective: it requires
+    every rank to call restore(). If you instead restore on rank 0 only and
+    broadcast (hvd.jax.broadcast_parameters / broadcast_resume_state), pass
+    ``verify=False`` — the broadcast itself is the consistency guarantee."""
     ocp = _ocp()
     ckptr = ocp.StandardCheckpointer()
     target = os.path.join(os.path.abspath(path), f"step_{step}") \
         if step is not None else os.path.abspath(path)
     state = ckptr.restore(target, template) if template is not None \
         else ckptr.restore(target)
+    if verify:
+        _verify_cross_rank_digest(state, f"{path}.{step}")
     return state
+
+
+def _verify_cross_rank_digest(state: Any, tag: str) -> None:
+    """SHA-256 over every restored leaf (dtype + shape + bytes), allgathered
+    through the eager engine; raises if any rank restored different state."""
+    if basics.size() == 1:
+        return
+    import hashlib
+
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state):
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    mine = np.frombuffer(h.digest(), dtype=np.uint8).astype(np.float64)
+    # Bounded: the check is collective, and a caller that restores on rank 0
+    # only (the verify=False flow) must get an actionable error, not a hang.
+    eng = basics.engine()
+    handle = eng.enqueue("allgather", mine, f"ckpt.digest.{tag}")
+    timeout = float(os.environ.get("HOROVOD_CKPT_VERIFY_TIMEOUT", "120"))
+    try:
+        gathered = np.asarray(eng.synchronize(handle, timeout=timeout))
+    except Exception as exc:
+        from .common.engine import HorovodInternalError
+
+        raise HorovodInternalError(
+            f"checkpoint digest verification did not complete within "
+            f"{timeout:.0f}s — restore(verify=True) is collective and every "
+            f"rank must call it; if you restore on rank 0 only and "
+            f"broadcast, pass verify=False"
+        ) from exc
+    gathered = gathered.reshape(basics.size(), mine.size)
+    bad = [r for r in range(basics.size())
+           if not np.array_equal(gathered[r], gathered[0])]
+    if bad:
+        from .common.engine import HorovodInternalError
+
+        raise HorovodInternalError(
+            f"checkpoint restore diverged across ranks: ranks {bad} read "
+            f"different state than rank 0 (non-shared or stale filesystem?); "
+            f"restore on rank 0 only and broadcast, or fix the filesystem"
+        )
 
 
 def latest_step(path: str) -> Optional[int]:

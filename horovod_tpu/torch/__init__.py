@@ -197,16 +197,31 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
     state_dict = optimizer.state_dict()
 
     # Newly constructed optimizers have empty state: create it by running a
-    # zero-gradient step (reference torch/__init__.py:251-268). This must
-    # happen on EVERY rank with empty state, not just root — the broadcast
-    # below is name-matched across ranks, so all ranks need identical state
-    # structure or the collective would stall.
+    # zero-gradient step (reference torch/__init__.py:251-268). On resume the
+    # ranks are ASYMMETRIC — root loaded state from the checkpoint, the rest
+    # are empty — so the init step must bypass the DistributedOptimizer
+    # wrapper: its step() would allreduce every parameter and deadlock,
+    # because root never joins (reference's same fix, torch/__init__.py:256-263).
     if not state_dict["state"]:
         for group in optimizer.param_groups:
             for p in group["params"]:
                 if p.requires_grad and p.grad is None:
                     p.grad = p.data.new_zeros(p.shape)
-        optimizer.step()
+        # The step exists only to materialize state entries — it must not
+        # move parameters. A zero gradient is not enough: weight decay makes
+        # d_p = wd*p even with grad 0, and on the asymmetric resume path the
+        # root (which skips this block) would keep different weights than
+        # everyone else, permanently diverging the replicas. Snapshot and
+        # restore.
+        snapshot = [p.data.clone() for group in optimizer.param_groups
+                    for p in group["params"]]
+        if hasattr(optimizer, "_handles"):  # DistributedOptimizer wrapper
+            super(optimizer.__class__, optimizer).step()
+        else:
+            optimizer.step()
+        for p, saved in zip((p for group in optimizer.param_groups
+                             for p in group["params"]), snapshot):
+            p.data.copy_(saved)
         state_dict = optimizer.state_dict()
 
     scalars: list[tuple[Any, Any, str]] = []  # (container, key, name)

@@ -1,0 +1,64 @@
+"""Shared multi-process launch harness for engine tests.
+
+One implementation of the free-port / shared-secret / HOROVOD_* env / Popen
+world spawner (previously copied per test file — protocol env changes now
+land in exactly one place).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_world(world: int, script: str, extra_env=None, per_rank_env=None,
+                 timeout: float = 180, check: bool = True) -> list[dict]:
+    """Spawn ``world`` ranks running ``script`` with a shared secret and
+    coordinator address. Returns per-rank dicts:
+    ``{"rc": int, "out": <last stdout line parsed as JSON> | None,
+    "stderr": str}``. With ``check`` (default) a non-zero rank fails the
+    test immediately."""
+    port = free_port()
+    secret = secrets.token_hex(16)
+    procs = []
+    for rank in range(world):
+        env = dict(os.environ)
+        env.update({
+            "HVD_REPO": REPO,
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(world),
+            "HOROVOD_COORD_ADDR": f"127.0.0.1:{port}",
+            "HOROVOD_SECRET": secret,
+        })
+        env.update(extra_env or {})
+        env.update((per_rank_env or {}).get(rank, {}))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    results = []
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=timeout)
+        if check:
+            assert p.returncode == 0, f"rank failed:\n{stderr[-3000:]}"
+        out = stdout.strip().splitlines()
+        results.append({
+            "rc": p.returncode,
+            "out": json.loads(out[-1]) if check and out else None,
+            "stderr": stderr,
+        })
+    return results

@@ -41,3 +41,46 @@ def test_broadcast_resume_state_single(hvd):
     out = checkpoint.broadcast_resume_state(state)
     assert out["epoch"] == 3
     np.testing.assert_array_equal(out["arr"], state["arr"])
+
+
+def test_digest_verify_single_is_noop(hvd):
+    # size-1 world: nothing to compare
+    checkpoint._verify_cross_rank_digest({"w": np.ones(3)}, "t")
+
+
+DIGEST_SCRIPT = """
+import json, os, sys
+import numpy as np
+sys.path.insert(0, os.environ["HVD_REPO"])
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint
+from horovod_tpu.common.engine import HorovodInternalError
+
+hvd.init()
+r = hvd.rank()
+# identical state on every rank: must pass
+checkpoint._verify_cross_rank_digest({"w": np.arange(8.0)}, "same")
+# rank-dependent state: must raise on every rank
+try:
+    checkpoint._verify_cross_rank_digest({"w": np.full(8, float(r))}, "diff")
+    diverged_caught = False
+except HorovodInternalError as e:
+    diverged_caught = "diverged across ranks" in str(e)
+hvd.shutdown()
+print(json.dumps({"ok": diverged_caught}))
+"""
+
+
+@pytest.mark.engine
+def test_digest_verify_two_ranks():
+    """Cross-rank digest check: identical restored state passes, divergent
+    state raises on every rank (the docstring-promised guarantee,
+    VERDICT weak #5)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from launch_util import launch_world
+
+    for res in launch_world(2, DIGEST_SCRIPT):
+        assert res["out"]["ok"] is True

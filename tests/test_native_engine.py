@@ -5,15 +5,17 @@ the rank-0 TCP coordinator (the reference tests the analogous path under
 
 from __future__ import annotations
 
-import json
 import os
-import socket
 import subprocess
 import sys
 import textwrap
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
 import numpy as np
 import pytest
+
+from launch_util import launch_world as _launch_world
 
 from horovod_tpu.common.config import Config
 from horovod_tpu.common.topology import Topology
@@ -117,40 +119,9 @@ RANK_SCRIPT = textwrap.dedent("""
 """)
 
 
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def launch_world(world: int, script: str, extra_env=None):
-    import secrets as secrets_mod
-
-    port = free_port()
-    secret = secrets_mod.token_hex(16)
-    procs = []
-    for rank in range(world):
-        env = dict(os.environ)
-        env.update({
-            "HVD_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "HOROVOD_RANK": str(rank),
-            "HOROVOD_SIZE": str(world),
-            "HOROVOD_COORD_ADDR": f"127.0.0.1:{port}",
-            "HOROVOD_SECRET": secret,
-        })
-        env.update(extra_env or {})
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", script], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        ))
-    outs = []
-    for p in procs:
-        stdout, stderr = p.communicate(timeout=120)
-        assert p.returncode == 0, f"rank failed:\n{stderr[-2000:]}"
-        outs.append(json.loads(stdout.strip().splitlines()[-1]))
-    return outs
+def launch_world(world, script, extra_env=None):
+    return [r["out"] for r in
+            _launch_world(world, script, extra_env=extra_env, timeout=120)]
 
 
 def test_native_multiprocess_world(native):

@@ -15,17 +15,18 @@ from __future__ import annotations
 import json
 import os
 import secrets
-import socket
 import subprocess
 import sys
 import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 import pytest
 
 pytestmark = pytest.mark.engine
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from launch_util import REPO, free_port, launch_world  # noqa: E402
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -33,48 +34,6 @@ def build_native():
     from horovod_tpu.cc import lib_path
 
     lib_path()
-
-
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def launch_world(world: int, script: str, extra_env=None, per_rank_env=None,
-                 timeout: float = 180, check: bool = True):
-    port = free_port()
-    secret = secrets.token_hex(16)
-    procs = []
-    for rank in range(world):
-        env = dict(os.environ)
-        env.update({
-            "HVD_REPO": REPO,
-            "HOROVOD_RANK": str(rank),
-            "HOROVOD_SIZE": str(world),
-            "HOROVOD_COORD_ADDR": f"127.0.0.1:{port}",
-            "HOROVOD_SECRET": secret,
-        })
-        env.update(extra_env or {})
-        env.update((per_rank_env or {}).get(rank, {}))
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", script], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        ))
-    results = []
-    for p in procs:
-        stdout, stderr = p.communicate(timeout=timeout)
-        if check:
-            assert p.returncode == 0, f"rank failed:\n{stderr[-3000:]}"
-        out = stdout.strip().splitlines()
-        results.append({
-            "rc": p.returncode,
-            "out": json.loads(out[-1]) if check and out else None,
-            "stderr": stderr,
-        })
-    return results
 
 
 PRELUDE = textwrap.dedent("""

@@ -5,15 +5,16 @@ broadcast_optimizer_state, compression, backward_passes_per_step
 
 from __future__ import annotations
 
-import json
 import os
-import socket
-import subprocess
 import sys
 import textwrap
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
 import numpy as np
 import pytest
+
+from launch_util import launch_world
 
 torch = pytest.importorskip("torch")
 
@@ -137,37 +138,13 @@ RANK_SCRIPT = textwrap.dedent("""
 """)
 
 
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 def test_torch_two_rank_lockstep():
     world = 2
-    port = free_port()
-    procs = []
-    for rank in range(world):
-        env = dict(os.environ)
-        env.update({
-            "HVD_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "HOROVOD_RANK": str(rank),
-            "HOROVOD_SIZE": str(world),
-            "HOROVOD_LOCAL_RANK": str(rank),
-            "HOROVOD_LOCAL_SIZE": str(world),
-            "HOROVOD_COORD_ADDR": f"127.0.0.1:{port}",
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", RANK_SCRIPT], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        ))
-    outs = []
-    for p in procs:
-        stdout, stderr = p.communicate(timeout=180)
-        assert p.returncode == 0, f"rank failed:\n{stderr[-3000:]}"
-        outs.append(json.loads(stdout.strip().splitlines()[-1]))
+    outs = [r["out"] for r in launch_world(
+        world, RANK_SCRIPT,
+        per_rank_env={r: {"HOROVOD_LOCAL_RANK": str(r),
+                          "HOROVOD_LOCAL_SIZE": str(world)}
+                      for r in range(world)})]
     # identical after broadcast
     assert outs[0]["weights_hash"] == pytest.approx(outs[1]["weights_hash"])
     # identical after 3 hook-averaged steps on different data
