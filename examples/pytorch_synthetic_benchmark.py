@@ -1,0 +1,88 @@
+"""Synthetic benchmark for the torch (eager/hook-driven) binding — the
+reference examples/pytorch_synthetic_benchmark.py:96-110 harness shape:
+timed batches over a synthetic dataset, reporting img/sec per device
+± 1.96σ and the aggregate.
+
+This measures the EAGER data plane (hook-driven allreduce through the
+native engine's peer-to-peer ring) — the compiled-plane twin is
+examples/jax_synthetic_benchmark.py. The image has CPU torch, so the
+default model is compact; --width scales it.
+
+    hvdrun -np 4 -- python examples/pytorch_synthetic_benchmark.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import torch
+import torch.nn.functional as F
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # run from repo without install
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="torch synthetic benchmark")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--width", type=int, default=16, help="model width")
+    p.add_argument("--num-warmup-batches", type=int, default=3)
+    p.add_argument("--num-batches-per-iter", type=int, default=5)
+    p.add_argument("--num-iters", type=int, default=5)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+    torch.manual_seed(42)
+    torch.set_num_threads(max(1, (os.cpu_count() or 2) // max(hvd.local_size(), 1)))
+
+    from examples.pytorch_imagenet_resnet50 import SmallResNet  # same in-repo model
+
+    model = SmallResNet(num_classes=100, width=args.width)
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size(),
+                                momentum=0.9)
+    compression = hvd.Compression.fp16 if args.fp16_allreduce else hvd.Compression.none
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    x = torch.randn(args.batch_size, 3, args.image_size, args.image_size)
+    y = torch.randint(0, 100, (args.batch_size,))
+
+    def benchmark_step():
+        optimizer.zero_grad()
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        optimizer.step()
+
+    for _ in range(args.num_warmup_batches):
+        benchmark_step()
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            benchmark_step()
+        dt = time.perf_counter() - t0
+        img_secs.append(args.batch_size * args.num_batches_per_iter / dt)
+
+    if hvd.rank() == 0:
+        import numpy as np
+
+        mean, conf = float(np.mean(img_secs)), 1.96 * float(np.std(img_secs))
+        print(f"Img/sec per device: {mean:.1f} +-{conf:.1f}")
+        print(f"Total img/sec on {hvd.size()} device(s): "
+              f"{mean * hvd.size():.1f} +-{conf * hvd.size():.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

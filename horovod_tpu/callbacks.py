@@ -85,6 +85,8 @@ class Callback:
 
     def on_train_begin(self, logs: Optional[dict] = None) -> None: ...
 
+    def on_train_end(self, logs: Optional[dict] = None) -> None: ...
+
     def on_epoch_begin(self, epoch: int, logs: Optional[dict] = None) -> None: ...
 
     def on_epoch_end(self, epoch: int, logs: Optional[dict] = None) -> None: ...

@@ -1,4 +1,12 @@
 """TPU compute ops beyond stock XLA: sequence-parallel attention schedules
-(ring / Ulysses) and, as the framework grows, pallas kernels for the hot ops."""
+(ring / Ulysses), expert-parallel switch-MoE, and, as the framework grows,
+pallas kernels for the hot ops."""
 
-from .ring_attention import ring_attention, ulysses_attention, causal_reference  # noqa: F401
+from .moe import (  # noqa: F401
+    MoEParams,
+    init_moe_params,
+    load_balancing_loss,
+    moe_apply,
+    top1_route,
+)
+from .ring_attention import causal_reference, ring_attention, ulysses_attention  # noqa: F401

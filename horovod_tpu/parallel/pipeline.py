@@ -1,0 +1,129 @@
+"""Pipeline parallelism (GPipe-style microbatch pipelining) over a mesh axis.
+
+Beyond the reference's scope (Horovod v0.16 is data-parallel only,
+SURVEY.md §2.8) but first-class on TPU, where a pod is deep enough that one
+model may not fit a chip. The design is compiler-idiomatic rather than a
+runtime scheduler:
+
+- Layers are STACKED (a leading layer dim) and sharded over the ``pp`` mesh
+  axis, so each device holds a contiguous block of layers (its stage).
+- The schedule is a single ``lax.scan`` over ticks; activations move to the
+  next stage with one ``lax.ppermute`` per tick. Microbatch m enters stage 0
+  at tick m and leaves the last stage at tick m + n_stages - 1; the scan
+  runs n_micro + n_stages - 1 ticks (the classic GPipe bubble).
+- The BACKWARD pipeline comes for free: the whole schedule is differentiable
+  (the gradient of ppermute is the reverse ppermute), so ``jax.grad``
+  through :func:`pipeline_apply` yields the reverse-order pipeline with the
+  same bubble — no hand-written scheduler, no send/recv state machine.
+
+This is the "pipelining = scan + collective permute" recipe of the public
+TPU scaling playbook; correctness is proven against a dense sequential
+oracle in tests/test_pipeline.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PP_AXIS = "pp"
+
+
+def stack_stage_params(layer_params_list):
+    """Stack per-layer param pytrees into one tree with a leading layer dim —
+    the shape pipeline_apply shards over the pp axis (P('pp') on dim 0)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *layer_params_list
+    )
+
+
+def pipeline_apply(
+    layer_fn: Callable,
+    stage_params,
+    microbatches,
+    axis_name: str = PP_AXIS,
+):
+    """Run ``microbatches`` through the layer pipeline; call INSIDE shard_map.
+
+    Args:
+      layer_fn: ``(params_one_layer, x) -> x`` — one layer's forward.
+      stage_params: params with leading dim = layers_per_stage (this stage's
+        shard of the stacked layer params).
+      microbatches: ``(n_micro, mb_size, ...)`` — every stage receives the
+        same microbatch array (replicated in-spec); only stage 0 reads it.
+      axis_name: the pipeline mesh axis.
+
+    Returns:
+      ``(n_micro, mb_size, ...)`` outputs — valid on the LAST stage (other
+      stages hold garbage of the right shape; callers typically
+      ``psum``/select the last stage's value or compute the loss there).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage_idx = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    n_ticks = n_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def apply_stage(x):
+        # layers_per_stage sequential layers on this device
+        def body(h, p_one):
+            return layer_fn(p_one, h), None
+
+        h, _ = lax.scan(body, x, stage_params)
+        return h
+
+    zero_mb = jnp.zeros_like(microbatches[0])
+    out_buf = jnp.zeros_like(microbatches)
+
+    def tick(carry, t):
+        in_flight, out_buf = carry
+        # Stage 0 ingests microbatch t (clamped: after the last microbatch it
+        # feeds zeros that are never collected); other stages consume what
+        # the previous tick's ppermute delivered.
+        mb = lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, n_micro - 1), keepdims=False)
+        x = jnp.where(stage_idx == 0, mb, in_flight)
+        y = apply_stage(x)
+        # The LAST stage finished microbatch (t - n_stages + 1) this tick.
+        m = t - (n_stages - 1)
+        valid = jnp.logical_and(stage_idx == n_stages - 1, m >= 0)
+        out_buf = lax.cond(
+            valid,
+            lambda buf: lax.dynamic_update_index_in_dim(
+                buf, y, jnp.maximum(m, 0), axis=0),
+            lambda buf: buf,
+            out_buf,
+        )
+        # Hand the activation to the next stage (ring: last->0 carries junk
+        # that stage 0 overwrites with a fresh microbatch).
+        in_flight = lax.ppermute(y, axis_name, perm)
+        return (in_flight, out_buf), None
+
+    (_, out_buf), _ = lax.scan(tick, (zero_mb, out_buf), jnp.arange(n_ticks))
+    return out_buf
+
+
+def last_stage_value(x, axis_name: str = PP_AXIS):
+    """Broadcast the last stage's value to every stage (e.g. the pipeline
+    output or the loss): zero elsewhere + psum. For REPORTING only — to
+    differentiate a pipeline loss, use :func:`masked_last_stage_loss`."""
+    n_stages = lax.axis_size(axis_name)
+    is_last = lax.axis_index(axis_name) == n_stages - 1
+    return lax.psum(jnp.where(is_last, x, jnp.zeros_like(x)), axis_name)
+
+
+def masked_last_stage_loss(loss_value, axis_name: str = PP_AXIS):
+    """The differentiable form of a pipeline loss: ``loss_value`` on the
+    last stage, zero elsewhere.
+
+    Differentiate THIS, not the psum-broadcast value: the broadcast's
+    transpose sums the cotangents of every stage's replicated loss copy,
+    scaling gradients by the stage count. With the mask, the summed
+    per-device losses equal the true loss exactly once, and the ppermute
+    transposes route the cotangents back through the reverse pipeline."""
+    n_stages = lax.axis_size(axis_name)
+    is_last = lax.axis_index(axis_name) == n_stages - 1
+    return jnp.where(is_last, loss_value, jnp.zeros_like(loss_value))

@@ -25,6 +25,7 @@ import sys
 from typing import Any, Callable, Optional, Sequence
 
 from .network import make_secret
+from .proc_tree import terminate_trees
 from .service import DriverService, TaskAgent, host_hash  # noqa: F401
 
 
@@ -35,7 +36,9 @@ def _spawn_worker(index: int, driver_addrs, secret: bytes, argv: Sequence[str],
     env["HOROVOD_SECRET"] = secret.hex()
     env["HOROVOD_TASK_INDEX"] = str(index)
     env.update(extra_env or {})
-    return subprocess.Popen(list(argv), env=env)
+    # Own session per worker: on abort the launcher signals the whole
+    # process group, so grandchildren die too (proc_tree.terminate_tree).
+    return subprocess.Popen(list(argv), env=env, start_new_session=True)
 
 
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
@@ -68,9 +71,7 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
             p.wait(timeout=30)
         return [results[r] for r in sorted(results)]
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
+        terminate_trees(procs)
         driver.stop()
 
 
@@ -95,7 +96,5 @@ def run_command(command: Sequence[str], num_proc: int,
             rc = max(rc, p.returncode or 0)
         return rc
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
+        terminate_trees(procs)
         driver.stop()

@@ -1,0 +1,73 @@
+"""Process-tree termination for the launcher.
+
+When a worker fails or times out, terminating only the direct child leaks
+its descendants (a training script that spawned data-loader or shell
+children keeps them running as orphans). The reference solves this with a
+fork middleman + psutil recursive kill
+(spark/util/safe_shell_exec.py:29-52); here each worker is launched in its
+own session (setsid) so the whole group can be signalled at once, with a
+psutil recursive sweep as the backstop for descendants that moved
+themselves into a new group.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+
+GRACE_S = 5.0
+
+
+def _descendants(pid: int):
+    try:
+        import psutil
+
+        return psutil.Process(pid).children(recursive=True)
+    except Exception:
+        return []
+
+
+def terminate_tree(proc: subprocess.Popen, grace: float = GRACE_S) -> None:
+    """SIGTERM the worker's whole process group (it was started with
+    ``start_new_session=True``), give it ``grace`` seconds, then SIGKILL the
+    group and any descendants that escaped into their own group."""
+    terminate_trees([proc], grace=grace)
+
+
+def terminate_trees(procs, grace: float = GRACE_S) -> None:
+    """Tear down many workers with ONE shared grace window: SIGTERM every
+    group first, wait once, then SIGKILL — teardown stays ~grace seconds
+    regardless of world size (a serial per-worker wait would cost
+    grace * num_proc on the failure path)."""
+    # Snapshot descendants BEFORE signalling: after a group dies their
+    # parentage is unreadable. Even when a worker itself already exited,
+    # its group may still hold grandchildren (they keep the pgid), so the
+    # group signals below always run.
+    escaped = {id(p): _descendants(p.pid) for p in procs}
+    for p in procs:
+        try:
+            os.killpg(p.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+    deadline = time.monotonic() + grace
+    while time.monotonic() < deadline:
+        if all(p.poll() is not None for p in procs):
+            break
+        time.sleep(0.1)
+    for p in procs:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        for d in escaped[id(p)]:
+            try:
+                d.kill()
+            except Exception:
+                pass
+    for p in procs:
+        try:
+            p.wait(timeout=grace)
+        except Exception:
+            pass

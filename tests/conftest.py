@@ -23,6 +23,15 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(items):
+    """Every test not marked slow is the fast tier: `-m fast` (or the
+    equivalent `-m "not slow"`) is the sub-2-minute developer loop; `-m slow`
+    holds the XLA-compile-heavy and multi-minute e2e tests."""
+    for item in items:
+        if "slow" not in item.keywords:
+            item.add_marker(pytest.mark.fast)
+
+
 @pytest.fixture()
 def hvd():
     import horovod_tpu as hvd

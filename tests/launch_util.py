@@ -51,14 +51,22 @@ def launch_world(world: int, script: str, extra_env=None, per_rank_env=None,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         ))
     results = []
-    for p in procs:
-        stdout, stderr = p.communicate(timeout=timeout)
-        if check:
-            assert p.returncode == 0, f"rank failed:\n{stderr[-3000:]}"
-        out = stdout.strip().splitlines()
-        results.append({
-            "rc": p.returncode,
-            "out": json.loads(out[-1]) if check and out else None,
-            "stderr": stderr,
-        })
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=timeout)
+            if check:
+                assert p.returncode == 0, f"rank failed:\n{stderr[-3000:]}"
+            out = stdout.strip().splitlines()
+            results.append({
+                "rc": p.returncode,
+                "out": json.loads(out[-1]) if check and out else None,
+                "stderr": stderr,
+            })
+    finally:
+        # One hung or failed rank must not leak the others into the rest of
+        # the pytest session (they would keep the coordinator port busy).
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     return results

@@ -25,6 +25,7 @@ def run_example(cmd, timeout=300, env_extra=None):
     return proc.stdout
 
 
+@pytest.mark.slow
 def test_pytorch_mnist_example_2proc():
     out = run_example([
         sys.executable, "-m", "horovod_tpu.runner", "-np", "2", "--",
@@ -34,6 +35,53 @@ def test_pytorch_mnist_example_2proc():
     assert "averaged over 2 ranks" in out
 
 
+@pytest.mark.slow
 def test_jax_mnist_example_single():
     out = run_example([sys.executable, "examples/jax_mnist.py"])
     assert "epoch 2" in out
+
+
+@pytest.mark.slow
+def test_pytorch_synthetic_benchmark_2proc():
+    out = run_example([
+        sys.executable, "-m", "horovod_tpu.runner", "-np", "2", "--",
+        sys.executable, "examples/pytorch_synthetic_benchmark.py",
+        "--num-iters", "2", "--num-batches-per-iter", "2",
+        "--num-warmup-batches", "1",
+    ])
+    assert "Img/sec per device" in out
+    assert "Total img/sec on 2 device(s)" in out
+
+
+def test_pytorch_mnist_callbacks_2proc():
+    out = run_example([
+        sys.executable, "-m", "horovod_tpu.runner", "-np", "2", "--",
+        sys.executable, "examples/pytorch_mnist_callbacks.py",
+    ], env_extra={"MNIST_EPOCHS": "3", "MNIST_STEPS": "4"})
+    assert "epoch 3" in out
+    assert "averaged over 2 ranks" in out
+    # warmup ramped lr toward lr*size=0.02 over 2 epochs
+    assert "lr 0.0200" in out
+
+
+@pytest.mark.slow
+def test_jax_word2vec_sparse_path():
+    out = run_example(
+        [sys.executable, "examples/jax_word2vec.py"],
+        env_extra={"HVD_FORCE_CPU": "1", "W2V_EPOCHS": "1", "W2V_STEPS": "3",
+                   "W2V_VOCAB": "200", "W2V_DIM": "16", "W2V_BATCH": "32"})
+    assert "sparse rows/step" in out
+
+
+@pytest.mark.slow
+def test_jax_imagenet_resume(tmp_path):
+    ck = str(tmp_path / "ckjax")
+    args = [sys.executable, "examples/jax_imagenet_resnet50.py",
+            "--epochs", "3", "--steps-per-epoch", "2", "--batch-size", "4",
+            "--image-size", "16", "--checkpoint-dir", ck]
+    env = {"HVD_FORCE_CPU": "1"}
+    out1 = run_example(args + ["--stop-after-epoch", "1"], env_extra=env)
+    assert '"epoch": 1' in out1 and "stopped_after_epoch" in out1
+    out2 = run_example(args, env_extra=env)
+    assert '"resumed_from": 1' in out2
+    assert '"epoch": 2' in out2 and '"epoch": 3' in out2

@@ -17,6 +17,7 @@ from horovod_tpu import models as zoo
     ("VGG16", 32),
     ("InceptionV3", 96),
 ])
+@pytest.mark.slow
 def test_cnn_forward_and_grad(name, image):
     model = getattr(zoo, name)(num_classes=10)
     x = jnp.ones((2, image, image, 3), jnp.float32)
@@ -33,7 +34,7 @@ def test_cnn_forward_and_grad(name, image):
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, jnp.zeros((2,), jnp.int32)).mean()
 
-    grads = jax.grad(loss)(variables["params"])
+    grads = jax.jit(jax.grad(loss))(variables["params"])
     flat = jax.tree_util.tree_leaves(grads)
     assert all(np.isfinite(np.asarray(g)).all() for g in flat)
     assert any(float(jnp.abs(g).max()) > 0 for g in flat)

@@ -124,6 +124,7 @@ def launch_world(world, script, extra_env=None):
             _launch_world(world, script, extra_env=extra_env, timeout=120)]
 
 
+@pytest.mark.slow
 def test_native_multiprocess_world(native):
     outs = launch_world(WORLD, RANK_SCRIPT)
     mean = float(np.mean(np.arange(WORLD)))

@@ -1,0 +1,101 @@
+"""Launcher process-tree cleanup (VERDICT #8): when a worker fails or the
+job aborts, the worker's own children must not survive as orphans
+(reference safe_shell_exec.py:29-52 fork-middleman + psutil tree kill)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+pytestmark = pytest.mark.engine
+
+# Worker: spawn a long-lived grandchild, report its pid, then fail.
+FAILING_WORKER = textwrap.dedent("""
+    import os, subprocess, sys, time
+    child = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(300)"])
+    print(f"GRANDCHILD {child.pid}", flush=True)
+    time.sleep(1)
+    sys.exit(3)  # worker dies; launcher must reap the grandchild
+""")
+
+
+def alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+@pytest.mark.slow
+def test_failed_worker_leaves_no_orphans(tmp_path):
+    """run() aborts when a worker exits non-zero; the worker's grandchild
+    must be gone afterwards."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = textwrap.dedent("""
+        import subprocess, sys
+        sys.path.insert(0, @REPO@)
+        from horovod_tpu.runner import run_command
+        rc = run_command([sys.executable, "-c", @WORKER@],
+                         num_proc=2, timeout=60)
+        print("RC", rc)
+    """).replace("@REPO@", repr(repo)).replace("@WORKER@", repr(FAILING_WORKER))
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=120)
+    pids = [int(line.split()[1]) for line in proc.stdout.splitlines()
+            if line.startswith("GRANDCHILD")]
+    assert len(pids) == 2, f"workers did not report grandchildren:\n{proc.stdout}\n{proc.stderr}"
+    assert "RC 3" in proc.stdout
+    # launcher returned: every grandchild must be dead (allow a beat for
+    # signal delivery)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and any(alive(p) for p in pids):
+        time.sleep(0.2)
+    leaked = [p for p in pids if alive(p)]
+    for p in leaked:  # don't actually leak them on test failure
+        os.kill(p, 9)
+    assert not leaked, f"grandchildren survived the abort: {leaked}"
+
+
+@pytest.mark.slow
+def test_programmatic_run_timeout_reaps_tree(tmp_path):
+    """run(fn) that times out must also kill workers' descendants."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = textwrap.dedent("""
+        import subprocess, sys
+        sys.path.insert(0, @REPO@)
+        from horovod_tpu.runner import run
+
+        def fn():
+            import subprocess, sys, time
+            child = subprocess.Popen(
+                [sys.executable, "-c", "import time; time.sleep(300)"])
+            print(f"GRANDCHILD {child.pid}", flush=True)
+            time.sleep(300)  # never returns a result -> launcher times out
+
+        try:
+            run(fn, num_proc=1, timeout=8)
+            print("NO_TIMEOUT")
+        except Exception as e:
+            print("TIMED_OUT")
+    """).replace("@REPO@", repr(repo))
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=120)
+    pids = [int(line.split()[1]) for line in proc.stdout.splitlines()
+            if line.startswith("GRANDCHILD")]
+    assert pids, f"worker did not report a grandchild:\n{proc.stdout}\n{proc.stderr}"
+    assert "TIMED_OUT" in proc.stdout
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and any(alive(p) for p in pids):
+        time.sleep(0.2)
+    leaked = [p for p in pids if alive(p)]
+    for p in leaked:
+        os.kill(p, 9)
+    assert not leaked, f"grandchildren survived the timeout: {leaked}"

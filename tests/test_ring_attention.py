@@ -26,7 +26,10 @@ def qkv(b=2, t=64, h=8, d=16, seed=0):
 
 @pytest.fixture()
 def sp_mesh():
-    return Mesh(np.asarray(jax.devices()), ("sp",))
+    # 4 of the 8 virtual devices: the ring schedule unrolls one scan step per
+    # device, so compile time scales with mesh size — 4 exercises the same
+    # index math (>2 avoids trivial neighbour symmetry) at half the compile.
+    return Mesh(np.asarray(jax.devices()[:4]), ("sp",))
 
 
 def _run_sharded(fn, mesh, *args):
@@ -36,6 +39,7 @@ def _run_sharded(fn, mesh, *args):
     )(*args)
 
 
+@pytest.mark.slow
 def test_ring_attention_matches_oracle(sp_mesh):
     q, k, v = qkv()
     with jax.default_matmul_precision("highest"):
@@ -44,6 +48,7 @@ def test_ring_attention_matches_oracle(sp_mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_is_causal(sp_mesh):
     """Changing future tokens must not change past outputs."""
     q, k, v = qkv(t=32)
@@ -71,6 +76,7 @@ def test_ulysses_rejects_bad_heads(sp_mesh):
         _run_sharded(lambda a, b, c: ulysses_attention(a, b, c, "sp"), sp_mesh, q, k, v)
 
 
+@pytest.mark.slow
 def test_transformer_sp_equals_dense(sp_mesh):
     """Full model: sp-sharded forward with ring attention == single-device
     forward with dense attention, same params."""

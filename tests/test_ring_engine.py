@@ -74,6 +74,7 @@ def test_fusion_executes_fewer_ring_passes():
         assert res["out"]["passes"] <= 5, res["out"]
 
 
+@pytest.mark.slow
 def test_ring_moves_100mb_world4():
     """World-4 allreduce of ~100 MB per rank: correct results, and every
     rank's wire traffic is ~1.5x payload (ring property) — far below the
@@ -127,6 +128,7 @@ def test_world16_coordinator_tick():
         assert res["out"]["ok"] is True
 
 
+@pytest.mark.slow
 def test_stall_warning_names_missing_ranks():
     """Rank 1 never submits tensor `lonely`; the coordinator must broadcast
     a stall warning naming rank 1 to every rank (reference prints missing
@@ -155,6 +157,7 @@ def test_stall_warning_names_missing_ranks():
         assert "lonely" in res["stderr"]
 
 
+@pytest.mark.slow
 def test_autotuner_knobs_identical_across_ranks():
     """After tuning rounds, every rank holds the same (threshold, cycle)
     knobs at the same version — the coordinator tunes and the knobs ride the

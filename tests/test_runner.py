@@ -46,6 +46,7 @@ def test_run_happy_path():
         assert reduced == [mean, mean]
 
 
+@pytest.mark.slow
 def test_run_command_cli():
     """CLI path: each worker gets rank env and runs the command."""
     from horovod_tpu.runner import run_command

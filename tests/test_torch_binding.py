@@ -138,6 +138,7 @@ RANK_SCRIPT = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 def test_torch_two_rank_lockstep():
     world = 2
     outs = [r["out"] for r in launch_world(
