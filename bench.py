@@ -53,7 +53,8 @@ def main() -> None:
     image = 224 if on_tpu else 32
     batch = per_dev_batch * n_dev
 
-    model = ResNet50(num_classes=1000)
+    model = ResNet50(num_classes=1000,
+                     space_to_depth=bool(os.environ.get("HVD_BENCH_S2D")))
     x = jnp.ones((batch, image, image, 3), jnp.float32)
     y = jnp.zeros((batch,), jnp.int32)
     variables = model.init(jax.random.PRNGKey(0), x[:2], train=False)

@@ -1,0 +1,73 @@
+"""Flax MoE layer for the transformer — switch-style top-1 routing with the
+same capacity/dispatch math as ops/moe.py, expressed densely so it drops
+into any model. Expert parallelism at scale comes from GSPMD: shard `w_in`/
+`w_out` with PartitionSpec('ep', None, None) (see ep_param_specs) and XLA
+partitions the expert einsums and inserts the token exchanges — the
+explicitly scheduled shard_map twin lives in ops/moe.py.
+
+The router's load-balancing auxiliary loss is sowed under
+intermediates/"moe_lb_loss"; training loops add
+`sum(intermediates) * aux_weight` to the task loss (Switch Transformer
+recipe, coefficient ~1e-2).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.moe import load_balancing_loss, top1_route
+
+
+class MoEMLP(nn.Module):
+    dim: int
+    hidden: int
+    n_experts: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        tokens = x.reshape(-1, d)
+        n_tok = b * t
+        capacity = max(int(self.capacity_factor * n_tok / self.n_experts), 1)
+
+        init = nn.initializers.lecun_normal()
+        gate_w = self.param("gate", init, (d, self.n_experts), jnp.float32)
+        w_in = self.param("w_in", init, (self.n_experts, d, self.hidden),
+                          jnp.float32).astype(self.dtype)
+        w_out = self.param("w_out", init, (self.n_experts, self.hidden, d),
+                           jnp.float32).astype(self.dtype)
+
+        logits = tokens.astype(jnp.float32) @ gate_w
+        expert, prob, pos, keep = top1_route(logits, capacity)
+        self.sow("intermediates", "moe_lb_loss",
+                 load_balancing_loss(logits, expert, self.n_experts))
+
+        kept = jnp.where(keep[:, None], tokens, jnp.zeros_like(tokens))
+        disp = jnp.zeros((self.n_experts, capacity, d), self.dtype
+                         ).at[expert, pos].add(kept.astype(self.dtype))
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", disp, w_in))
+        y = jnp.einsum("ech,ehd->ecd", h, w_out)
+        out = y[expert, pos] * (prob * keep).astype(self.dtype)[:, None]
+        return out.reshape(b, t, d)
+
+
+def ep_param_specs(params, ep_axis: str = "ep"):
+    """PartitionSpecs sharding every MoE expert tensor over ``ep_axis``
+    (leading expert dim), everything else replicated — compose with
+    transformer.tp_param_specs for mixed tp x ep."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        names = "/".join(str(getattr(p, "key", getattr(p, "name", "")))
+                         for p in path)
+        if ("w_in" in names or "w_out" in names) and leaf.ndim == 3:
+            return P(ep_axis, None, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

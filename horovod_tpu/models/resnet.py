@@ -78,6 +78,13 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    # MXU-friendly stem: 2x2 space-to-depth folds the 3 input channels into
+    # 12 (a 7x7/s2 conv on 3 channels starves the 128-lane contraction dim),
+    # and the stride-2 conv becomes a dense 4x4/s1 conv on the half-res
+    # grid — the standard TPU ResNet trick (MLPerf submissions train
+    # ResNet-50 with exactly this stem). Same downsampling, same output
+    # shape, same parameter count class; not bit-equivalent to the 7x7.
+    space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -92,7 +99,13 @@ class ResNet(nn.Module):
         act = nn.relu
 
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        if self.space_to_depth:
+            n, h, w, c = x.shape
+            x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+            x = conv(self.num_filters, (4, 4), (1, 1), name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -53,6 +53,7 @@ class Block(nn.Module):
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
     sp_axis: Optional[str] = None  # sequence-parallel mesh axis (ring attention)
+    moe_experts: int = 0           # >0: switch-MoE MLP instead of dense
 
     @nn.compact
     def __call__(self, x, positions):
@@ -73,9 +74,16 @@ class Block(nn.Module):
         attn = attn.reshape(b, t, self.dim)
         x = x + nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="o_proj")(attn)
         h = nn.RMSNorm(dtype=self.dtype)(x)
-        h = nn.Dense(self.mlp_ratio * self.dim, use_bias=False, dtype=self.dtype, name="mlp_in")(h)
-        h = nn.gelu(h)
-        x = x + nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="mlp_out")(h)
+        if self.moe_experts > 0:
+            from .moe import MoEMLP
+
+            x = x + MoEMLP(dim=self.dim, hidden=self.mlp_ratio * self.dim,
+                           n_experts=self.moe_experts, dtype=self.dtype,
+                           name="moe")(h)
+        else:
+            h = nn.Dense(self.mlp_ratio * self.dim, use_bias=False, dtype=self.dtype, name="mlp_in")(h)
+            h = nn.gelu(h)
+            x = x + nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="mlp_out")(h)
         return x
 
 
@@ -87,6 +95,10 @@ class TransformerLM(nn.Module):
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
     sp_axis: Optional[str] = None
+    # >0 turns every `moe_every`-th block's MLP into a switch-MoE with this
+    # many experts (models/moe.py; shard experts over 'ep' via ep_param_specs)
+    moe_experts: int = 0
+    moe_every: int = 2
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -100,6 +112,9 @@ class TransformerLM(nn.Module):
                 mlp_ratio=self.mlp_ratio,
                 dtype=self.dtype,
                 sp_axis=self.sp_axis,
+                moe_experts=(self.moe_experts
+                             if self.moe_experts > 0 and i % self.moe_every == self.moe_every - 1
+                             else 0),
                 name=f"block_{i}",
             )(x, positions)
         x = nn.RMSNorm(dtype=self.dtype)(x)

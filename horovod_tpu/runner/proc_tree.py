@@ -5,69 +5,84 @@ its descendants (a training script that spawned data-loader or shell
 children keeps them running as orphans). The reference solves this with a
 fork middleman + psutil recursive kill
 (spark/util/safe_shell_exec.py:29-52); here each worker is launched in its
-own session (setsid) so the whole group can be signalled at once, with a
-psutil recursive sweep as the backstop for descendants that moved
-themselves into a new group.
+own session (setsid), and teardown enumerates the session's group members
+plus any descendants that escaped into their own group, then terminates
+them with ONE shared grace window for the whole world.
+
+Why enumerate instead of ``os.killpg``: by teardown time the worker may
+already be reaped (``Popen.wait``/``poll``), and a reaped pid is eligible
+for reuse — ``killpg`` on it could SIGKILL an unrelated new process group.
+Group membership, by contrast, is forgery-proof for everyone but the leader
+pid itself: a process group with id X can only be (re)created by the
+process whose pid IS X (``setsid``/``setpgid`` semantics), so members with
+``pid != X`` are genuinely ours, and psutil's create-time check guards each
+individual kill against pid reuse.
 """
 
 from __future__ import annotations
 
 import os
-import signal
 import subprocess
-import time
 
 GRACE_S = 5.0
 
 
-def _descendants(pid: int):
-    try:
-        import psutil
+def _collect_targets(procs):
+    import psutil
 
-        return psutil.Process(pid).children(recursive=True)
-    except Exception:
-        return []
-
-
-def terminate_tree(proc: subprocess.Popen, grace: float = GRACE_S) -> None:
-    """SIGTERM the worker's whole process group (it was started with
-    ``start_new_session=True``), give it ``grace`` seconds, then SIGKILL the
-    group and any descendants that escaped into their own group."""
-    terminate_trees([proc], grace=grace)
+    targets = {}
+    leaders_alive = {p.pid for p in procs if p.poll() is None}
+    pgids = {p.pid for p in procs}
+    for q in psutil.process_iter():
+        try:
+            pgid = os.getpgid(q.pid)
+        except (ProcessLookupError, PermissionError):
+            continue
+        if pgid not in pgids:
+            continue
+        # A process whose pid equals the (reaped) leader's pid is a pid-reuse
+        # imposter — the real leader is gone. Only the still-unreaped leader
+        # is a legitimate same-pid member.
+        if q.pid == pgid and q.pid not in leaders_alive:
+            continue
+        targets[q.pid] = q
+    # Descendants that setsid'd themselves out of the group (only reachable
+    # through a still-alive leader's process tree).
+    for p in procs:
+        if p.poll() is None:
+            try:
+                for d in psutil.Process(p.pid).children(recursive=True):
+                    targets[d.pid] = d
+            except psutil.NoSuchProcess:
+                pass
+    return list(targets.values())
 
 
 def terminate_trees(procs, grace: float = GRACE_S) -> None:
-    """Tear down many workers with ONE shared grace window: SIGTERM every
-    group first, wait once, then SIGKILL — teardown stays ~grace seconds
-    regardless of world size (a serial per-worker wait would cost
-    grace * num_proc on the failure path)."""
-    # Snapshot descendants BEFORE signalling: after a group dies their
-    # parentage is unreadable. Even when a worker itself already exited,
-    # its group may still hold grandchildren (they keep the pgid), so the
-    # group signals below always run.
-    escaped = {id(p): _descendants(p.pid) for p in procs}
-    for p in procs:
+    """Tear down the workers' whole process trees: SIGTERM every group
+    member and escaped descendant, wait one shared ``grace`` window, then
+    SIGKILL the survivors — teardown stays ~grace seconds regardless of
+    world size."""
+    procs = [p for p in procs if isinstance(p, subprocess.Popen)]
+    if not procs:
+        return
+    import psutil
+
+    targets = _collect_targets(procs)
+    for q in targets:
         try:
-            os.killpg(p.pid, signal.SIGTERM)
-        except (ProcessLookupError, PermissionError):
+            q.terminate()
+        except psutil.NoSuchProcess:
             pass
-    deadline = time.monotonic() + grace
-    while time.monotonic() < deadline:
-        if all(p.poll() is not None for p in procs):
-            break
-        time.sleep(0.1)
-    for p in procs:
+    _, alive = psutil.wait_procs(targets, timeout=grace)
+    for q in alive:
         try:
-            os.killpg(p.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
+            q.kill()
+        except psutil.NoSuchProcess:
             pass
-        for d in escaped[id(p)]:
+    for p in procs:
+        if p.poll() is None:
             try:
-                d.kill()
+                p.wait(timeout=grace)
             except Exception:
                 pass
-    for p in procs:
-        try:
-            p.wait(timeout=grace)
-        except Exception:
-            pass

@@ -55,3 +55,17 @@ def test_mlp_and_convnet():
         params = model.init(jax.random.PRNGKey(0), x)
         out = model.apply(params, x)
         assert out.shape == (2, 10)
+
+
+def test_resnet_space_to_depth_stem():
+    """s2d stem: same output shape and downsampling as the 7x7/s2 stem,
+    trains (finite grads) — the MXU-friendly MLPerf stem variant."""
+    model = zoo.ResNet18(num_classes=10, space_to_depth=True)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    # conv_init sees 12 channels (2x2 s2d of RGB) with a 4x4 kernel
+    k = variables["params"]["conv_init"]["kernel"]
+    assert k.shape == (4, 4, 12, 64)
+    assert np.isfinite(np.asarray(out)).all()
