@@ -9,7 +9,9 @@ Prints ONE JSON line:
 
 vs_baseline compares per-chip throughput against the reference's only
 published absolute number: 1656.82 img/s on 16 Pascal GPUs = 103.55 img/s
-per device (reference docs/benchmarks.md:22-38).
+per device — measured on ResNet-101 (reference docs/benchmarks.md:22-38),
+so the ratio is cross-model (BASELINE.md defines it this way; ResNet-101
+per-chip numbers for a like-for-like comparison are in docs/benchmarks.md).
 
 Batch-norm statistics are deliberately per-rank, exactly like the reference:
 Horovod averages *gradients* only, never BN running stats (each worker keeps

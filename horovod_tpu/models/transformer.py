@@ -54,9 +54,18 @@ class Block(nn.Module):
     dtype: Any = jnp.bfloat16
     sp_axis: Optional[str] = None  # sequence-parallel mesh axis (ring attention)
     moe_experts: int = 0           # >0: switch-MoE MLP instead of dense
+    attention: str = "dense"       # "dense" | "flash" (pallas fused kernel)
 
     @nn.compact
     def __call__(self, x, positions):
+        if self.attention not in ("dense", "flash"):
+            raise ValueError(
+                f"unknown attention={self.attention!r}; use 'dense' or 'flash'")
+        if self.attention == "flash" and self.sp_axis is not None:
+            raise ValueError(
+                "attention='flash' with sp_axis is not supported yet: the "
+                "sequence-parallel path runs ring attention; drop sp_axis or "
+                "use attention='dense'")
         head_dim = self.dim // self.heads
         h = nn.RMSNorm(dtype=self.dtype)(x)
         qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype, name="qkv")(h)
@@ -69,6 +78,10 @@ class Block(nn.Module):
             from ..ops.ring_attention import ring_attention
 
             attn = ring_attention(q, k, v, axis_name=self.sp_axis)
+        elif self.attention == "flash":
+            from ..ops.flash_attention import flash_attention
+
+            attn = flash_attention(q, k, v)
         else:
             attn = causal_attention(q, k, v)
         attn = attn.reshape(b, t, self.dim)
@@ -99,6 +112,11 @@ class TransformerLM(nn.Module):
     # many experts (models/moe.py; shard experts over 'ep' via ep_param_specs)
     moe_experts: int = 0
     moe_every: int = 2
+    # "flash" runs attention through the pallas fused kernel (O(T*D) HBM
+    # traffic; trains at sequence lengths where the dense schedule cannot
+    # even compile — measured on v5e: seq 8192 dense OOMs the compiler,
+    # flash runs). Sequence length must tile into 128-blocks.
+    attention: str = "dense"
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -112,6 +130,7 @@ class TransformerLM(nn.Module):
                 mlp_ratio=self.mlp_ratio,
                 dtype=self.dtype,
                 sp_axis=self.sp_axis,
+                attention=self.attention,
                 moe_experts=(self.moe_experts
                              if self.moe_experts > 0 and i % self.moe_every == self.moe_every - 1
                              else 0),

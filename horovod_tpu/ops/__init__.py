@@ -1,6 +1,8 @@
 """TPU compute ops beyond stock XLA: sequence-parallel attention schedules
-(ring / Ulysses), expert-parallel switch-MoE, and, as the framework grows,
-pallas kernels for the hot ops."""
+(ring / Ulysses), expert-parallel switch-MoE, and a pallas flash-attention
+kernel (fused, trainable) for the hot op."""
+
+from .flash_attention import flash_attention  # noqa: F401
 
 from .moe import (  # noqa: F401
     MoEParams,

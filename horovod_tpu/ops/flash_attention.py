@@ -1,0 +1,276 @@
+"""Fused causal attention as pallas TPU kernels (flash-attention schedule),
+forward AND backward — fully trainable.
+
+The transformer's attention is the one hot op XLA does not fuse into a
+single kernel: the naive schedule materializes the (T, T) logits in HBM
+(memory traffic O(T²) — the HBM-bandwidth wall at long sequence). These
+kernels compute attention block-by-block in VMEM with the online-softmax
+recurrence, so HBM traffic stays O(T·D) — the playbook case for pallas
+(/opt/skills/guides/pallas_guide.md; the algorithm is the published
+flash-attention recurrence).
+
+Three kernels behind one ``jax.custom_vjp``:
+- forward: one program per (batch·head, q-block); online (max, sum, acc)
+  carries over k-blocks; also emits the per-row logsumexp residual L.
+- backward dQ: same grid; recomputes p = exp(s − L) blockwise and
+  accumulates dQ = scale · Σ_k [p ∘ (dO·Vᵀ − D)] · K.
+- backward dK/dV: one program per (batch·head, k-block); loops over the
+  q-blocks at/after the diagonal, accumulating dV = Σ pᵀ·dO and
+  dK = scale · Σ [p ∘ (dO·Vᵀ − D)]ᵀ·Q.
+(D = rowsum(dO ∘ O) is an elementwise reduction computed outside.)
+
+Causal programs never touch the dead triangle: q-programs stop at their
+diagonal block, k-programs start at theirs.
+
+VMEM envelope: each program stages the full K/V row ((t, d) each, plus
+Q/dO in the dK/dV kernel), so per-program VMEM is O(T·D) — on a 16 MB-VMEM
+chip that means roughly seq <= 16k at d=64 / 8k at d=128 in bf16. HBM
+traffic is O(T·D) regardless (the flash property). Beyond the VMEM
+envelope, shard the sequence with ring attention (ring_attention.py) —
+or stream k-blocks through a third grid dimension, the known next step.
+
+Pairs with the sequence-parallel schedules in ring_attention.py (which move
+K/V between chips); `causal_reference` is the oracle both are tested
+against. On CPU (tests) the kernels run in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret_default():
+    return jax.devices()[0].platform not in ("tpu", "axon")
+
+
+# ------------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
+                seq_len, causal, sm_scale):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (block_q, d)
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros(q.shape, jnp.float32)
+    q_pos = qi * block_q + jax.lax.iota(jnp.int32, block_q)
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T                                      # (block_q, block_k)
+        if causal:
+            k_pos = i * block_k + jax.lax.iota(jnp.int32, block_k)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[:, None] + p @ v
+        return m_new, l, acc
+
+    n_blocks = (qi + 1) * (block_q // block_k) if causal else seq_len // block_k
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m, l, acc))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    # (8, block_q) sublane-replicated store: TPU block tiling wants the last
+    # two dims (8, 128)-aligned, so the per-row scalar rides 8 sublanes
+    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[None, :], (8, block_q))
+
+
+# ---------------------------------------------------------------- backward dQ
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_q, block_k, seq_len, causal, sm_scale):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]                                   # (block_q,)
+    delta = delta_ref[0, 0]                               # (block_q,)
+    dq = jnp.zeros(q.shape, jnp.float32)
+    q_pos = qi * block_q + jax.lax.iota(jnp.int32, block_q)
+
+    def body(i, dq):
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = (q @ k.T) * sm_scale
+        if causal:
+            k_pos = i * block_k + jax.lax.iota(jnp.int32, block_k)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None])
+        return dq + (ds @ k) * sm_scale
+
+    n_blocks = (qi + 1) * (block_q // block_k) if causal else seq_len // block_k
+    dq = jax.lax.fori_loop(0, n_blocks, body, dq)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+# ------------------------------------------------------------- backward dK/dV
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, *, block_q, block_k, seq_len, causal, sm_scale):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                      # (block_k, d)
+    v = v_ref[0].astype(jnp.float32)
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+    k_pos = ki * block_k + jax.lax.iota(jnp.int32, block_k)
+    n_q = seq_len // block_q
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        s = (q @ k.T) * sm_scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.iota(jnp.int32, block_q)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                     # (block_q, block_k)
+        dv = dv + p.T @ do
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None])
+        dk = dk + (ds.T @ q) * sm_scale
+        return dk, dv
+
+    # first q-block whose rows can see this k-block
+    start = (ki * block_k) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(start, n_q, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ----------------------------------------------------------------- public API
+
+def _check_blocks(t, block_q, block_k):
+    block_q = min(block_q, t)
+    block_k = min(block_k, block_q)
+    if t % block_q or block_q % block_k:
+        raise ValueError(
+            f"seq {t} must tile into block_q {block_q} (and block_q into "
+            f"block_k {block_k}); pad the sequence or shrink the blocks")
+    return block_q, block_k
+
+
+def _rows(x, b, t, h, d):
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _unrows(x, b, t, h, d):
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Fused attention, trainable. q, k, v: ``(B, T, H, D)`` (the layout
+    models/transformer.py uses). Sequence length must be a multiple of
+    ``block_q`` and ``block_q`` of ``block_k``. ``interpret=None``
+    auto-selects interpret mode off-TPU (CPU tests)."""
+    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    block_q, block_k = _check_blocks(t, block_q, block_k)
+    if interpret is None:
+        interpret = _interpret_default()
+    qr, kr, vr = (_rows(x, b, t, h, d) for x in (q, k, v))
+    kernel = functools.partial(
+        _fwd_kernel, block_q=block_q, block_k=block_k, seq_len=t,
+        causal=causal, sm_scale=d ** -0.5)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda r, qi: (r, qi, 0)),
+            pl.BlockSpec((1, t, d), lambda r, qi: (r, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda r, qi: (r, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda r, qi: (r, qi, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda r, qi: (r, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 8, t), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return _unrows(out, b, t, h, d), (q, k, v, out, lse)
+
+
+def _fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    out, res = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, res
+
+
+def _bwd_rule(causal, block_q, block_k, interpret, res, dout):
+    q, k, v, out, lse = res
+    b, t, h, d = q.shape
+    block_q, block_k = _check_blocks(t, block_q, block_k)
+    if interpret is None:
+        interpret = _interpret_default()
+    qr, kr, vr, dor = (_rows(x, b, t, h, d) for x in (q, k, v, dout))
+    outr = out  # saved in rows layout by _fwd
+    # D_i = rowsum(dO ∘ O): cheap elementwise reduction, done outside;
+    # broadcast to the same (rows, 8, t) sublane layout as lse
+    delta = jnp.sum(dor.astype(jnp.float32) * outr.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, None, :], (b * h, 8, t))
+
+    common = dict(block_q=block_q, block_k=block_k, seq_len=t, causal=causal,
+                  sm_scale=d ** -0.5)
+    full = lambda r, i: (r, 0, 0)  # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **common),
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda r, qi: (r, qi, 0)),
+            pl.BlockSpec((1, t, d), full),
+            pl.BlockSpec((1, t, d), full),
+            pl.BlockSpec((1, block_q, d), lambda r, qi: (r, qi, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda r, qi: (r, 0, qi)),
+            pl.BlockSpec((1, 8, block_q), lambda r, qi: (r, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda r, qi: (r, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **common),
+        grid=(b * h, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, t, d), full),
+            pl.BlockSpec((1, block_k, d), lambda r, ki: (r, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda r, ki: (r, ki, 0)),
+            pl.BlockSpec((1, t, d), full),
+            pl.BlockSpec((1, 8, t), lambda r, ki: (r, 0, 0)),
+            pl.BlockSpec((1, 8, t), lambda r, ki: (r, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda r, ki: (r, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda r, ki: (r, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lse, delta)
+
+    return (_unrows(dq, b, t, h, d), _unrows(dk, b, t, h, d),
+            _unrows(dv, b, t, h, d))
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)
