@@ -352,15 +352,19 @@ void Engine::execute_list(const ResponseList& list) {
 }
 
 void Engine::execute_entry(const ResponseEntry& re) {
-  // Pull this rank's contributions out of the tensor table. The coordinator
-  // only emits an entry when every rank (including us) contributed, so a
-  // miss is an engine bug, not a runtime condition.
+  // Pull this rank's contributions out of the tensor table. For OK entries
+  // the coordinator only emits when every rank (including us) contributed,
+  // so a miss is an engine bug. ERROR entries are different: a dead-rank
+  // failure covers tensors this rank may not have submitted yet, and a miss
+  // is expected.
   std::vector<Entry> ents;
   ents.reserve(re.names.size());
   for (auto& name : re.names) {
     auto it = table_.find(name);
     if (it == table_.end()) {
-      HVD_WARN("response for unknown tensor " + name + " (engine bug)");
+      if (re.kind != ResponseEntry::ERROR) {
+        HVD_WARN("response for unknown tensor " + name + " (engine bug)");
+      }
       continue;
     }
     ents.push_back(std::move(it->second));
@@ -735,7 +739,19 @@ std::vector<std::pair<std::string, int>> Coordinator::hello(
 
 void Coordinator::mark_departed(int rank) {
   std::lock_guard<std::mutex> g(mu_);
+  // Only reached from serve()'s error path: a clean departure breaks out of
+  // the serve loop via the shutdown flag instead. This rank is dead.
   departed_.insert(rank);
+  if (!dead_ranks_.count(rank)) {
+    dead_ranks_.insert(rank);
+    HVD_WARN("rank " + std::to_string(rank) +
+             " lost (connection dropped without shutdown); failing pending "
+             "collectives — restart from the last checkpoint");
+  }
+  // If every live rank is already parked in the tick barrier, complete the
+  // cycle now — build_response_list fails the pending tensors (dead_ranks_
+  // branch) and the waiters wake with errors instead of stalling. Live
+  // ranks that have not ticked yet get their errors on the next cycle.
   if (barrier_complete() && !contributed_.empty()) build_response_list();
   cv_.notify_all();
 }
@@ -831,13 +847,27 @@ void Coordinator::build_response_list() {
   for (auto& name : arrival_order_) {
     auto it = pending_.find(name);
     if (it == pending_.end()) continue;
-    if ((int)it->second.contribs.size() < world_) continue;
+    if ((int)it->second.contribs.size() < world_ && dead_ranks_.empty())
+      continue;
     ResponseEntry entry;
     if (shutdown_seen_) {
       entry.kind = ResponseEntry::ERROR;
       entry.op = it->second.contribs.begin()->second.op;
       entry.names = {name};
       entry.error = "Horovod has been shut down";
+    } else if (!dead_ranks_.empty()) {
+      // A rank died without shutting down: its contributions will never
+      // arrive and the ring through it is gone — no pending collective can
+      // complete. Fail them all with the dead ranks named (better than the
+      // reference, which stalls forever with warnings).
+      std::string who;
+      for (int r : dead_ranks_) who += (who.empty() ? "" : ", ") + std::to_string(r);
+      entry.kind = ResponseEntry::ERROR;
+      entry.op = it->second.contribs.begin()->second.op;
+      entry.names = {name};
+      entry.error = "rank(s) " + who +
+                    " lost (connection dropped without shutdown); collective "
+                    "cannot complete — restart from the last checkpoint";
     } else {
       validate(name, it->second.contribs, &entry);
     }

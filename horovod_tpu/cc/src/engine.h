@@ -242,6 +242,12 @@ class Coordinator {
   uint64_t gen_ = 0;
   std::set<int> contributed_;
   std::set<int> departed_;
+  // Ranks whose connection dropped WITHOUT the clean shutdown flag (crash,
+  // SIGKILL, network loss). Their tensors can never become ready and the
+  // ring through them is dead, so every pending and future collective is
+  // failed with an error naming them — survivors get a clean error + the
+  // checkpoint/resume story instead of the reference's indefinite stall.
+  std::set<int> dead_ranks_;
   bool shutdown_seen_ = false;
   ResponseList current_;
   std::map<std::string, PendingTensor> pending_;   // the message table

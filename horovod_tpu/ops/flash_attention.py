@@ -150,13 +150,25 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
 
 # ----------------------------------------------------------------- public API
 
-def _check_blocks(t, block_q, block_k):
+def _check_blocks(t, block_q, block_k, interpret):
     block_q = min(block_q, t)
     block_k = min(block_k, block_q)
     if t % block_q or block_q % block_k:
         raise ValueError(
             f"seq {t} must tile into block_q {block_q} (and block_q into "
-            f"block_k {block_k}); pad the sequence or shrink the blocks")
+            f"block_k {block_k}); pad the sequence or adjust the blocks")
+    if not interpret:
+        # TPU lowering: the lse/delta blocks are (1, 8, block_q), so their
+        # last dim must be 128-divisible (or the whole axis); the dK/dV
+        # kernel's (1, block_k, d) blocks need block_k 8-divisible likewise.
+        if block_q % 128 and block_q != t:
+            raise ValueError(
+                f"on TPU block_q must be a multiple of 128 (or equal the "
+                f"sequence length); got block_q={block_q}, seq={t}")
+        if block_k % 8 and block_k != t:
+            raise ValueError(
+                f"on TPU block_k must be a multiple of 8 (or equal the "
+                f"sequence length); got block_k={block_k}, seq={t}")
     return block_q, block_k
 
 
@@ -181,9 +193,9 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
     b, t, h, d = q.shape
-    block_q, block_k = _check_blocks(t, block_q, block_k)
     if interpret is None:
         interpret = _interpret_default()
+    block_q, block_k = _check_blocks(t, block_q, block_k, interpret)
     qr, kr, vr = (_rows(x, b, t, h, d) for x in (q, k, v))
     kernel = functools.partial(
         _fwd_kernel, block_q=block_q, block_k=block_k, seq_len=t,
@@ -209,17 +221,12 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
     return _unrows(out, b, t, h, d), (q, k, v, out, lse)
 
 
-def _fwd_rule(q, k, v, causal, block_q, block_k, interpret):
-    out, res = _fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out, res
-
-
 def _bwd_rule(causal, block_q, block_k, interpret, res, dout):
     q, k, v, out, lse = res
     b, t, h, d = q.shape
-    block_q, block_k = _check_blocks(t, block_q, block_k)
     if interpret is None:
         interpret = _interpret_default()
+    block_q, block_k = _check_blocks(t, block_q, block_k, interpret)
     qr, kr, vr, dor = (_rows(x, b, t, h, d) for x in (q, k, v, dout))
     outr = out  # saved in rows layout by _fwd
     # D_i = rowsum(dO ∘ O): cheap elementwise reduction, done outside;
@@ -273,4 +280,4 @@ def _bwd_rule(causal, block_q, block_k, interpret, res, dout):
             _unrows(dv, b, t, h, d))
 
 
-flash_attention.defvjp(_fwd_rule, _bwd_rule)
+flash_attention.defvjp(_fwd, _bwd_rule)

@@ -57,9 +57,16 @@ def launch_world(world: int, script: str, extra_env=None, per_rank_env=None,
             if check:
                 assert p.returncode == 0, f"rank failed:\n{stderr[-3000:]}"
             out = stdout.strip().splitlines()
+            parsed = None
+            if out:
+                try:
+                    parsed = json.loads(out[-1])
+                except ValueError:
+                    if check:
+                        raise
             results.append({
                 "rc": p.returncode,
-                "out": json.loads(out[-1]) if check and out else None,
+                "out": parsed,
                 "stderr": stderr,
             })
     finally:

@@ -239,3 +239,47 @@ def test_wrong_secret_rejected():
         p0.kill()
         p1.kill()
         p0.communicate(timeout=10)
+
+
+@pytest.mark.slow
+def test_peer_death_mid_collective_fails_cleanly():
+    """Kill one rank mid-stream: the survivors' collectives must FAIL (ring
+    transport error or abort) — never hang past the transfer deadline and
+    never deliver silently corrupt data (the ring-error latch: a desynced
+    peer stream has no resync point, so the engine fails everything and
+    departs)."""
+    script = PRELUDE + textwrap.dedent("""
+        import os, signal, time
+        eng = NativeEngine(topo, Config(cycle_time_ms=2.0))
+        # one good collective so the ring is fully established
+        out = eng.run("allreduce", np.full(1024, float(rank)), "warm")
+        ok_warm = bool(np.allclose(out, np.mean(range(world))))
+
+        if rank == 2:
+            os.kill(os.getpid(), signal.SIGKILL)  # die without cleanup
+
+        # Large payload: the transfer is mid-stream when rank 2 dies.
+        results = []
+        for i in range(3):
+            try:
+                eng.run("allreduce", np.full(2_000_000, float(rank)),
+                        f"big{i}", average=False)
+                results.append("ok")
+            except Exception as e:
+                results.append(type(e).__name__ + ":" + str(e)[:80])
+        try:
+            eng.shutdown()
+        except Exception:
+            pass
+        print(json.dumps({"warm": ok_warm, "results": results}))
+    """)
+    res = launch_world(3, script, timeout=120, check=False)
+    assert res[2]["rc"] != 0  # the killed rank
+    for r in (res[0], res[1]):
+        assert r["rc"] == 0, f"survivor crashed instead of erroring:\n{r['stderr'][-2000:]}"
+        out = r["out"]
+        assert out is not None, f"survivor printed no result:\n{r['stderr'][-2000:]}"
+        assert out["warm"] is True
+        # every post-death collective errored; none "succeeded" against a
+        # dead peer
+        assert all(x != "ok" for x in out["results"]), out["results"]
