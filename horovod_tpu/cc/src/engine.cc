@@ -698,10 +698,19 @@ void Coordinator::serve(int fd) {
     }
     // Handshake done: drop the deadline — an authenticated worker may
     // legitimately go quiet between ticks for longer than the handshake
-    // bound (long compute, debugger, GC pause).
+    // bound (long compute, debugger, GC pause). TCP keepalive covers the
+    // silent-loss case instead (host power/network loss sends no FIN/RST;
+    // without keepalive the serve thread would block in recv forever and
+    // dead-rank detection would never fire): probe after 60s idle, every
+    // 10s, give up after 6 misses -> loss detected within ~2 minutes.
     timeval none{0, 0};
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &none, sizeof(none));
     ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &none, sizeof(none));
+    int ka = 1, idle = 60, intvl = 10, cnt = 6;
+    ::setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &ka, sizeof(ka));
+    ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &idle, sizeof(idle));
+    ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof(intvl));
+    ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof(cnt));
     while (!stop_.load()) {
       auto frame = recv_frame(fd);
       Reader r(frame.data(), frame.size());

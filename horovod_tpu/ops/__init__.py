@@ -11,4 +11,11 @@ from .moe import (  # noqa: F401
     moe_apply,
     top1_route,
 )
-from .ring_attention import causal_reference, ring_attention, ulysses_attention  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    causal_reference,
+    ring_attention,
+    ulysses_attention,
+    zigzag_positions,
+    zigzag_shard,
+    zigzag_unshard,
+)

@@ -7,7 +7,11 @@ axis:
 - :func:`ring_attention` — blockwise causal attention with online softmax;
   K/V blocks rotate around the ring via ``ppermute`` so each hop rides a
   single ICI link while the current block's matmuls run on the MXU
-  (communication hides behind compute for T_local*D large enough).
+  (communication hides behind compute for T_local*D large enough). The
+  per-step local block product currently runs as XLA einsums; routing it
+  through the pallas flash kernel (flash_attention.py, exposing its
+  unnormalized (acc, m, l) carries + global position offsets via scalar
+  prefetch) is the known next fusion step for very large local blocks.
 - :func:`ulysses_attention` — all-to-all re-shard: trade the sequence shard
   for a head shard, run dense local attention, trade back. Cheaper at modest
   sequence lengths when heads % devices == 0.
@@ -23,15 +27,14 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _block_update(q, k, v, o, m, l, q_offset, k_offset, scale):
+def _block_update(q, k, v, o, m, l, q_pos, k_pos, scale):
     """One flash-attention accumulation step with global causal masking.
 
     o: [B,T,H,D] f32 accumulator; m, l: [B,H,T] f32 running max / normalizer.
+    q_pos/k_pos: global sequence positions of the local rows (explicit so
+    non-contiguous layouts — zigzag — mask correctly).
     """
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    t_q, t_k = q.shape[1], k.shape[1]
-    q_pos = q_offset + jnp.arange(t_q)
-    k_pos = k_offset + jnp.arange(t_k)
     mask = (q_pos[:, None] >= k_pos[None, :])[None, None]  # [1,1,Tq,Tk]
     logits = jnp.where(mask, logits, -jnp.inf)
 
@@ -46,13 +49,59 @@ def _block_update(q, k, v, o, m, l, q_offset, k_offset, scale):
     return o_new, m_new, l_new
 
 
-def ring_attention(q, k, v, axis_name: str):
+def zigzag_positions(rank_idx, t_local: int, n: int):
+    """Global positions of rank ``rank_idx``'s tokens under zigzag sharding:
+    the sequence is cut into 2n stripes and rank r holds stripes r and
+    2n-1-r, so every rank sees the same causal workload (contiguous
+    sharding leaves rank 0 with almost no unmasked keys and rank n-1 with
+    all of them). ``rank_idx`` may be a traced ``lax.axis_index``."""
+    half = t_local // 2
+    i = jnp.arange(t_local)
+    low = rank_idx * half + i
+    high = (2 * n - 1 - rank_idx) * half + (i - half)
+    return jnp.where(i < half, low, high)
+
+
+def _zigzag_order(t: int, n: int):
+    """The permutation both shard and unshard derive from: stripe r then
+    stripe 2n-1-r for each rank r."""
+    if t % (2 * n):
+        raise ValueError(f"sequence {t} must divide into 2*{n} stripes")
+    half = t // (2 * n)
+    order = []
+    for r in range(n):
+        order.extend(range(r * half, (r + 1) * half))
+        order.extend(range((2 * n - 1 - r) * half, (2 * n - r) * half))
+    return order
+
+
+def zigzag_shard(x, n: int, axis: int = 1):
+    """Host-side layout change: reorder the FULL sequence so that a plain
+    contiguous split over ``n`` ranks hands each rank its two zigzag
+    stripes. Apply to tokens before sharding (and to targets/positions the
+    same way); invert with :func:`zigzag_unshard`."""
+    return jnp.take(x, jnp.asarray(_zigzag_order(x.shape[axis], n)), axis=axis)
+
+
+def zigzag_unshard(x, n: int, axis: int = 1):
+    """Inverse permutation of :func:`zigzag_shard`."""
+    order = _zigzag_order(x.shape[axis], n)
+    inv = [0] * len(order)
+    for i, o in enumerate(order):
+        inv[o] = i
+    return jnp.take(x, jnp.asarray(inv), axis=axis)
+
+
+def ring_attention(q, k, v, axis_name: str, zigzag: bool = False):
     """Causal ring attention over ``axis_name`` (sequence-sharded).
 
-    TODO(perf): with contiguous sequence sharding, blocks from src > rank are
-    fully masked, so ~half the ring steps do dead work. Zigzag/striped
-    sharding (each rank holds a low and a high sequence stripe) balances the
-    causal load; requires remapping positions at the caller.
+    With contiguous sharding (default), blocks from src > rank are fully
+    masked — ~half the ring steps do dead work and the last rank is the
+    critical path. ``zigzag=True`` assumes the zigzag layout
+    (:func:`zigzag_shard` at the caller: rank r holds stripes r and
+    2n-1-r), which balances the causal workload across ranks; the masking
+    uses explicit global positions so correctness is independent of the
+    layout (oracle-tested both ways).
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -63,13 +112,31 @@ def ring_attention(q, k, v, axis_name: str):
     m = jnp.full((b, h, t), -jnp.inf, jnp.float32)
     l = jnp.zeros((b, h, t), jnp.float32)
 
-    q_offset = my * t
+    def positions(rank_idx):
+        if zigzag:
+            return zigzag_positions(rank_idx, t, n)
+        return rank_idx * t + jnp.arange(t)
+
+    q_pos = positions(my)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     k_blk, v_blk = k, v
     for step in range(n):
         src = (my - step) % n
-        o, m, l = _block_update(q, k_blk, v_blk, o, m, l, q_offset, src * t, scale)
+        k_pos = positions(src)
+        # Skip fully-masked blocks (every key in the future of every query):
+        # with contiguous sharding that is every block from src > rank —
+        # rank 0 skips n-1 of n steps, rank n-1 none, which is exactly the
+        # imbalance zigzag exists to fix (each rank then holds one early and
+        # one late stripe, so skipped work evens out across ranks).
+        fully_masked = jnp.max(q_pos) < jnp.min(k_pos)
+        o, m, l = lax.cond(
+            fully_masked,
+            lambda o, m, l, *_: (o, m, l),
+            lambda o, m, l, kb, vb, kp: _block_update(
+                q, kb, vb, o, m, l, q_pos, kp, scale),
+            o, m, l, k_blk, v_blk, k_pos,
+        )
         if step + 1 < n:
             k_blk = lax.ppermute(k_blk, axis_name, perm)
             v_blk = lax.ppermute(v_blk, axis_name, perm)
