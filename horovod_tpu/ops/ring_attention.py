@@ -55,6 +55,10 @@ def zigzag_positions(rank_idx, t_local: int, n: int):
     2n-1-r, so every rank sees the same causal workload (contiguous
     sharding leaves rank 0 with almost no unmasked keys and rank n-1 with
     all of them). ``rank_idx`` may be a traced ``lax.axis_index``."""
+    if t_local % 2:
+        raise ValueError(
+            f"zigzag needs an even per-rank sequence (two stripes); got "
+            f"t_local={t_local}")
     half = t_local // 2
     i = jnp.arange(t_local)
     low = rank_idx * half + i

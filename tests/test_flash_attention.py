@@ -52,10 +52,22 @@ def test_non_causal_full_softmax():
                                atol=2e-6, rtol=2e-6)
 
 
-def test_bad_block_tiling_rejected():
+def test_block_sizes_are_ceilings():
+    """Requested block sizes auto-shrink to the largest conforming divisor
+    of the sequence (96 with a 64 ceiling fits at 48) — and still match the
+    oracle."""
+    from horovod_tpu.ops.flash_attention import _check_blocks
+
+    assert _check_blocks(96, 64, 64, True) == (48, 48)
+    # TPU quantum: non-divisible seqs fall back to whole-sequence blocks
+    assert _check_blocks(1536, 1024, 512, False) == (768, 384)
+    assert _check_blocks(130, 1024, 512, False) == (130, 130)
     q, k, v = qkv(3, t=96)
-    with pytest.raises(ValueError, match="tile"):
-        flash_attention(q, k, v, True, 64, 64)  # 96 % 64 != 0
+    with jax.default_matmul_precision("highest"):
+        out = flash_attention(q, k, v, True, 64, 64)
+        ref = causal_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
 
 
 def test_transformer_flash_equals_dense():
