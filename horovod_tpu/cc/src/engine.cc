@@ -158,6 +158,8 @@ Engine::~Engine() { shutdown(); }
 int64_t Engine::enqueue(OpType op, const std::string& name, DataType dtype,
                         const std::vector<int64_t>& shape, const void* data,
                         int root_rank, bool average) {
+  // Best-effort fast path: skip the tensor copy when already shut down.
+  // The authoritative check is under qmu_ below (no lost-entry race).
   if (shutdown_.load()) throw std::runtime_error("Horovod has been shut down");
   if (shape.empty() &&
       (op == OpType::ALLGATHER || op == OpType::REDUCESCATTER ||
@@ -184,6 +186,12 @@ int64_t Engine::enqueue(OpType op, const std::string& name, DataType dtype,
   e.enqueued = std::chrono::steady_clock::now();
   {
     std::lock_guard<std::mutex> g(qmu_);
+    // Checked under qmu_: the loop's final fail_everything sweep swaps
+    // queue_ under this lock AFTER shutdown_ is set, so a push here either
+    // precedes the sweep (and is swept) or observes shutdown_ and throws —
+    // an unlocked check-then-push could slip an entry in after the sweep
+    // and leave its handle waiting forever.
+    if (shutdown_.load()) throw std::runtime_error("Horovod has been shut down");
     if (!inflight_.insert(e.req.name).second) {
       throw std::runtime_error(
           "Duplicate tensor name " + e.req.name +
@@ -294,8 +302,10 @@ bool Engine::tick_multiprocess(bool shutting) {
   try {
     out = coord_ ? coord_->tick(topo_.rank, t) : client_->tick(t);
   } catch (const std::exception& ex) {
-    fail_everything(std::string("control plane failed: ") + ex.what());
+    // Order matters: latch shutdown FIRST so no new enqueue can slip past
+    // the sweep (enqueue re-checks under qmu_), then fail everything.
     shutdown_.store(true);
+    fail_everything(std::string("control plane failed: ") + ex.what());
     return false;
   }
   if (out.knob_version != applied_knob_version_.load()) {
@@ -317,8 +327,10 @@ bool Engine::tick_multiprocess(bool shutting) {
     // coordinately. Keep looping for one more tick — that tick runs with
     // shutting=true and ships t.shutdown=1, so the coordinator marks this
     // rank departed instead of stalling the tick barrier for the peers.
-    fail_everything(ring_error_);
+    // Latch shutdown BEFORE the sweep (same invariant as the control-plane
+    // catch): enqueue re-checks under qmu_, so nothing slips in unswept.
     shutdown_.store(true);
+    fail_everything(ring_error_);
     return true;
   }
   if (out.shutdown && !shutting) {

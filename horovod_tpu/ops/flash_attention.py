@@ -181,7 +181,11 @@ def _fit_block(t, want, quantum):
         if t % b == 0:
             return b
         b -= quantum
-    return None
+    # No conforming divisor at all (e.g. t = 8*prime): the whole axis is
+    # always a legal block ("equal to the respective dimension"), so fall
+    # back to it — correct, though VMEM-heavy for very long non-tileable
+    # sequences, where padding to a friendlier length is the better call.
+    return t
 
 
 def _check_blocks(t, block_q, block_k, interpret):
@@ -192,15 +196,7 @@ def _check_blocks(t, block_q, block_k, interpret):
     q_quantum = 1 if interpret else 128
     k_quantum = 1 if interpret else 8
     bq = _fit_block(t, min(block_q, t), q_quantum)
-    if bq is None:
-        raise ValueError(
-            f"sequence {t} has no block_q divisor that satisfies TPU tiling "
-            f"(multiple of {q_quantum}); pad the sequence")
     bk = _fit_block(bq, min(block_k, bq), k_quantum)
-    if bk is None:
-        raise ValueError(
-            f"block_q {bq} has no block_k divisor that satisfies TPU tiling "
-            f"(multiple of {k_quantum}); pad the sequence")
     return bq, bk
 
 

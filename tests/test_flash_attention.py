@@ -59,9 +59,13 @@ def test_block_sizes_are_ceilings():
     from horovod_tpu.ops.flash_attention import _check_blocks
 
     assert _check_blocks(96, 64, 64, True) == (48, 48)
-    # TPU quantum: non-divisible seqs fall back to whole-sequence blocks
+    # TPU quantum: blocks shrink to the largest conforming divisor
     assert _check_blocks(1536, 1024, 512, False) == (768, 384)
+    # ...or fall back to the always-legal whole axis when none exists
     assert _check_blocks(130, 1024, 512, False) == (130, 130)
+    assert _check_blocks(1160, 1024, 512, False) == (1160, 232)
+    # sub-quantum ceilings round up to the quantum
+    assert _check_blocks(4096, 64, 64, False) == (128, 64)
     q, k, v = qkv(3, t=96)
     with jax.default_matmul_precision("highest"):
         out = flash_attention(q, k, v, True, 64, 64)
