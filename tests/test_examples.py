@@ -53,6 +53,7 @@ def test_pytorch_synthetic_benchmark_2proc():
     assert "Total img/sec on 2 device(s)" in out
 
 
+@pytest.mark.slow
 def test_pytorch_mnist_callbacks_2proc():
     out = run_example([
         sys.executable, "-m", "horovod_tpu.runner", "-np", "2", "--",

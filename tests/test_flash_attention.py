@@ -74,6 +74,7 @@ def test_block_sizes_are_ceilings():
                                atol=2e-6, rtol=2e-6)
 
 
+@pytest.mark.slow
 def test_transformer_flash_equals_dense():
     from horovod_tpu.models import TransformerLM
 

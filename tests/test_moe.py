@@ -54,6 +54,7 @@ def run_ep(ep_mesh, params, x, capacity):
     ), static_argnums=())(params.gate, params.w_in, params.w_out, x)
 
 
+@pytest.mark.slow
 def test_moe_matches_dense_oracle(ep_mesh):
     params = init_moe_params(jax.random.PRNGKey(0), DIM, HIDDEN, EXPERTS, EP)
     x = jax.random.normal(jax.random.PRNGKey(1), (TOKENS * EP, DIM))
@@ -121,6 +122,7 @@ def test_load_balancing_loss_uniform_is_one():
     assert lb == pytest.approx(1.0, abs=0.05)
 
 
+@pytest.mark.slow
 def test_moe_transformer_and_ep_specs(ep_mesh):
     """TransformerLM with MoE blocks: forward + finite grads + sowed
     load-balance loss; and GSPMD expert sharding (ep_param_specs) produces

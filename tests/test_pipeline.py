@@ -72,6 +72,7 @@ def test_pipeline_matches_sequential(pp_mesh):
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_grads_match_sequential(pp_mesh):
     """jax.grad through the pipeline == grads of the dense model: the
     backward pipeline needs no hand-written schedule."""

@@ -101,6 +101,7 @@ def test_transformer_sp_equals_dense(sp_mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_zigzag_ring_matches_oracle(sp_mesh):
     """Zigzag layout (load-balanced causal sharding): shard the zigzag-
     reordered sequence, run ring attention with zigzag masking, undo the
