@@ -61,11 +61,6 @@ class Block(nn.Module):
         if self.attention not in ("dense", "flash"):
             raise ValueError(
                 f"unknown attention={self.attention!r}; use 'dense' or 'flash'")
-        if self.attention == "flash" and self.sp_axis is not None:
-            raise ValueError(
-                "attention='flash' with sp_axis is not supported yet: the "
-                "sequence-parallel path runs ring attention; drop sp_axis or "
-                "use attention='dense'")
         head_dim = self.dim // self.heads
         h = nn.RMSNorm(dtype=self.dtype)(x)
         qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype, name="qkv")(h)
@@ -75,9 +70,14 @@ class Block(nn.Module):
         k = _rope(k.reshape(b, t, self.heads, head_dim), positions)
         v = v.reshape(b, t, self.heads, head_dim)
         if self.sp_axis is not None:
-            from ..ops.ring_attention import ring_attention
+            if self.attention == "flash":
+                from ..ops.ring_flash import ring_flash_attention
 
-            attn = ring_attention(q, k, v, axis_name=self.sp_axis)
+                attn = ring_flash_attention(q, k, v, axis_name=self.sp_axis)
+            else:
+                from ..ops.ring_attention import ring_attention
+
+                attn = ring_attention(q, k, v, axis_name=self.sp_axis)
         elif self.attention == "flash":
             from ..ops.flash_attention import flash_attention
 
@@ -115,7 +115,9 @@ class TransformerLM(nn.Module):
     # "flash" runs attention through the pallas fused kernel (O(T*D) HBM
     # traffic; trains at sequence lengths where the dense schedule cannot
     # even compile — measured on v5e: seq 8192 dense OOMs the compiler,
-    # flash runs). Sequence length must tile into 128-blocks.
+    # flash runs). Sequence length must tile into 128-blocks. Combined
+    # with sp_axis it selects ring_flash_attention: ring schedule between
+    # chips, fused flash blocks within each chip.
     attention: str = "dense"
 
     @nn.compact

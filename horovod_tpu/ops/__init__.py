@@ -11,6 +11,7 @@ from .moe import (  # noqa: F401
     moe_apply,
     top1_route,
 )
+from .ring_flash import ring_flash_attention  # noqa: F401
 from .ring_attention import (  # noqa: F401
     causal_reference,
     ring_attention,

@@ -8,10 +8,11 @@ axis:
   K/V blocks rotate around the ring via ``ppermute`` so each hop rides a
   single ICI link while the current block's matmuls run on the MXU
   (communication hides behind compute for T_local*D large enough). The
-  per-step local block product currently runs as XLA einsums; routing it
-  through the pallas flash kernel (flash_attention.py, exposing its
-  unnormalized (acc, m, l) carries + global position offsets via scalar
-  prefetch) is the known next fusion step for very large local blocks.
+  per-step local block product runs as XLA einsums — simple and fine for
+  moderate local blocks; ring_flash.py is the fused variant that routes
+  the block product through position-aware pallas flash kernels with the
+  (acc, m, l) state carried across ring steps (use it when T_local is
+  large enough that the (T_local, T_local) logits block stresses HBM).
 - :func:`ulysses_attention` — all-to-all re-shard: trade the sequence shard
   for a head shard, run dense local attention, trade back. Cheaper at modest
   sequence lengths when heads % devices == 0.
