@@ -1,0 +1,35 @@
+import time, jax, jax.numpy as jnp, numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+from horovod_tpu.ops.ring_attention import ring_attention
+from horovod_tpu.ops.ring_flash import ring_flash_attention
+from horovod_tpu.ops.flash_attention import flash_attention
+
+mesh = Mesh(np.asarray(jax.devices()[:1]), ("sp",))
+REPS = 20
+
+def chain(fn):
+    def run(q, k, v):
+        def body(i, q):
+            o = fn(q, k, v)
+            return o.astype(q.dtype) * 1e-3 + q  # dependency, keep scale sane
+        return jax.lax.fori_loop(0, REPS, body, q)
+    return jax.jit(run)
+
+def timeit(f, *a):
+    float(jnp.sum(f(*a)))
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter(); float(jnp.sum(f(*a))); ts.append(time.perf_counter()-t0)
+    return min(ts)
+
+b,h,d = 4,8,64
+sm = lambda fn: shard_map(fn, mesh=mesh, in_specs=P(None,"sp"), out_specs=P(None,"sp"), check_vma=False)
+for t in (2048, 4096, 8192):
+    ks = jax.random.split(jax.random.PRNGKey(0),3)
+    q,k,v = (jax.random.normal(kk,(b,t,h,d),jnp.bfloat16) for kk in ks)
+    base = timeit(jax.jit(lambda a,bb,c: a), q,k,v)
+    tfl = (timeit(chain(lambda a,bb,c: flash_attention(a,bb,c)), q,k,v) - base)/REPS
+    trf = (timeit(chain(sm(lambda a,bb,c: ring_flash_attention(a,bb,c,"sp"))), q,k,v) - base)/REPS
+    trx = (timeit(chain(sm(lambda a,bb,c: ring_attention(a,bb,c,"sp"))), q,k,v) - base)/REPS
+    print(f"t={t} fwd/call: flash {tfl*1e3:.2f} ms | ring_flash {trf*1e3:.2f} ms | ring_einsum {trx*1e3:.2f} ms | einsum/fused {trx/trf:.2f}x", flush=True)

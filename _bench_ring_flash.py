@@ -1,0 +1,30 @@
+"""On-chip: per-ring-step local block product, einsum schedule vs fused."""
+import time, jax, jax.numpy as jnp, numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+from horovod_tpu.ops.ring_attention import ring_attention
+from horovod_tpu.ops.ring_flash import ring_flash_attention
+
+mesh = Mesh(np.asarray(jax.devices()[:1]), ("sp",))
+def run(fn, q, k, v, w):
+    f = jax.jit(jax.value_and_grad(lambda a,b,c: jnp.sum(
+        shard_map(fn, mesh=mesh, in_specs=P(None,"sp"), out_specs=P(None,"sp"),
+                  check_vma=False)(a,b,c).astype(jnp.float32)*w), argnums=(0,1,2)))
+    out = f(q,k,v); jax.block_until_ready(out)  # compile
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter(); jax.block_until_ready(f(q,k,v))
+        times.append(time.perf_counter()-t0)
+    return min(times)
+
+for t in (2048, 4096, 8192):
+    b,h,d = 1,8,64
+    ks = jax.random.split(jax.random.PRNGKey(0),3)
+    q,k,v = (jax.random.normal(kk,(b,t,h,d),jnp.bfloat16) for kk in ks)
+    w = jax.random.normal(jax.random.PRNGKey(9),(b,t,h,d),jnp.float32)
+    tf = run(lambda a,bb,c: ring_flash_attention(a,bb,c,"sp"), q,k,v,w)
+    try:
+        tx = run(lambda a,bb,c: ring_attention(a,bb,c,"sp"), q,k,v,w)
+    except Exception as e:
+        tx = float('nan'); print(f"t={t}: einsum ring failed: {type(e).__name__}")
+    print(f"t_local={t}: einsum {tx*1e3:.1f} ms  fused {tf*1e3:.1f} ms  speedup {tx/tf:.2f}x", flush=True)

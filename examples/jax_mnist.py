@@ -23,6 +23,9 @@ from jax.sharding import PartitionSpec as P
 import horovod_tpu as hvd
 from horovod_tpu.models import ConvNet
 
+EPOCHS = int(os.environ.get("MNIST_EPOCHS", "3"))
+STEPS = int(os.environ.get("MNIST_STEPS", "10"))
+
 
 def synthetic_mnist(n, seed):
     rng = np.random.default_rng(seed)
@@ -67,16 +70,16 @@ def main():
     # 5. initial-state consistency: replicated init above is already
     # identical; after a checkpoint restore use hvd.jax.broadcast_parameters.
     batch = 32 * n_dev
-    for epoch in range(3):
-        x, y = synthetic_mnist(batch * 10, seed=epoch)
+    for epoch in range(EPOCHS):
+        x, y = synthetic_mnist(batch * STEPS, seed=epoch)
         epoch_loss = 0.0
-        for i in range(10):
+        for i in range(STEPS):
             xb = jnp.asarray(x[i * batch:(i + 1) * batch])
             yb = jnp.asarray(y[i * batch:(i + 1) * batch])
             params, opt_state, loss = step(params, opt_state, xb, yb)
             epoch_loss += float(loss)
         if hvd.rank() == 0:
-            print(f"epoch {epoch}: loss {epoch_loss / 10:.4f}")
+            print(f"epoch {epoch}: loss {epoch_loss / STEPS:.4f}")
     hvd.shutdown()
 
 

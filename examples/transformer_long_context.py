@@ -34,6 +34,11 @@ def main():
     parser.add_argument("--dim", type=int, default=256)
     parser.add_argument("--layers", type=int, default=4)
     parser.add_argument("--steps", type=int, default=5)
+    parser.add_argument("--attention", choices=["dense", "flash"],
+                        default="dense",
+                        help="'flash' fuses each ring step's local block "
+                             "product as pallas kernels (ops/ring_flash.py) "
+                             "— the schedule for very long per-shard blocks")
     parser.add_argument("--virtual-devices", type=int, default=0,
                         help="force an N-device virtual CPU mesh (for trying "
                              "the schedule without a pod)")
@@ -60,7 +65,8 @@ def main():
         raise SystemExit(f"--seq-len must be divisible by sp={sp}")
 
     model = TransformerLM(vocab=256, dim=args.dim, heads=8,
-                          layers=args.layers, sp_axis="sp")
+                          layers=args.layers, sp_axis="sp",
+                          attention=args.attention)
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, 256, size=(2 * args.dp, args.seq_len)),
         jnp.int32)

@@ -335,9 +335,14 @@ bool Engine::tick_multiprocess(bool shutting) {
   }
   if (out.shutdown && !shutting) {
     // Another rank initiated shutdown; exit together (reference
-    // operations.cc:2125-2128). New enqueues fail from here on.
+    // operations.cc:2125-2128). New enqueues fail from here on. Keep
+    // looping for ONE more tick so the departure is announced: that tick
+    // runs with shutting=true and ships t.shutdown=1, letting the
+    // coordinator record a clean departure — dropping out silently here
+    // would make the serve thread see a bare EOF later and warn
+    // "rank N lost" on every normal shutdown.
     shutdown_.store(true);
-    return false;
+    return true;
   }
   return !shutting;
 }

@@ -13,7 +13,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_example(cmd, timeout=300, env_extra=None):
+def run_example(cmd, timeout=300, env_extra=None, with_stderr=False):
     env = dict(os.environ)
     # Append (never replace) PYTHONPATH: the image's sitecustomize path on it
     # registers the TPU plugin; clobbering it breaks jax in subprocesses.
@@ -22,7 +22,7 @@ def run_example(cmd, timeout=300, env_extra=None):
     proc = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout,
                           cwd=REPO, env=env)
     assert proc.returncode == 0, f"stderr:\n{proc.stderr[-3000:]}"
-    return proc.stdout
+    return (proc.stdout, proc.stderr) if with_stderr else proc.stdout
 
 
 @pytest.mark.slow
@@ -37,7 +37,8 @@ def test_pytorch_mnist_example_2proc():
 
 @pytest.mark.slow
 def test_jax_mnist_example_single():
-    out = run_example([sys.executable, "examples/jax_mnist.py"])
+    out = run_example([sys.executable, "examples/jax_mnist.py"],
+                      env_extra={"MNIST_STEPS": "3"})
     assert "epoch 2" in out
 
 
@@ -63,6 +64,36 @@ def test_pytorch_mnist_callbacks_2proc():
     assert "averaged over 2 ranks" in out
     # warmup ramped lr toward lr*size=0.02 over 2 epochs
     assert "lr 0.0200" in out
+
+
+@pytest.mark.slow
+def test_jax_mnist_advanced_2proc():
+    """keras_mnist_advanced twin: warmup ramps lr toward base*size and the
+    epoch-end metrics are engine-averaged across ranks."""
+    out = run_example([
+        sys.executable, "-m", "horovod_tpu.runner", "-np", "2", "--",
+        sys.executable, "examples/jax_mnist_advanced.py",
+    ], env_extra={"MNIST_EPOCHS": "3", "MNIST_STEPS": "4"})
+    assert "epoch 2" in out
+    assert "averaged over 2 ranks" in out
+    assert "lr 0.0100" in out  # base 0.005 ramped to base*size at warmup end
+
+
+@pytest.mark.slow
+def test_jax_mnist_eager_2proc():
+    """tensorflow_mnist_eager twin: gradients allreduced per step through
+    the background engine, not in-jit collectives."""
+    out, err = run_example([
+        sys.executable, "-m", "horovod_tpu.runner", "-np", "2", "--",
+        sys.executable, "examples/jax_mnist_eager.py",
+    ], env_extra={"MNIST_EPOCHS": "2", "MNIST_STEPS": "4"}, with_stderr=True)
+    assert "epoch 1" in out
+    assert "eager engine, averaged over 2 ranks" in out
+    # Clean coordinated shutdown: a worker that learns of shutdown from the
+    # response broadcast must ANNOUNCE its departure (engine.cc one-extra-
+    # tick protocol) — a silent exit makes the coordinator log every normal
+    # multi-process teardown as a lost rank.
+    assert "lost (connection dropped without shutdown)" not in err, err[-2000:]
 
 
 @pytest.mark.slow

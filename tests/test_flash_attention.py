@@ -117,7 +117,5 @@ def test_unknown_attention_value_rejected():
     bad = TransformerLM(vocab=8, dim=16, heads=2, layers=1, attention="Flash")
     with pytest.raises(ValueError, match="unknown attention"):
         bad.init(jax.random.PRNGKey(0), tok)
-    conflict = TransformerLM(vocab=8, dim=16, heads=2, layers=1,
-                             attention="flash", sp_axis="sp")
-    with pytest.raises(ValueError, match="sp_axis"):
-        conflict.init(jax.random.PRNGKey(0), tok)
+    # attention="flash" + sp_axis is a VALID pair (ring_flash_attention);
+    # its parity is covered in tests/test_ring_flash.py.

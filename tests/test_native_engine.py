@@ -177,10 +177,12 @@ def test_native_duplicate_name_rejected(native):
     test, test_torch.py:356)."""
     from horovod_tpu.cc.native_engine import HorovodInternalError
 
-    eng = make_engine(native)
+    # A long cycle keeps the first enqueue live across the second one —
+    # with the default 1 ms cycle a loaded CI host can drain h1 in the gap
+    # between the two enqueues and the duplicate is never seen.
+    eng = native(Topology(0, 1, 0, 1, 0, 1), Config(cycle_time_ms=500.0))
     try:
         eng._lib  # engine built
-        # stall the loop long enough to have both enqueues in one cycle
         h1 = eng.enqueue("allreduce", np.ones(4), "dup")
         with pytest.raises(HorovodInternalError, match="Duplicate tensor name"):
             eng.enqueue("allreduce", np.ones(4), "dup")

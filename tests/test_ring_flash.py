@@ -82,29 +82,10 @@ def test_ring_flash_multiblock_matches_oracle(sp_mesh):
 
 @pytest.mark.slow
 def test_ring_flash_multiblock_grads_match_oracle(sp_mesh):
-    q, k, v = qkv(t=64)
+    q, k, v = qkv(t=32)  # t_local=8 with bq=4/bk=2: 2x4 grid per step
     w = jax.random.normal(jax.random.PRNGKey(9), q.shape, jnp.float32)
     ring = _sharded(sp_mesh, lambda a, b, c: ring_flash_attention(
-        a, b, c, "sp", block_q=8, block_k=4))
-    with jax.default_matmul_precision("highest"):
-        g_ring = jax.grad(lambda a, b, c: jnp.sum(ring(a, b, c) * w),
-                          argnums=(0, 1, 2))(q, k, v)
-        g_ref = jax.grad(lambda a, b, c: jnp.sum(causal_reference(a, b, c) * w),
-                         argnums=(0, 1, 2))(q, k, v)
-    for got, want, name in zip(g_ring, g_ref, "qkv"):
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                                   atol=3e-5, rtol=3e-5,
-                                   err_msg=f"d{name} mismatch")
-
-
-@pytest.mark.slow
-def test_ring_flash_grads_match_oracle(sp_mesh):
-    """dQ accumulates locally, dK/dV ride the ring home — all three must
-    equal autodiff through the dense oracle."""
-    q, k, v = qkv(t=64)
-    w = jax.random.normal(jax.random.PRNGKey(9), q.shape, jnp.float32)
-
-    ring = _sharded(sp_mesh, lambda a, b, c: ring_flash_attention(a, b, c, "sp"))
+        a, b, c, "sp", block_q=4, block_k=2))
     with jax.default_matmul_precision("highest"):
         g_ring = jax.grad(lambda a, b, c: jnp.sum(ring(a, b, c) * w),
                           argnums=(0, 1, 2))(q, k, v)
@@ -118,8 +99,13 @@ def test_ring_flash_grads_match_oracle(sp_mesh):
 
 @pytest.mark.slow
 def test_ring_flash_zigzag_grads_match_oracle(sp_mesh):
+    """dQ accumulates locally, dK/dV ride the ring home — all three must
+    equal autodiff through the dense oracle. Zigzag layout: the masking
+    must use the true (non-contiguous) global positions in both passes.
+    (Contiguous-layout gradients are covered by the multiblock test above
+    and the full-model parity test below.)"""
     n = sp_mesh.size
-    q, k, v = qkv(t=64)
+    q, k, v = qkv(t=32)
     w = jax.random.normal(jax.random.PRNGKey(9), q.shape, jnp.float32)
     qz, kz, vz = (zigzag_shard(x, n) for x in (q, k, v))
     wz = zigzag_shard(w, n)
