@@ -85,6 +85,18 @@ def main():
         check_vma=False,
     ))
 
+    def eval_step(params, x, y):
+        loss, acc = loss_fn(params, x, y)
+        return (jax.lax.pmean(loss, hvd.HVD_AXIS),
+                jax.lax.pmean(acc, hvd.HVD_AXIS))
+
+    evaluate = jax.jit(shard_map(
+        eval_step, mesh=mesh,
+        in_specs=(P(), P(hvd.HVD_AXIS), P(hvd.HVD_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    ))
+
     # Initial-state consistency from root (BroadcastGlobalVariablesCallback).
     params = jax.tree_util.tree_map(lambda a: jnp.asarray(hvd.broadcast(a)), params)
 
@@ -100,13 +112,14 @@ def main():
                                               jnp.asarray(xb), jnp.asarray(yb))
             epoch_loss += float(loss)
 
-        # Per-epoch eval on a held-out shard; metrics averaged across ranks
-        # at epoch end (MetricAverageCallback semantics) — each rank holds a
-        # different eval shard, the printed number is the global mean.
+        # Per-epoch eval on a held-out shard (forward only); metrics averaged
+        # across ranks at epoch end (MetricAverageCallback semantics) — each
+        # rank holds a different eval shard, the printed number is the
+        # global mean.
         ex, ey = synthetic_mnist(64, seed=1000 + epoch + hvd.rank())
-        _, _, eval_loss, eval_acc = step(params, opt_state,
-                                         jnp.asarray(np.repeat(ex, n_dev, 0)[:64 * n_dev]),
-                                         jnp.asarray(np.repeat(ey, n_dev, 0)[:64 * n_dev]))
+        eval_loss, eval_acc = evaluate(params,
+                                       jnp.asarray(np.repeat(ex, n_dev, 0)),
+                                       jnp.asarray(np.repeat(ey, n_dev, 0)))
         logs = {"val_loss": float(eval_loss), "val_acc": float(eval_acc)}
         logs = average_metrics(logs, name_prefix=f"ep{epoch}.")
         lr_now = float(schedule(jnp.asarray((epoch + 1) * STEPS - 1)))

@@ -55,6 +55,7 @@ class Block(nn.Module):
     sp_axis: Optional[str] = None  # sequence-parallel mesh axis (ring attention)
     moe_experts: int = 0           # >0: switch-MoE MLP instead of dense
     attention: str = "dense"       # "dense" | "flash" (pallas fused kernel)
+    kv_heads: Optional[int] = None  # < heads: grouped-query attention
 
     @nn.compact
     def __call__(self, x, positions):
@@ -62,13 +63,30 @@ class Block(nn.Module):
             raise ValueError(
                 f"unknown attention={self.attention!r}; use 'dense' or 'flash'")
         head_dim = self.dim // self.heads
+        kvh = self.heads if self.kv_heads is None else self.kv_heads
+        if kvh < 1 or self.heads % kvh:
+            raise ValueError(
+                f"kv_heads {kvh} must be >= 1 and divide heads {self.heads}")
         h = nn.RMSNorm(dtype=self.dtype)(x)
-        qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype, name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
         b, t = x.shape[0], x.shape[1]
+        if kvh == self.heads:
+            qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype, name="qkv")(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            q = nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+                         name="q_proj")(h)
+            kv = nn.Dense(2 * kvh * head_dim, use_bias=False,
+                          dtype=self.dtype, name="kv_proj")(h)
+            k, v = jnp.split(kv, 2, axis=-1)
         q = _rope(q.reshape(b, t, self.heads, head_dim), positions)
-        k = _rope(k.reshape(b, t, self.heads, head_dim), positions)
-        v = v.reshape(b, t, self.heads, head_dim)
+        k = _rope(k.reshape(b, t, kvh, head_dim), positions)
+        v = v.reshape(b, t, kvh, head_dim)
+        if self.attention == "dense" and kvh != self.heads:
+            # The einsum paths are plain multi-head; replicate kv heads for
+            # them (the flash kernels alias the shared head via the grid
+            # index map and never materialize the copies).
+            k = jnp.repeat(k, self.heads // kvh, axis=2)
+            v = jnp.repeat(v, self.heads // kvh, axis=2)
         if self.sp_axis is not None:
             if self.attention == "flash":
                 from ..ops.ring_flash import ring_flash_attention
@@ -119,6 +137,11 @@ class TransformerLM(nn.Module):
     # with sp_axis it selects ring_flash_attention: ring schedule between
     # chips, fused flash blocks within each chip.
     attention: str = "dense"
+    # kv_heads < heads enables grouped-query attention: one kv head serves
+    # heads//kv_heads query heads. The flash kernels alias the shared head
+    # (no replication in HBM), and ring_flash rotates only the small kv
+    # blocks over ICI.
+    kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -133,6 +156,7 @@ class TransformerLM(nn.Module):
                 dtype=self.dtype,
                 sp_axis=self.sp_axis,
                 attention=self.attention,
+                kv_heads=self.kv_heads,
                 moe_experts=(self.moe_experts
                              if self.moe_experts > 0 and i % self.moe_every == self.moe_every - 1
                              else 0),
@@ -153,7 +177,8 @@ def tp_param_specs(params, tp_axis: str = "tp"):
         names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
         joined = "/".join(str(n) for n in names)
         if leaf.ndim == 2:
-            if "qkv" in joined or "mlp_in" in joined:
+            if ("qkv" in joined or "q_proj" in joined or "kv_proj" in joined
+                    or "mlp_in" in joined):
                 return P(None, tp_axis)
             if "o_proj" in joined or "mlp_out" in joined or "lm_head" in joined:
                 return P(tp_axis, None)

@@ -130,11 +130,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                 dv_ref, dk_acc_ref, dv_acc_ref, *, block_q, block_k, nq,
-                causal, sm_scale):
+                group, causal, sm_scale):
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    # Innermost grid dim walks (g, qi): for GQA (group > 1) the same
+    # k/v-head block accumulates gradient contributions from every q head
+    # in its group — the grid dim 0 row is a KV row, and j sweeps the
+    # group's q blocks. group == 1 reduces to the plain j == qi walk.
+    j = pl.program_id(2)
+    qi = j % nq
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
@@ -160,7 +165,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         ds = p * (do @ v.T - delta[:, None])
         dk_acc_ref[0] = dk_acc_ref[0] + (ds.T @ q) * sm_scale
 
-    @pl.when(qi == nq - 1)
+    @pl.when(j == nq * group - 1)
     def _finalize():
         dk_ref[0] = dk_acc_ref[0].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[0].astype(dv_ref.dtype)
@@ -208,11 +213,31 @@ def _unrows(x, b, t, h, d):
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
+def _gqa_group(q, k, v):
+    """(h, hkv, group) for grouped-query attention: q has h heads, k/v may
+    have fewer (hkv), each shared by a contiguous group of h//hkv q heads
+    (the standard GQA layout). h == hkv is plain multi-head."""
+    h, hkv = q.shape[2], k.shape[2]
+    if v.shape[2] != hkv:
+        raise ValueError(f"k has {hkv} heads but v has {v.shape[2]}")
+    if h % hkv:
+        raise ValueError(f"q heads {h} not divisible by kv heads {hkv}")
+    return h, hkv, h // hkv
+
+
+def _kv_row(r, h, hkv, group):
+    """Map a q-row index (b*h + head) to its kv-row (b*hkv + head//group)."""
+    return (r // h) * hkv + (r % h) // group
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 1024,
                     block_k: int = 512, interpret: bool | None = None):
-    """Fused attention, trainable. q, k, v: ``(B, T, H, D)`` (the layout
-    models/transformer.py uses). Sequence length must be a multiple of
+    """Fused attention, trainable. q: ``(B, T, H, D)``, k/v: ``(B, T, H, D)``
+    or ``(B, T, Hkv, D)`` with ``H % Hkv == 0`` for grouped-query attention
+    (each kv head serves a contiguous group of q heads — no head
+    replication ever materializes; the kernels alias the shared kv block
+    via the grid index map). Sequence length must be a multiple of
     ``block_q`` and ``block_q`` of ``block_k`` (both clamp down to the
     sequence length for short inputs; the defaults measured fastest on v5e
     at d=64 — bigger blocks amortize scratch round-trips and feed the MXU
@@ -224,21 +249,25 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 1024,
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
     b, t, h, d = q.shape
+    h, hkv, group = _gqa_group(q, k, v)
     if interpret is None:
         interpret = _interpret_default()
     block_q, block_k = _check_blocks(t, block_q, block_k, interpret)
-    qr, kr, vr = (_rows(x, b, t, h, d) for x in (q, k, v))
+    qr = _rows(q, b, t, h, d)
+    kr, vr = (_rows(x, b, t, hkv, d) for x in (k, v))
     nk = t // block_k
     kernel = functools.partial(
         _fwd_kernel, block_q=block_q, block_k=block_k, nk=nk, causal=causal,
         sm_scale=d ** -0.5)
+    kv_spec = pl.BlockSpec(
+        (1, block_k, d), lambda r, qi, ki: (_kv_row(r, h, hkv, group), ki, 0))
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda r, qi, ki: (r, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda r, qi, ki: (r, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda r, qi, ki: (r, ki, 0)),
+            kv_spec,
+            kv_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda r, qi, ki: (r, qi, 0)),
@@ -261,10 +290,12 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 def _bwd_rule(causal, block_q, block_k, interpret, res, dout):
     q, k, v, out, lse = res
     b, t, h, d = q.shape
+    h, hkv, group = _gqa_group(q, k, v)
     if interpret is None:
         interpret = _interpret_default()
     block_q, block_k = _check_blocks(t, block_q, block_k, interpret)
-    qr, kr, vr, dor = (_rows(x, b, t, h, d) for x in (q, k, v, dout))
+    qr, dor = (_rows(x, b, t, h, d) for x in (q, dout))
+    kr, vr = (_rows(x, b, t, hkv, d) for x in (k, v))
     outr = out  # saved in rows layout by _fwd
     # D_i = rowsum(dO ∘ O): cheap elementwise reduction, done outside;
     # broadcast to the same (rows, 8, t) sublane layout as lse
@@ -274,14 +305,16 @@ def _bwd_rule(causal, block_q, block_k, interpret, res, dout):
     nq, nk = t // block_q, t // block_k
     common = dict(block_q=block_q, block_k=block_k, causal=causal,
                   sm_scale=d ** -0.5)
+    kv_spec = pl.BlockSpec(
+        (1, block_k, d), lambda r, qi, ki: (_kv_row(r, h, hkv, group), ki, 0))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, nk=nk, **common),
         grid=(b * h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda r, qi, ki: (r, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda r, qi, ki: (r, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda r, qi, ki: (r, ki, 0)),
+            kv_spec,
+            kv_spec,
             pl.BlockSpec((1, block_q, d), lambda r, qi, ki: (r, qi, 0)),
             pl.BlockSpec((1, 8, block_q), lambda r, qi, ki: (r, 0, qi)),
             pl.BlockSpec((1, 8, block_q), lambda r, qi, ki: (r, 0, qi)),
@@ -292,24 +325,23 @@ def _bwd_rule(causal, block_q, block_k, interpret, res, dout):
         interpret=interpret,
     )(qr, kr, vr, dor, lse, delta)
 
+    # dK/dV: one grid row per KV row; the innermost dim sweeps (g, qi) so a
+    # shared kv head accumulates all of its group's q-head contributions in
+    # scratch before writing out (grid dim 0 = b*hkv, not b*h).
+    def q_row(r, j):
+        return (r // hkv) * h + (r % hkv) * group + j // nq
+
+    qd = pl.BlockSpec((1, block_q, d), lambda r, ki, j: (q_row(r, j), j % nq, 0))
+    row = pl.BlockSpec((1, 8, block_q), lambda r, ki, j: (q_row(r, j), 0, j % nq))
+    kd = pl.BlockSpec((1, block_k, d), lambda r, ki, j: (r, ki, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, nq=nq, **common),
-        grid=(b * h, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda r, ki, qi: (r, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda r, ki, qi: (r, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda r, ki, qi: (r, ki, 0)),
-            pl.BlockSpec((1, block_q, d), lambda r, ki, qi: (r, qi, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda r, ki, qi: (r, 0, qi)),
-            pl.BlockSpec((1, 8, block_q), lambda r, ki, qi: (r, 0, qi)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda r, ki, qi: (r, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda r, ki, qi: (r, ki, 0)),
-        ],
+        functools.partial(_dkv_kernel, nq=nq, group=group, **common),
+        grid=(b * hkv, nk, nq * group),
+        in_specs=[qd, kd, kd, qd, row, row],
+        out_specs=[kd, kd],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+            jax.ShapeDtypeStruct((b * hkv, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * hkv, t, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((1, block_k, d), jnp.float32),   # dk acc
@@ -318,8 +350,8 @@ def _bwd_rule(causal, block_q, block_k, interpret, res, dout):
         interpret=interpret,
     )(qr, kr, vr, dor, lse, delta)
 
-    return (_unrows(dq, b, t, h, d), _unrows(dk, b, t, h, d),
-            _unrows(dv, b, t, h, d))
+    return (_unrows(dq, b, t, h, d), _unrows(dk, b, t, hkv, d),
+            _unrows(dv, b, t, hkv, d))
 
 
 flash_attention.defvjp(_fwd, _bwd_rule)

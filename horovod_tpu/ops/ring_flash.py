@@ -43,7 +43,15 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import NEG_INF, _check_blocks, _interpret_default, _rows, _unrows
+from .flash_attention import (
+    NEG_INF,
+    _check_blocks,
+    _gqa_group,
+    _interpret_default,
+    _kv_row,
+    _rows,
+    _unrows,
+)
 from .ring_attention import zigzag_positions
 
 
@@ -124,10 +132,13 @@ def _rf_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _rf_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    qpos_ref, kpos_ref, dk_in_ref, dv_in_ref,
                    dk_out_ref, dv_out_ref, dk_acc_ref, dv_acc_ref, *,
-                   nq, sm_scale):
-    qi = pl.program_id(2)
+                   nq, group, sm_scale):
+    # Innermost grid dim sweeps (g, qi): for GQA a shared kv head
+    # accumulates every group q-head's contribution before writing out
+    # (grid dim 0 is a KV row); group == 1 is the plain qi walk.
+    j = pl.program_id(2)
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_acc_ref[...] = dk_in_ref[...]
         dv_acc_ref[...] = dv_in_ref[...]
@@ -150,7 +161,7 @@ def _rf_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = p * (do @ v.T - delta[:, None])
         dk_acc_ref[0] = dk_acc_ref[0] + (ds.T @ q) * sm_scale
 
-    @pl.when(qi == nq - 1)
+    @pl.when(j == nq * group - 1)
     def _finalize():
         dk_out_ref[...] = dk_acc_ref[...]
         dv_out_ref[...] = dv_acc_ref[...]
@@ -185,14 +196,17 @@ def _kpos_spec(bk):
     return pl.BlockSpec((bk, 128), lambda r, qi, ki: (ki, 0))
 
 
-def _fwd_block_call(qr, k_blk, v_blk, o, m, l, qpos, kpos, bq, bk, interpret):
+def _fwd_block_call(qr, k_blk, v_blk, o, m, l, qpos, kpos, bq, bk,
+                    h, hkv, group, interpret):
     R, t, d = qr.shape
     nq, nk = t // bq, t // bk
     kernel = functools.partial(_rf_fwd_kernel, nk=nk, sm_scale=d ** -0.5)
+    kv = pl.BlockSpec(
+        (1, bk, d), lambda r, qi, ki: (_kv_row(r, h, hkv, group), ki, 0))
     return pl.pallas_call(
         kernel,
         grid=(R, nq, nk),
-        in_specs=[_qd_spec(bq, d), _kd_spec(bk, d), _kd_spec(bk, d),
+        in_specs=[_qd_spec(bq, d), kv, kv,
                   _qd_spec(bq, d), _row_spec(bq), _row_spec(bq),
                   _qpos_spec(bq), _kpos_spec(bk)],
         out_specs=[_qd_spec(bq, d), _row_spec(bq), _row_spec(bq)],
@@ -207,14 +221,16 @@ def _fwd_block_call(qr, k_blk, v_blk, o, m, l, qpos, kpos, bq, bk, interpret):
 
 
 def _dq_block_call(qr, k_blk, v_blk, dor, lse, delta, qpos, kpos, dq,
-                   bq, bk, interpret):
+                   bq, bk, h, hkv, group, interpret):
     R, t, d = qr.shape
     nq, nk = t // bq, t // bk
     kernel = functools.partial(_rf_dq_kernel, nk=nk, sm_scale=d ** -0.5)
+    kv = pl.BlockSpec(
+        (1, bk, d), lambda r, qi, ki: (_kv_row(r, h, hkv, group), ki, 0))
     return pl.pallas_call(
         kernel,
         grid=(R, nq, nk),
-        in_specs=[_qd_spec(bq, d), _kd_spec(bk, d), _kd_spec(bk, d),
+        in_specs=[_qd_spec(bq, d), kv, kv,
                   _qd_spec(bq, d), _row_spec(bq), _row_spec(bq),
                   _qpos_spec(bq), _kpos_spec(bk), _qd_spec(bq, d)],
         out_specs=_qd_spec(bq, d),
@@ -225,23 +241,30 @@ def _dq_block_call(qr, k_blk, v_blk, dor, lse, delta, qpos, kpos, dq,
 
 
 def _dkv_block_call(qr, k_blk, v_blk, dor, lse, delta, qpos, kpos, dk, dv,
-                    bq, bk, interpret):
+                    bq, bk, h, hkv, group, interpret):
     R, t, d = qr.shape
+    Rkv = k_blk.shape[0]
     nq, nk = t // bq, t // bk
-    kernel = functools.partial(_rf_dkv_kernel, nq=nq, sm_scale=d ** -0.5)
-    # dK/dV accumulate over q-blocks: innermost grid dim is qi.
-    qd = pl.BlockSpec((1, bq, d), lambda r, ki, qi: (r, qi, 0))
-    kd = pl.BlockSpec((1, bk, d), lambda r, ki, qi: (r, ki, 0))
-    row = pl.BlockSpec((1, 8, bq), lambda r, ki, qi: (r, 0, qi))
-    qpos_s = pl.BlockSpec((8, bq), lambda r, ki, qi: (0, qi))
-    kpos_s = pl.BlockSpec((bk, 128), lambda r, ki, qi: (ki, 0))
+    kernel = functools.partial(_rf_dkv_kernel, nq=nq, group=group,
+                               sm_scale=d ** -0.5)
+
+    # One grid row per KV row; innermost dim sweeps (g, qi) so a shared kv
+    # head accumulates its whole group before the write-out.
+    def q_row(r, j):
+        return (r // hkv) * h + (r % hkv) * group + j // nq
+
+    qd = pl.BlockSpec((1, bq, d), lambda r, ki, j: (q_row(r, j), j % nq, 0))
+    kd = pl.BlockSpec((1, bk, d), lambda r, ki, j: (r, ki, 0))
+    row = pl.BlockSpec((1, 8, bq), lambda r, ki, j: (q_row(r, j), 0, j % nq))
+    qpos_s = pl.BlockSpec((8, bq), lambda r, ki, j: (0, j % nq))
+    kpos_s = pl.BlockSpec((bk, 128), lambda r, ki, j: (ki, 0))
     return pl.pallas_call(
         kernel,
-        grid=(R, nk, nq),
+        grid=(Rkv, nk, nq * group),
         in_specs=[qd, kd, kd, qd, row, row, qpos_s, kpos_s, kd, kd],
         out_specs=[kd, kd],
-        out_shape=[jax.ShapeDtypeStruct((R, t, d), jnp.float32),
-                   jax.ShapeDtypeStruct((R, t, d), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((Rkv, t, d), jnp.float32),
+                   jax.ShapeDtypeStruct((Rkv, t, d), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((1, bk, d), jnp.float32),
                         pltpu.VMEM((1, bk, d), jnp.float32)],
         interpret=interpret,
@@ -269,8 +292,11 @@ def ring_flash_attention(q, k, v, axis_name: str, zigzag: bool = False,
                          block_q: int = 1024, block_k: int = 512,
                          interpret: bool | None = None):
     """Causal ring attention over ``axis_name`` with pallas-fused local
-    blocks, trainable. q, k, v: ``(B, T_local, H, D)``, sequence already
-    sharded on ``axis_name``. Same semantics as
+    blocks, trainable. q: ``(B, T_local, H, D)``; k, v: same or
+    ``(B, T_local, Hkv, D)`` with ``H % Hkv == 0`` (grouped-query
+    attention — and the ring only ever rotates the SMALLER kv blocks and
+    their gradients, so GQA cuts ICI traffic by the group factor too).
+    Sequence already sharded on ``axis_name``. Same semantics as
     :func:`ring_attention.ring_attention` (including ``zigzag``), same
     block-size contract as :func:`flash_attention.flash_attention`."""
     out, _ = _rf_fwd(q, k, v, axis_name, zigzag, block_q, block_k, interpret)
@@ -281,10 +307,12 @@ def _rf_fwd(q, k, v, axis_name, zigzag, block_q, block_k, interpret):
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
+    h, hkv, group = _gqa_group(q, k, v)
     if interpret is None:
         interpret = _interpret_default()
     bq, bk = _check_blocks(t, block_q, block_k, interpret)
-    qr, kr, vr = (_rows(x, b, t, h, d) for x in (q, k, v))
+    qr = _rows(q, b, t, h, d)
+    kr, vr = (_rows(x, b, t, hkv, d) for x in (k, v))
     R = b * h
 
     o = jnp.zeros((R, t, d), jnp.float32)
@@ -304,7 +332,8 @@ def _rf_fwd(q, k, v, axis_name, zigzag, block_q, block_k, interpret):
             fully_masked,
             lambda o, m, l, *_: (o, m, l),
             lambda o, m, l, kb, vb, kp: _fwd_block_call(
-                qr, kb, vb, o, m, l, qpos, kp, bq, bk, interpret),
+                qr, kb, vb, o, m, l, qpos, kp, bq, bk, h, hkv, group,
+                interpret),
             o, m, l, k_blk, v_blk, kpos,
         )
         if step + 1 < n:
@@ -323,10 +352,12 @@ def _rf_bwd(axis_name, zigzag, block_q, block_k, interpret, res, dout):
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
+    h, hkv, group = _gqa_group(q, k, v)
     if interpret is None:
         interpret = _interpret_default()
     bq, bk = _check_blocks(t, block_q, block_k, interpret)
-    qr, kr, vr, dor = (_rows(x, b, t, h, d) for x in (q, k, v, dout))
+    qr, dor = (_rows(x, b, t, h, d) for x in (q, dout))
+    kr, vr = (_rows(x, b, t, hkv, d) for x in (k, v))
     R = b * h
 
     delta = jnp.sum(dor.astype(jnp.float32) * out_r.astype(jnp.float32), axis=-1)
@@ -337,8 +368,8 @@ def _rf_bwd(axis_name, zigzag, block_q, block_k, interpret, res, dout):
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     dq = jnp.zeros((R, t, d), jnp.float32)
-    dk_blk = jnp.zeros((R, t, d), jnp.float32)
-    dv_blk = jnp.zeros((R, t, d), jnp.float32)
+    dk_blk = jnp.zeros((b * hkv, t, d), jnp.float32)
+    dv_blk = jnp.zeros((b * hkv, t, d), jnp.float32)
     k_blk, v_blk = kr, vr
     for step in range(n):
         src = (my - step) % n
@@ -349,7 +380,8 @@ def _rf_bwd(axis_name, zigzag, block_q, block_k, interpret, res, dout):
             fully_masked,
             lambda dq, *_: dq,
             lambda dq, kb, vb, kp: _dq_block_call(
-                qr, kb, vb, dor, lse, delta, qpos, kp, dq, bq, bk, interpret),
+                qr, kb, vb, dor, lse, delta, qpos, kp, dq, bq, bk,
+                h, hkv, group, interpret),
             dq, k_blk, v_blk, kpos,
         )
         dk_blk, dv_blk = lax.cond(
@@ -357,7 +389,7 @@ def _rf_bwd(axis_name, zigzag, block_q, block_k, interpret, res, dout):
             lambda dk, dv, *_: (dk, dv),
             lambda dk, dv, kb, vb, kp: _dkv_block_call(
                 qr, kb, vb, dor, lse, delta, qpos, kp, dk, dv, bq, bk,
-                interpret),
+                h, hkv, group, interpret),
             dk_blk, dv_blk, k_blk, v_blk, kpos,
         )
         # (dK, dV) travel WITH their (K, V) block; after the n-th rotation
@@ -369,8 +401,8 @@ def _rf_bwd(axis_name, zigzag, block_q, block_k, interpret, res, dout):
             v_blk = lax.ppermute(v_blk, axis_name, perm)
 
     return (_unrows(dq.astype(q.dtype), b, t, h, d),
-            _unrows(dk_blk.astype(k.dtype), b, t, h, d),
-            _unrows(dv_blk.astype(v.dtype), b, t, h, d))
+            _unrows(dk_blk.astype(k.dtype), b, t, hkv, d),
+            _unrows(dv_blk.astype(v.dtype), b, t, hkv, d))
 
 
 ring_flash_attention.defvjp(_rf_fwd, _rf_bwd)

@@ -40,6 +40,42 @@ def test_gradients_match_oracle():
                                    atol=5e-6, rtol=5e-6)
 
 
+def test_gqa_matches_replicated_oracle():
+    """Grouped-query attention: 4 q heads over 2 kv heads must equal the
+    oracle with kv heads explicitly replicated — forward and all grads
+    (the oracle's autodiff sums each group's dk/dv for free)."""
+    hkv, group = 2, 2
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (B, 64, hkv * group, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, 64, hkv, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, 64, hkv, D), jnp.float32)
+    g = jax.random.normal(jax.random.PRNGKey(9), q.shape, jnp.float32)
+
+    def rep(x):
+        return jnp.repeat(x, group, axis=2)
+
+    with jax.default_matmul_precision("highest"):
+        out = flash_attention(q, k, v, True, 16, 8)
+        ref = causal_reference(q, rep(k), rep(v))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-6, rtol=2e-6)
+        gf = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, True, 16, 8) * g), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(
+            causal_reference(q, rep(k), rep(v)) * g), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-6, rtol=5e-6, err_msg=name)
+
+
+def test_gqa_rejects_bad_heads():
+    q, k, v = qkv()
+    k3 = jnp.repeat(k[:, :, :1], 3, axis=2)  # 3 kv heads, H=2 q heads
+    v3 = jnp.repeat(v[:, :, :1], 3, axis=2)
+    with pytest.raises(ValueError, match="not divisible"):
+        flash_attention(q, k3, v3, True, 32, 32)
+
+
 def test_non_causal_full_softmax():
     q, k, v = qkv(2)
     with jax.default_matmul_precision("highest"):
@@ -83,6 +119,26 @@ def test_transformer_flash_equals_dense():
     flash = TransformerLM(vocab=64, dim=32, heads=4, layers=2, dtype=jnp.float32,
                           attention="flash")
     params = dense.init(jax.random.PRNGKey(0), tok)["params"]
+    with jax.default_matmul_precision("highest"):
+        od = dense.apply({"params": params}, tok)
+        of = flash.apply({"params": params}, tok)
+    np.testing.assert_allclose(np.asarray(of), np.asarray(od),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_transformer_gqa_flash_equals_dense():
+    """kv_heads < heads: the dense path replicates kv heads, the flash
+    path aliases them in the kernel — same params, same output."""
+    from horovod_tpu.models import TransformerLM
+
+    tok = jax.random.randint(jax.random.PRNGKey(4), (2, 128), 0, 64)
+    kw = dict(vocab=64, dim=32, heads=4, kv_heads=2, layers=2,
+              dtype=jnp.float32)
+    dense = TransformerLM(**kw)
+    flash = TransformerLM(**kw, attention="flash")
+    params = dense.init(jax.random.PRNGKey(0), tok)["params"]
+    # GQA swaps the fused qkv kernel for split q/kv projections
+    assert "q_proj" in params["block_0"] and "kv_proj" in params["block_0"]
     with jax.default_matmul_precision("highest"):
         od = dense.apply({"params": params}, tok)
         of = flash.apply({"params": params}, tok)
