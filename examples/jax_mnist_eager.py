@@ -18,6 +18,9 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # run from repo without install
 
 import jax
+
+if os.environ.get("HVD_FORCE_CPU"):  # tests: deterministic off-chip runs
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -83,9 +86,10 @@ def main():
             updates, opt_state = opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             epoch_loss += float(loss)
-        # epoch loss averaged across ranks through the same engine
+        # epoch loss averaged across ranks through the same engine (scalars
+        # come back as shape-(1,) arrays, like the reference's wrapping)
         mean_loss = float(np.asarray(hvd.allreduce(epoch_loss / STEPS,
-                                                   name=f"loss.ep{epoch}")))
+                                                   name=f"loss.ep{epoch}")).ravel()[0])
         if hvd.rank() == 0:
             print(f"epoch {epoch}: loss {mean_loss:.4f} "
                   f"(eager engine, averaged over {hvd.size()} ranks)")

@@ -304,6 +304,9 @@ bool Engine::tick_multiprocess(bool shutting) {
   } catch (const std::exception& ex) {
     // Order matters: latch shutdown FIRST so no new enqueue can slip past
     // the sweep (enqueue re-checks under qmu_), then fail everything.
+    HVD_DEBUG("rank " + std::to_string(topo_.rank) +
+              " control-plane tick failed (shutting=" +
+              std::to_string((int)shutting) + "): " + ex.what());
     shutdown_.store(true);
     fail_everything(std::string("control plane failed: ") + ex.what());
     return false;
@@ -659,8 +662,15 @@ void Coordinator::stop() {
 
 void Coordinator::await_departure(double timeout_s) {
   std::unique_lock<std::mutex> lk(mu_);
-  cv_.wait_for(lk, std::chrono::duration<double>(timeout_s),
-               [&] { return (int)departed_.size() >= world_; });
+  // Every rank announced AND every serve thread has finished its final
+  // send and released its socket. Waiting on departed_ alone is a race:
+  // tick() marks the announcing rank departed BEFORE serve sends the
+  // response, so the caller could tear the coordinator down (closing the
+  // client fds) mid-send — the worker then sees a dropped connection and
+  // the coordinator logs a spurious "rank lost" on a clean shutdown.
+  cv_.wait_for(lk, std::chrono::duration<double>(timeout_s), [&] {
+    return (int)departed_.size() >= world_ && client_fds_.empty();
+  });
 }
 
 void Coordinator::accept_loop() {
@@ -692,6 +702,7 @@ void Coordinator::serve(int fd) {
       client_fds_.erase(
           std::remove(client_fds_.begin(), client_fds_.end(), fd),
           client_fds_.end());
+      cv_.notify_all();  // await_departure also waits on client_fds_.empty()
       ::close(fd);
       return;
     }
@@ -740,13 +751,19 @@ void Coordinator::serve(int fd) {
       send_frame(fd, w.buf);
       if (t.shutdown) break;  // rank departed cleanly
     }
-  } catch (const std::exception&) {
-    if (rank >= 0) mark_departed(rank);
+  } catch (const std::exception& ex) {
+    if (rank >= 0) {
+      HVD_DEBUG("serve(rank " + std::to_string(rank) + ") error: " + ex.what());
+      mark_departed(rank);
+    }
   }
   {
     std::lock_guard<std::mutex> g(mu_);
     client_fds_.erase(std::remove(client_fds_.begin(), client_fds_.end(), fd),
                       client_fds_.end());
+    // await_departure waits for this: a departure is only complete once the
+    // serve thread has sent the final response and released the socket.
+    cv_.notify_all();
   }
   ::close(fd);
 }

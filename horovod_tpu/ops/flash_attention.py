@@ -230,6 +230,14 @@ def _kv_row(r, h, hkv, group):
     return (r // h) * hkv + (r % h) // group
 
 
+def _q_row(r, j, nq, h, hkv, group):
+    """Inverse walk for the dK/dV grids: kv-row ``r`` with innermost grid
+    index ``j`` sweeping (g, qi) maps to q-row b*h + kv_head*group + g.
+    The single definition keeps the group layout in one place with
+    :func:`_kv_row` — the two must stay inverses."""
+    return (r // hkv) * h + (r % hkv) * group + j // nq
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 1024,
                     block_k: int = 512, interpret: bool | None = None):
@@ -329,7 +337,7 @@ def _bwd_rule(causal, block_q, block_k, interpret, res, dout):
     # shared kv head accumulates all of its group's q-head contributions in
     # scratch before writing out (grid dim 0 = b*hkv, not b*h).
     def q_row(r, j):
-        return (r // hkv) * h + (r % hkv) * group + j // nq
+        return _q_row(r, j, nq, h, hkv, group)
 
     qd = pl.BlockSpec((1, block_q, d), lambda r, ki, j: (q_row(r, j), j % nq, 0))
     row = pl.BlockSpec((1, 8, block_q), lambda r, ki, j: (q_row(r, j), 0, j % nq))

@@ -49,6 +49,7 @@ from .flash_attention import (
     _gqa_group,
     _interpret_default,
     _kv_row,
+    _q_row,
     _rows,
     _unrows,
 )
@@ -251,7 +252,7 @@ def _dkv_block_call(qr, k_blk, v_blk, dor, lse, delta, qpos, kpos, dk, dv,
     # One grid row per KV row; innermost dim sweeps (g, qi) so a shared kv
     # head accumulates its whole group before the write-out.
     def q_row(r, j):
-        return (r // hkv) * h + (r % hkv) * group + j // nq
+        return _q_row(r, j, nq, h, hkv, group)
 
     qd = pl.BlockSpec((1, bq, d), lambda r, ki, j: (q_row(r, j), j % nq, 0))
     kd = pl.BlockSpec((1, bk, d), lambda r, ki, j: (r, ki, 0))

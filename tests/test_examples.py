@@ -38,7 +38,7 @@ def test_pytorch_mnist_example_2proc():
 @pytest.mark.slow
 def test_jax_mnist_example_single():
     out = run_example([sys.executable, "examples/jax_mnist.py"],
-                      env_extra={"MNIST_STEPS": "3"})
+                      env_extra={"MNIST_STEPS": "3", "HVD_FORCE_CPU": "1"})
     assert "epoch 2" in out
 
 
@@ -73,7 +73,8 @@ def test_jax_mnist_advanced_2proc():
     out = run_example([
         sys.executable, "-m", "horovod_tpu.runner", "-np", "2", "--",
         sys.executable, "examples/jax_mnist_advanced.py",
-    ], env_extra={"MNIST_EPOCHS": "3", "MNIST_STEPS": "4"})
+    ], env_extra={"MNIST_EPOCHS": "3", "MNIST_STEPS": "4",
+                  "HVD_FORCE_CPU": "1"})
     assert "epoch 2" in out
     assert "averaged over 2 ranks" in out
     assert "lr 0.0100" in out  # base 0.005 ramped to base*size at warmup end
@@ -86,7 +87,8 @@ def test_jax_mnist_eager_2proc():
     out, err = run_example([
         sys.executable, "-m", "horovod_tpu.runner", "-np", "2", "--",
         sys.executable, "examples/jax_mnist_eager.py",
-    ], env_extra={"MNIST_EPOCHS": "2", "MNIST_STEPS": "4"}, with_stderr=True)
+    ], env_extra={"MNIST_EPOCHS": "2", "MNIST_STEPS": "4",
+                  "HVD_FORCE_CPU": "1"}, with_stderr=True)
     assert "epoch 1" in out
     assert "eager engine, averaged over 2 ranks" in out
     # Clean coordinated shutdown: a worker that learns of shutdown from the
