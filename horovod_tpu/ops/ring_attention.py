@@ -163,14 +163,22 @@ def causal_reference(q, k, v):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def ulysses_attention(q, k, v, axis_name: str):
+def ulysses_attention(q, k, v, axis_name: str, impl: str = "dense"):
     """All-to-all sequence parallelism (DeepSpeed-Ulysses schedule): re-shard
-    [B, T/n, H, D] -> [B, T, H/n, D], dense causal attention on full sequence
-    with a head shard, re-shard back."""
+    [B, T/n, H, D] -> [B, T, H/n, D], causal attention on the full sequence
+    with a head shard, re-shard back.
+
+    ``impl="flash"`` runs the local attention through the pallas flash
+    kernel (flash_attention.py) instead of dense einsums — after the
+    all-to-all each shard holds the FULL sequence, which is exactly the
+    regime the fused kernel exists for (the dense schedule materializes
+    the (T, T) logits and stops compiling around seq 8k)."""
     n = lax.axis_size(axis_name)
     h = q.shape[2]
     if h % n != 0:
         raise ValueError(f"heads {h} not divisible by axis size {n}")
+    if impl not in ("dense", "flash"):
+        raise ValueError(f"unknown impl={impl!r}; use 'dense' or 'flash'")
 
     def to_heads(x):  # [B,Tl,H,D] -> [B,T,H/n,D]
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
@@ -179,11 +187,16 @@ def ulysses_attention(q, k, v, axis_name: str):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) * scale
-    t = qh.shape[1]
-    mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
-    logits = jnp.where(mask[None, None], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
+    if impl == "flash":
+        from .flash_attention import flash_attention
+
+        out = flash_attention(qh, kh, vh)
+    else:
+        scale = q.shape[-1] ** -0.5
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) * scale
+        t = qh.shape[1]
+        mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
     return to_seq(out)

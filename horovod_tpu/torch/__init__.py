@@ -43,11 +43,15 @@ from .mpi_ops import (  # noqa: F401
     allreduce_,
     allreduce_async,
     allreduce_async_,
+    alltoall,
+    alltoall_async,
     broadcast,
     broadcast_,
     broadcast_async,
     broadcast_async_,
     poll,
+    reducescatter,
+    reducescatter_async,
     synchronize,
 )
 

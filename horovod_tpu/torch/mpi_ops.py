@@ -98,6 +98,26 @@ def broadcast_async_(tensor: torch.Tensor, root_rank: int,
     return h
 
 
+def alltoall_async(tensor: torch.Tensor, name: Optional[str] = None) -> int:
+    """Beyond the reference's op set (its operations.h:108-126 exposes only
+    allreduce/allgather/broadcast): dim-0 split to all ranks, matching the
+    framework's public numpy/jax API."""
+    h = _engine().enqueue("alltoall", _to_numpy(tensor),
+                          _name(name, "alltoall", tensor))
+    _handle_map[h] = (tensor, None)
+    return h
+
+
+def reducescatter_async(tensor: torch.Tensor, average: bool = False,
+                        name: Optional[str] = None) -> int:
+    """Beyond the reference's op set (reduce-scatter is internal-only there,
+    operations.cc:1350): reduce across ranks, return this rank's dim-0 shard."""
+    h = _engine().enqueue("reducescatter", _to_numpy(tensor),
+                          _name(name, "reducescatter", tensor), average=average)
+    _handle_map[h] = (tensor, None)
+    return h
+
+
 def poll(handle: int) -> bool:
     return _engine().poll(handle)
 
@@ -191,3 +211,12 @@ def broadcast(tensor: torch.Tensor, root_rank: int,
 def broadcast_(tensor: torch.Tensor, root_rank: int,
                name: Optional[str] = None) -> torch.Tensor:
     return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+def alltoall(tensor: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(alltoall_async(tensor, name))
+
+
+def reducescatter(tensor: torch.Tensor, average: bool = False,
+                  name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(reducescatter_async(tensor, average, name))

@@ -133,6 +133,12 @@ RANK_SCRIPT = textwrap.dedent("""
     # plain op check
     t = torch.full((3,), float(r))
     out["allreduce"] = hvd.allreduce(t).tolist()
+    # beyond-reference op set: alltoall sends row i to rank i; reducescatter
+    # returns this rank's summed shard
+    a2a = torch.arange(float(n * 2)).reshape(n, 2) + 10 * r
+    out["alltoall"] = hvd.alltoall(a2a).tolist()
+    rs = torch.arange(float(n * 2)).reshape(n, 2) * (r + 1)
+    out["reducescatter"] = hvd.reducescatter(rs).tolist()
     hvd.shutdown()
     print(json.dumps(out))
 """)
@@ -152,3 +158,10 @@ def test_torch_two_rank_lockstep():
     np.testing.assert_allclose(outs[0]["final"], outs[1]["final"], atol=1e-6)
     # allreduce of ranks {0,1} averages to 0.5
     np.testing.assert_allclose(outs[0]["allreduce"], [0.5, 0.5, 0.5])
+    # alltoall: rank i receives row i of every rank's [[0,1],[2,3]]+10r
+    np.testing.assert_allclose(outs[0]["alltoall"], [[0, 1], [10, 11]])
+    np.testing.assert_allclose(outs[1]["alltoall"], [[2, 3], [12, 13]])
+    # reducescatter: sum of arange(4).reshape(2,2)*(r+1) is arange*3; each
+    # rank keeps its dim-0 shard
+    np.testing.assert_allclose(outs[0]["reducescatter"], [[0, 3]])
+    np.testing.assert_allclose(outs[1]["reducescatter"], [[6, 9]])
