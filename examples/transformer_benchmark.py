@@ -1,0 +1,111 @@
+"""TransformerLM training throughput (tokens/sec) — the long-context
+counterpart of the CNN img/s harness (jax_synthetic_benchmark.py, which
+follows the reference's examples/pytorch_synthetic_benchmark.py:96-110
+reporting shape).
+
+Full training step: forward + backward + fused-allreduce AdamW update over
+the local data-parallel mesh; bf16 activations, f32 params. The attention
+tier is selectable (--attention dense|flash, --kv-heads for GQA), which is
+the point of the harness: at --seq-len 8192 the dense schedule cannot
+compile while flash trains (docs/benchmarks.md).
+
+    python examples/transformer_benchmark.py --seq-len 4096 --attention flash
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # run from repo without install
+
+import argparse
+import time
+
+import jax
+
+if os.environ.get("HVD_FORCE_CPU"):  # tests: deterministic off-chip runs
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import TransformerLM
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dim", type=int, default=1024)
+    parser.add_argument("--heads", type=int, default=16)
+    parser.add_argument("--kv-heads", type=int, default=None)
+    parser.add_argument("--layers", type=int, default=12)
+    parser.add_argument("--vocab", type=int, default=32000)
+    parser.add_argument("--seq-len", type=int, default=4096)
+    parser.add_argument("--batch-size", type=int, default=1,
+                        help="per-device sequences")
+    parser.add_argument("--attention", choices=["dense", "flash"],
+                        default="flash")
+    parser.add_argument("--num-warmup", type=int, default=3)
+    parser.add_argument("--num-iters", type=int, default=10)
+    args = parser.parse_args()
+
+    hvd.init()
+    mesh = hvd.default_mesh()
+    n_dev = mesh.size
+
+    model = TransformerLM(vocab=args.vocab, dim=args.dim, heads=args.heads,
+                          kv_heads=args.kv_heads, layers=args.layers,
+                          attention=args.attention)
+    batch = args.batch_size * n_dev
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, args.vocab,
+                                          size=(batch, args.seq_len)),
+        jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens[:1])["params"]
+
+    opt = hvd.jax.DistributedOptimizer(optax.adamw(3e-4))
+    opt_state = opt.init(params)
+
+    def loss_fn(params, tokens):
+        logits = model.apply({"params": params}, tokens)
+        targets = jnp.roll(tokens, -1, axis=1)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, jax.lax.pmean(loss, hvd.HVD_AXIS)
+
+    step = jax.jit(shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P(), P(), P(hvd.HVD_AXIS)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    ), donate_argnums=(0, 1))
+
+    for _ in range(args.num_warmup):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)  # hard sync (see bench.py: block_until_ready alone is not a
+    # reliable fence for chained multi-output steps on the tunneled backend)
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tok_s = batch * args.seq_len * args.num_iters / dt
+    if hvd.rank() == 0:
+        kv = args.kv_heads if args.kv_heads else args.heads
+        print(f"Model: dim {args.dim} x {args.layers}L, heads {args.heads} "
+              f"(kv {kv}), seq {args.seq_len}, attention={args.attention}")
+        print(f"Tokens/sec on {n_dev} device(s): {tok_s:.0f} "
+              f"({tok_s / n_dev:.0f} per device); loss {float(loss):.3f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

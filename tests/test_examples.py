@@ -99,6 +99,20 @@ def test_jax_mnist_eager_2proc():
 
 
 @pytest.mark.slow
+def test_transformer_benchmark_flash_gqa():
+    """The tokens/s harness runs end-to-end with flash attention + GQA on
+    tiny shapes (interpret-mode kernels on CPU)."""
+    out = run_example([
+        sys.executable, "examples/transformer_benchmark.py",
+        "--dim", "32", "--heads", "4", "--kv-heads", "2", "--layers", "2",
+        "--vocab", "64", "--seq-len", "64", "--num-warmup", "1",
+        "--num-iters", "2", "--attention", "flash",
+    ], env_extra={"HVD_FORCE_CPU": "1"})
+    assert "Tokens/sec" in out
+    assert "kv 2" in out
+
+
+@pytest.mark.slow
 def test_jax_word2vec_sparse_path():
     out = run_example(
         [sys.executable, "examples/jax_word2vec.py"],
