@@ -79,6 +79,10 @@ def training_mesh(
     devs = _devices(devices)
     n = len(devs)
     sizes = [dp, fsdp, pp, tp, sp, ep]
+    if len(axis_names) != len(sizes):
+        raise ValueError(
+            f"axis_names must name all {len(sizes)} axes (rename, don't "
+            f"drop — size-1 axes cost nothing); got {axis_names}")
     if sizes.count(-1) > 1:
         raise ValueError("at most one axis may be -1")
     if -1 in sizes:
