@@ -1,0 +1,123 @@
+"""Pipeline-parallel TransformerLM: the real model family on the GPipe
+scan+ppermute schedule (parallel/pipeline.py), not just toy stacked MLPs.
+
+Layout: the transformer blocks are STACKED (leading layer dim) and sharded
+over the ``pp`` mesh axis — each stage owns a contiguous run of blocks.
+Embedding runs outside the pipeline (every stage computes it; only stage
+0's result is ingested — replicated compute, a gather, in exchange for no
+extra collective), the final norm + lm_head run on the pipeline output,
+and the loss is masked to the last stage (masked_last_stage_loss) so
+autodiff routes cotangents back through the reverse pipeline.
+
+Gradients for the replicated embed/head params materialize only on the
+stage that used them (zeros elsewhere); :func:`pipeline_lm_loss_and_grads`
+psums them over the pp axis so every stage holds the true gradient —
+composition with a dp axis then works exactly like any other model.
+
+The reference has no pipeline parallelism (SURVEY.md §2.8: data-parallel
+only); oracle equality against the sequential TransformerLM is proven in
+tests/test_pipeline.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from ..parallel.pipeline import (
+    PP_AXIS,
+    masked_last_stage_loss,
+    pipeline_apply,
+    stack_stage_params,
+)
+from .transformer import Block
+
+
+def split_lm_params(params, layers: int):
+    """Split a TransformerLM param tree into (outer, stacked_blocks):
+    ``outer`` holds embed / final norm / lm_head (replicate these), and
+    ``stacked_blocks`` stacks block_0..block_{L-1} with a leading layer dim
+    (shard dim 0 over 'pp')."""
+    outer = {k: v for k, v in params.items() if not k.startswith("block_")}
+    blocks = stack_stage_params([params[f"block_{i}"] for i in range(layers)])
+    return outer, blocks
+
+
+def merge_lm_params(outer, stacked_blocks, layers: int):
+    """Inverse of :func:`split_lm_params` (host side — e.g. checkpointing)."""
+    params = dict(outer)
+    for i in range(layers):
+        params[f"block_{i}"] = jax.tree_util.tree_map(
+            lambda s: s[i], stacked_blocks)
+    return params
+
+
+def pipeline_lm_logits(model, outer, stage_blocks, tokens_micro,
+                       axis_name: str = PP_AXIS):
+    """Forward through the pipelined blocks; call INSIDE shard_map.
+
+    Args:
+      model: the TransformerLM whose hyperparameters define the blocks.
+      outer: embed/norm/head params (replicated).
+      stage_blocks: this stage's shard of the stacked block params
+        (leading dim = layers_per_stage).
+      tokens_micro: ``(n_micro, mb, T)`` int tokens (replicated).
+
+    Returns ``(n_micro, mb, T, vocab)`` logits — valid on the LAST stage.
+    """
+    import flax.linen as nn
+
+    if model.moe_experts > 0:
+        # MoE models alternate dense and MoE blocks — heterogeneous param
+        # trees cannot stack into one (layers, ...) pytree. Fail loudly
+        # instead of scrambling trees in stack_stage_params.
+        raise NotImplementedError(
+            "pipeline_lm does not support moe_experts > 0: MoE blocks "
+            "alternate with dense blocks, so the stacked-layer layout does "
+            "not apply; pipeline MoE needs per-stage param trees")
+    t = tokens_micro.shape[-1]
+    positions = jnp.arange(t)[None, :]
+    block = Block(dim=model.dim, heads=model.heads, mlp_ratio=model.mlp_ratio,
+                  dtype=model.dtype, attention=model.attention,
+                  kv_heads=model.kv_heads, sp_axis=model.sp_axis)
+
+    embed = nn.Embed(model.vocab, model.dim, dtype=model.dtype, name="embed")
+    x_micro = embed.apply({"params": outer["embed"]}, tokens_micro)
+
+    def layer_fn(p_one, h):
+        return block.apply({"params": p_one}, h, positions)
+
+    out = pipeline_apply(layer_fn, stage_blocks, x_micro, axis_name)
+
+    norm = nn.RMSNorm(dtype=model.dtype)
+    head = nn.Dense(model.vocab, use_bias=False, dtype=jnp.float32)
+    h = norm.apply({"params": outer["RMSNorm_0"]}, out)
+    return head.apply({"params": outer["lm_head"]}, h)
+
+
+def pipeline_lm_loss_and_grads(model, outer, stage_blocks, tokens_micro,
+                               axis_name: str = PP_AXIS):
+    """Loss + gradients of the pipelined LM; call INSIDE shard_map.
+
+    Returns ``(loss, (outer_grads, stage_block_grads))``: the loss is the
+    true mean cross entropy (psum-broadcast to every stage), block grads
+    are each stage's own shard, and outer grads are psummed over the pp
+    axis (embed's gradient materializes on stage 0, the head's on the last
+    stage — everyone ends up with the full thing).
+    """
+
+    def loss_fn(outer, stage_blocks):
+        logits = pipeline_lm_logits(model, outer, stage_blocks, tokens_micro,
+                                    axis_name)
+        targets = jnp.roll(tokens_micro, -1, axis=-1)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+        return masked_last_stage_loss(loss, axis_name)
+
+    loss, (outer_g, block_g) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+        outer, stage_blocks)
+    loss = lax.psum(loss, axis_name)  # nonzero only on the last stage
+    outer_g = jax.tree_util.tree_map(lambda g: lax.psum(g, axis_name), outer_g)
+    return loss, (outer_g, block_g)
