@@ -79,6 +79,11 @@ def pipeline_lm_logits(model, outer, stage_blocks, tokens_micro,
             "not apply; pipeline MoE needs per-stage param trees")
     t = tokens_micro.shape[-1]
     positions = jnp.arange(t)[None, :]
+    if model.sp_axis is not None:
+        # Sequence-parallel composition: tokens_micro holds this rank's
+        # sequence SHARD, so rope needs the global positions of the shard
+        # (ring attention masks by its own axis_index internally).
+        positions = positions + lax.axis_index(model.sp_axis) * t
     block = Block(dim=model.dim, heads=model.heads, mlp_ratio=model.mlp_ratio,
                   dtype=model.dtype, attention=model.attention,
                   kv_heads=model.kv_heads, sp_axis=model.sp_axis)
