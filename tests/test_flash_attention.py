@@ -126,6 +126,7 @@ def test_transformer_flash_equals_dense():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_transformer_gqa_flash_equals_dense():
     """kv_heads < heads: the dense path replicates kv heads, the flash
     path aliases them in the kernel — same params, same output."""

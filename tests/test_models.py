@@ -57,6 +57,7 @@ def test_mlp_and_convnet():
         assert out.shape == (2, 10)
 
 
+@pytest.mark.slow
 def test_resnet_space_to_depth_stem():
     """s2d stem: same output shape and downsampling as the 7x7/s2 stem,
     trains (finite grads) — the MXU-friendly MLPerf stem variant."""

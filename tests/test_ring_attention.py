@@ -70,6 +70,7 @@ def test_ulysses_matches_oracle(sp_mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_flash_matches_oracle(sp_mesh):
     """impl='flash': the post-all-to-all local attention runs through the
     pallas kernel; grads flow through its custom VJP and the all_to_all

@@ -54,6 +54,7 @@ def test_ring_flash_matches_oracle(sp_mesh):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_flash_zigzag_matches_oracle(sp_mesh):
     n = sp_mesh.size
     q, k, v = qkv(t=64)
