@@ -46,6 +46,14 @@ def main():
                         help="per-device sequences")
     parser.add_argument("--attention", choices=["dense", "flash"],
                         default="flash")
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialize blocks in backward (activation "
+                             "HBM -> FLOPs trade; buys the longest sequences)")
+    parser.add_argument("--loss-chunk", type=int, default=0,
+                        help=">0: compute the loss over sequence chunks of "
+                             "this many tokens so the (T, vocab) logits "
+                             "never materialize (the memory ceiling past "
+                             "~16k tokens with a 32k vocab)")
     parser.add_argument("--num-warmup", type=int, default=3)
     parser.add_argument("--num-iters", type=int, default=10)
     args = parser.parse_args()
@@ -56,7 +64,7 @@ def main():
 
     model = TransformerLM(vocab=args.vocab, dim=args.dim, heads=args.heads,
                           kv_heads=args.kv_heads, layers=args.layers,
-                          attention=args.attention)
+                          attention=args.attention, remat=args.remat)
     batch = args.batch_size * n_dev
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, args.vocab,
@@ -68,8 +76,15 @@ def main():
     opt_state = opt.init(params)
 
     def loss_fn(params, tokens):
-        logits = model.apply({"params": params}, tokens)
         targets = jnp.roll(tokens, -1, axis=1)
+        if args.loss_chunk:
+            from horovod_tpu.models.transformer import chunked_lm_loss
+
+            hidden = model.apply({"params": params}, tokens,
+                                 return_hidden=True)
+            return chunked_lm_loss(hidden, params["lm_head"]["kernel"],
+                                   targets, args.loss_chunk)
+        logits = model.apply({"params": params}, tokens)
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, targets).mean()
 

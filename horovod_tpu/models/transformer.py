@@ -142,14 +142,20 @@ class TransformerLM(nn.Module):
     # (no replication in HBM), and ring_flash rotates only the small kv
     # blocks over ICI.
     kv_heads: Optional[int] = None
+    # Rematerialize each block in the backward pass (jax.checkpoint): trade
+    # one extra forward of FLOPs for O(layers) less activation HBM — the
+    # knob that buys deeper models / longer sequences when activations,
+    # not weights, are the memory ceiling. Composes with flash and sp.
+    remat: bool = False
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, return_hidden: bool = False):
         if positions is None:
             positions = jnp.arange(tokens.shape[1])[None, :]
         x = nn.Embed(self.vocab, self.dim, dtype=self.dtype, name="embed")(tokens)
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.layers):
-            x = Block(
+            x = block_cls(
                 dim=self.dim,
                 heads=self.heads,
                 mlp_ratio=self.mlp_ratio,
@@ -163,8 +169,50 @@ class TransformerLM(nn.Module):
                 name=f"block_{i}",
             )(x, positions)
         x = nn.RMSNorm(dtype=self.dtype)(x)
-        logits = nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32, name="lm_head")(x)
-        return logits
+        head = nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
+                        name="lm_head")
+        if return_hidden:
+            # Long-sequence loss path: the (B, T, vocab) f32 logits dwarf
+            # every other activation past ~16k tokens (vocab 32k -> 4 GB at
+            # T=32k). Return the normed hidden states and compute the loss
+            # in sequence chunks with chunked_lm_loss.
+            if self.is_initializing():
+                head(x[:, :1])  # param tree must not depend on the flag
+            return x
+        return head(x)
+
+
+def chunked_lm_loss(hidden, head_kernel, targets, chunk: int = 2048):
+    """Next-token cross entropy without ever materializing the full
+    (B, T, vocab) logits: map the lm_head + softmax-CE over sequence
+    chunks, with the chunk body checkpointed so the backward pass also
+    re-computes each chunk's logits instead of saving them.
+
+    Use with ``model.apply(..., return_hidden=True)``; ``head_kernel`` is
+    ``params["lm_head"]["kernel"]``. Peak extra memory is one chunk's
+    logits (B·chunk·vocab f32) in both passes — the difference between
+    OOM and training at 32k+ tokens with a 32k vocab.
+    """
+    import optax
+
+    b, t, d = hidden.shape
+    if chunk <= 0:
+        raise ValueError(f"loss chunk must be positive, got {chunk}")
+    chunk = min(chunk, t)
+    if t % chunk:
+        raise ValueError(f"sequence {t} not divisible by loss chunk {chunk}")
+    n = t // chunk
+    h = hidden.reshape(b, n, chunk, d).swapaxes(0, 1)    # (n, b, chunk, d)
+    tg = targets.reshape(b, n, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def one(ht):
+        hc, tc = ht
+        logits = hc.astype(jnp.float32) @ head_kernel    # (b, chunk, vocab)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tc).mean()
+
+    return jax.lax.map(one, (h, tg)).mean()
 
 
 def tp_param_specs(params, tp_axis: str = "tp"):

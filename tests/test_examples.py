@@ -99,14 +99,17 @@ def test_jax_mnist_eager_2proc():
 
 
 @pytest.mark.slow
-def test_transformer_benchmark_flash_gqa():
+@pytest.mark.parametrize("extra", [[], ["--remat", "--loss-chunk", "16"]],
+                         ids=["full-logits", "remat-chunked"])
+def test_transformer_benchmark_flash_gqa(extra):
     """The tokens/s harness runs end-to-end with flash attention + GQA on
-    tiny shapes (interpret-mode kernels on CPU)."""
+    tiny shapes (interpret-mode kernels on CPU) — both the default
+    full-logits branch and the remat + chunked-loss long-context branch."""
     out = run_example([
         sys.executable, "examples/transformer_benchmark.py",
         "--dim", "32", "--heads", "4", "--kv-heads", "2", "--layers", "2",
         "--vocab", "64", "--seq-len", "64", "--num-warmup", "1",
-        "--num-iters", "2", "--attention", "flash",
+        "--num-iters", "2", "--attention", "flash", *extra,
     ], env_extra={"HVD_FORCE_CPU": "1"})
     assert "Tokens/sec" in out
     assert "kv 2" in out

@@ -70,3 +70,68 @@ def test_resnet_space_to_depth_stem():
     k = variables["params"]["conv_init"]["kernel"]
     assert k.shape == (4, 4, 12, 64)
     assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.slow
+def test_transformer_remat_matches_no_remat():
+    """jax.checkpoint on the blocks must not change loss or gradients —
+    only the activation-memory/FLOPs trade. Covers composition with the
+    flash-attention custom_vjp (checkpoint replays its forward)."""
+    import optax
+
+    from horovod_tpu.models import TransformerLM
+
+    tok = jax.random.randint(jax.random.PRNGKey(4), (2, 64), 0, 64)
+    kw = dict(vocab=64, dim=32, heads=4, layers=2, dtype=jnp.float32,
+              attention="flash")
+    plain = TransformerLM(**kw)
+    remat = TransformerLM(**kw, remat=True)
+    params = plain.init(jax.random.PRNGKey(0), tok)["params"]
+
+    def loss(model, params):
+        logits = model.apply({"params": params}, tok)
+        targets = jnp.roll(tok, -1, axis=1)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+
+    with jax.default_matmul_precision("highest"):
+        l0, g0 = jax.value_and_grad(lambda p: loss(plain, p))(params)
+        l1, g1 = jax.value_and_grad(lambda p: loss(remat, p))(params)
+    np.testing.assert_allclose(float(l1), float(l0), atol=1e-6, rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5), g1, g0)
+
+
+@pytest.mark.slow
+def test_chunked_lm_loss_matches_full():
+    """Chunked loss head: identical loss AND gradients to the full-logits
+    path (the chunk body is checkpointed; only shapes change)."""
+    import optax
+
+    from horovod_tpu.models import TransformerLM
+    from horovod_tpu.models.transformer import chunked_lm_loss
+
+    tok = jax.random.randint(jax.random.PRNGKey(4), (2, 64), 0, 64)
+    model = TransformerLM(vocab=64, dim=32, heads=4, layers=2,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), tok)["params"]
+    targets = jnp.roll(tok, -1, axis=1)
+
+    def full(params):
+        logits = model.apply({"params": params}, tok)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+
+    def chunked(params):
+        hidden = model.apply({"params": params}, tok, return_hidden=True)
+        return chunked_lm_loss(hidden, params["lm_head"]["kernel"],
+                               targets, chunk=16)
+
+    with jax.default_matmul_precision("highest"):
+        l0, g0 = jax.value_and_grad(full)(params)
+        l1, g1 = jax.value_and_grad(chunked)(params)
+    np.testing.assert_allclose(float(l1), float(l0), atol=1e-6, rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5), g1, g0)
