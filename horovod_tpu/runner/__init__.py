@@ -1,15 +1,20 @@
 """Launcher — the horovodrun/`horovod.spark.run` capability for TPU pods.
 
-Two entry points:
+Two entry points, each with a local and a multi-host leg:
 
 - :func:`run(fn, args=..., num_proc=N)` — programmatic launch (the
   `horovod.spark.run()` analog, reference spark/__init__.py:80-196): starts a
-  driver service, spawns ``num_proc`` local worker processes (on a pod, one
-  per host via your scheduler with ``HOROVOD_DRIVER_ADDRS`` exported), ships
-  the pickled ``fn`` to each, returns results ordered by rank.
-- CLI ``python -m horovod_tpu.runner -np N -- python train.py`` — script
-  launch (the mpirun/horovodrun analog): each worker registers, learns its
-  rank/topology via env, then executes the command.
+  driver service, spawns ``num_proc`` local worker processes, ships the
+  pickled ``fn`` to each, returns results ordered by rank. With
+  ``hosts="host1:4,host2:4"`` the workers are spawned REMOTELY through each
+  host's resident `hvd-agent` daemon (agent.py) — the reference's
+  Spark-executor / mpirun-rsh remote materialization
+  (spark/__init__.py:61-77, spark/driver/mpirun_rsh.py:24-43) without Spark
+  or ssh.
+- CLI ``hvdrun -np N -- python train.py`` / ``hvdrun -H host1:4,host2:4 --
+  python train.py`` — script launch (the mpirun/horovodrun analog): each
+  worker registers, learns its rank/topology via env, then executes the
+  command.
 
 No MPI, no ssh: the control plane is the HMAC-authenticated TCP service pair
 from the reference's Spark layer (SURVEY.md §2.6), which was already the
@@ -22,10 +27,11 @@ import json
 import os
 import subprocess
 import sys
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence, Union
 
 from .network import make_secret
 from .proc_tree import terminate_trees
+from .remote import HostSpec, RemoteSpawner, parse_hosts  # noqa: F401
 from .service import DriverService, TaskAgent, host_hash  # noqa: F401
 
 
@@ -41,16 +47,79 @@ def _spawn_worker(index: int, driver_addrs, secret: bytes, argv: Sequence[str],
     return subprocess.Popen(list(argv), env=env, start_new_session=True)
 
 
+def _worker_env(index: int, driver_addrs, secret: bytes,
+                extra_env: Optional[dict]) -> dict:
+    # The per-job secret rides the agent channel, which is authenticated but
+    # not encrypted — same trust model as the reference shipping its secret
+    # through Spark executor env (spark/__init__.py:109).
+    env = {
+        "HOROVOD_DRIVER_ADDRS": json.dumps([list(a) for a in driver_addrs]),
+        "HOROVOD_SECRET": secret.hex(),
+        "HOROVOD_TASK_INDEX": str(index),
+    }
+    env.update(extra_env or {})
+    return env
+
+
+def _exit_code(rc: Optional[int]) -> int:
+    """Normalize a Popen returncode: signal deaths (negative) map to the
+    shell convention 128+signum so they can't lose to 0 in max()."""
+    if rc is None:
+        return 0
+    return 128 - rc if rc < 0 else rc
+
+
+def _remote_spawner(hosts, agent_port, agent_secret) -> RemoteSpawner:
+    if agent_secret is None:
+        hex_secret = os.environ.get("HOROVOD_AGENT_SECRET")
+        if not hex_secret:
+            raise ValueError(
+                "multi-host launch needs the agent secret: pass agent_secret= "
+                "or set HOROVOD_AGENT_SECRET (hex)")
+        agent_secret = bytes.fromhex(hex_secret)
+    return RemoteSpawner(parse_hosts(hosts, agent_port), agent_secret)
+
+
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         num_proc: Optional[int] = None, env: Optional[dict] = None,
-        timeout: float = 600.0) -> list:
+        timeout: float = 600.0, hosts: Union[str, Sequence, None] = None,
+        agent_port: Optional[int] = None,
+        agent_secret: Optional[bytes] = None,
+        python: Optional[str] = None) -> list:
     """Run ``fn`` on ``num_proc`` processes; returns [result_rank0, ...]
     (reference horovod.spark.run returns per-rank results ordered by rank,
-    spark/__init__.py:195-196)."""
+    spark/__init__.py:195-196).
+
+    With ``hosts`` (``"host1:4,host2:4"``; ``@port`` overrides the agent
+    port per host), workers are spawned through each host's resident
+    hvd-agent daemon instead of locally; ``num_proc`` defaults to the total
+    slot count and must match it if given."""
+    secret = make_secret()
+    if hosts is not None:
+        spawner = _remote_spawner(hosts, agent_port, agent_secret)
+        if num_proc is not None and num_proc != spawner.num_proc:
+            spawner.close()
+            raise ValueError(
+                f"num_proc={num_proc} contradicts hosts spec "
+                f"({spawner.num_proc} total slots)")
+        num_proc = spawner.num_proc
+        driver = DriverService(num_proc, secret, fn=fn, args=args, kwargs=kwargs)
+        argv = [python or sys.executable, "-m", "horovod_tpu.runner.task_main"]
+        try:
+            spawner.spawn(
+                make_argv=lambda i: argv,
+                make_env=lambda i: _worker_env(i, driver.addresses(), secret, env))
+            results = driver.wait_results(timeout=timeout,
+                                          liveness=spawner.liveness)
+            return [results[r] for r in sorted(results)]
+        finally:
+            spawner.kill()
+            spawner.close()
+            driver.stop()
+
     num_proc = num_proc or os.cpu_count() or 1
     if num_proc < 1:
         raise ValueError(f"num_proc must be >= 1, got {num_proc}")
-    secret = make_secret()
     driver = DriverService(num_proc, secret, fn=fn, args=args, kwargs=kwargs)
     procs = []
     try:
@@ -75,10 +144,56 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         driver.stop()
 
 
-def run_command(command: Sequence[str], num_proc: int,
-                env: Optional[dict] = None, timeout: Optional[float] = None) -> int:
-    """Launch ``command`` on ``num_proc`` worker processes (CLI path).
-    Returns the max exit code."""
+def run_command(command: Sequence[str], num_proc: Optional[int] = None,
+                env: Optional[dict] = None, timeout: Optional[float] = None,
+                hosts: Union[str, Sequence, None] = None,
+                agent_port: Optional[int] = None,
+                agent_secret: Optional[bytes] = None,
+                python: Optional[str] = None) -> int:
+    """Launch ``command`` on worker processes (CLI path); returns the max
+    exit code. With ``hosts``, workers are spawned through each host's
+    resident hvd-agent daemon (supervised, so they die with the agent)."""
+    if hosts is not None:
+        import time
+
+        spawner = _remote_spawner(hosts, agent_port, agent_secret)
+        if num_proc is not None and num_proc != spawner.num_proc:
+            spawner.close()
+            raise ValueError(
+                f"num_proc={num_proc} contradicts hosts spec "
+                f"({spawner.num_proc} total slots)")
+        secret = make_secret()
+        driver = DriverService(spawner.num_proc, secret, fn=None)
+        argv = ([python or sys.executable, "-m", "horovod_tpu.runner.task_exec"]
+                + list(command))
+        try:
+            spawner.spawn(
+                make_argv=lambda i: argv,
+                make_env=lambda i: {
+                    **_worker_env(i, driver.addresses(), secret, env),
+                    "HOROVOD_SUPERVISE": "1",
+                })
+            deadline = time.monotonic() + timeout if timeout else None
+            while True:
+                codes = spawner.poll_returncodes()
+                if codes is None:
+                    raise RuntimeError(
+                        "an hvd-agent became unreachable mid-job; its workers "
+                        "self-terminate via the parent-death watchdog")
+                if all(c is not None for c in codes):
+                    return max((_exit_code(c) for c in codes), default=0)
+                if deadline and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"{sum(c is None for c in codes)} workers still "
+                        f"running after {timeout}s")
+                time.sleep(0.5)
+        finally:
+            spawner.kill()
+            spawner.close()
+            driver.stop()
+
+    if num_proc is None:
+        raise ValueError("num_proc is required for local launch")
     if num_proc < 1:
         raise ValueError(f"num_proc must be >= 1, got {num_proc}")
     secret = make_secret()
@@ -93,7 +208,7 @@ def run_command(command: Sequence[str], num_proc: int,
         rc = 0
         for p in procs:
             p.wait(timeout=timeout)
-            rc = max(rc, p.returncode or 0)
+            rc = max(rc, _exit_code(p.returncode))
         return rc
     finally:
         terminate_trees(procs)

@@ -1,7 +1,10 @@
-"""CLI: python -m horovod_tpu.runner -np N [--env K=V ...] -- command ...
+"""CLI: hvdrun [-np N | -H host1:4,host2:4] [--env K=V ...] -- command ...
 
 The horovodrun analog (the reference at this version has no CLI — launch was
-raw mpirun, docs/running.md:22-43; this closes that gap TPU-side)."""
+raw mpirun, docs/running.md:22-43; this closes that gap TPU-side). With -H,
+workers are spawned through each host's resident hvd-agent daemon
+(``python -m horovod_tpu.runner.agent``) — the remote leg the reference got
+from Spark executors / mpirun's rsh agent (spark/__init__.py:160-178)."""
 
 from __future__ import annotations
 
@@ -11,11 +14,20 @@ import sys
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
-        prog="python -m horovod_tpu.runner",
-        description="Launch a command on N horovod_tpu worker processes.",
+        prog="hvdrun",
+        description="Launch a command on N horovod_tpu worker processes, "
+                    "locally (-np) or across hosts via hvd-agents (-H).",
     )
-    parser.add_argument("-np", "--num-proc", type=int, required=True,
-                        help="number of worker processes")
+    parser.add_argument("-np", "--num-proc", type=int, default=None,
+                        help="number of worker processes (local launch)")
+    parser.add_argument("-H", "--hosts", default=None, metavar="host1:4,host2:4",
+                        help="remote launch: slots per host, spawned via each "
+                             "host's hvd-agent (host[@agent_port][:slots])")
+    parser.add_argument("--agent-port", type=int, default=None,
+                        help="default hvd-agent port for -H hosts")
+    parser.add_argument("--agent-secret-file", default=None,
+                        help="file with the shared hvd-agent secret "
+                             "(hex or raw; default: HOROVOD_AGENT_SECRET env)")
     parser.add_argument("--env", action="append", default=[],
                         metavar="K=V", help="extra env var for workers")
     parser.add_argument("command", nargs=argparse.REMAINDER,
@@ -26,14 +38,24 @@ def main(argv=None) -> int:
         command = command[1:]
     if not command:
         parser.error("no command given; usage: -np 4 -- python train.py")
+    if args.num_proc is None and args.hosts is None:
+        parser.error("one of -np or -H is required")
     extra_env = {}
     for kv in args.env:
         k, _, v = kv.partition("=")
         extra_env[k] = v
 
+    agent_secret = None
+    if args.agent_secret_file:
+        from .agent import _load_secret
+
+        agent_secret = _load_secret(args.agent_secret_file)
+
     from . import run_command
 
-    return run_command(command, num_proc=args.num_proc, env=extra_env)
+    return run_command(command, num_proc=args.num_proc, env=extra_env,
+                       hosts=args.hosts, agent_port=args.agent_port,
+                       agent_secret=agent_secret)
 
 
 if __name__ == "__main__":

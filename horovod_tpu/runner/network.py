@@ -65,9 +65,9 @@ class BasicService:
     """Threaded request/response TCP server (reference BasicService,
     network.py:79-143). Subclasses implement handle(request) -> response."""
 
-    def __init__(self, key: bytes, host: str = "0.0.0.0") -> None:
+    def __init__(self, key: bytes, host: str = "0.0.0.0", port: int = 0) -> None:
         self.key = key
-        self.server = socket.create_server((host, 0))
+        self.server = socket.create_server((host, port))
         self.port = self.server.getsockname()[1]
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -117,6 +117,12 @@ class BasicService:
                 conn.close()
             except OSError:
                 pass
+            self.on_disconnect(addr)
+
+    def on_disconnect(self, client_addr) -> None:
+        """Hook: called when an authenticated client's connection closes.
+        The host agent uses this to tie job lifetime to the driver's
+        connection — driver gone means its workers are reaped."""
 
     def stop(self) -> None:
         self._stop.set()

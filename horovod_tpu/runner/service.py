@@ -130,9 +130,24 @@ class DriverService(BasicService):
                      ) -> dict[int, Any]:
         """Collect one result per rank. ``liveness`` (if given) is polled each
         tick and may return an error string to abort early (dead worker)."""
+        def raise_failures(results: dict) -> None:
+            failures = {r: v["error"] for r, v in results.items()
+                        if isinstance(v, dict) and not v.get("ok", True)}
+            if failures:
+                rank, tb = sorted(failures.items())[0]
+                raise RuntimeError(
+                    f"task on rank {rank} failed"
+                    f" (and {len(failures) - 1} more):\n{tb}")
+
         with self._cv:
             deadline = time.monotonic() + timeout
             while len(self._results) < self.num_proc:
+                # Fail fast WITH the remote traceback: a failed rank reports
+                # its error result before exiting, so check results before
+                # the liveness poll — otherwise the poll wins the race and
+                # reports a bare "exited with code 1", discarding the
+                # traceback the worker already delivered.
+                raise_failures(self._results)
                 if time.monotonic() > deadline:
                     raise TimeoutError(
                         f"only {len(self._results)}/{self.num_proc} results arrived")
@@ -142,12 +157,7 @@ class DriverService(BasicService):
                         raise RuntimeError(dead)
                 self._cv.wait(0.5)
             results = dict(self._results)
-        failures = {r: v["error"] for r, v in results.items()
-                    if isinstance(v, dict) and not v.get("ok", True)}
-        if failures:
-            rank, tb = sorted(failures.items())[0]
-            raise RuntimeError(
-                f"task on rank {rank} failed (and {len(failures) - 1} more):\n{tb}")
+        raise_failures(results)
         return {r: (v["value"] if isinstance(v, dict) and "value" in v else v)
                 for r, v in results.items()}
 

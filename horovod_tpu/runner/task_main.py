@@ -9,9 +9,11 @@ import sys
 import threading
 
 
-def _watch_parent() -> None:
-    """Exit if the parent (driver) dies (reference parent-death watchdog,
-    mpirun_exec_fn.py:26-31)."""
+def watch_parent(on_death=None) -> int:
+    """Exit if the parent (driver or host agent) dies (reference parent-death
+    watchdog, mpirun_exec_fn.py:26-31). ``on_death`` runs first — the
+    supervised CLI path uses it to take its child down too. Returns the
+    watched ppid so callers can close the start-up race themselves."""
     ppid = os.getppid()
 
     def loop():
@@ -19,16 +21,22 @@ def _watch_parent() -> None:
 
         while True:
             if os.getppid() != ppid:
+                if on_death is not None:
+                    try:
+                        on_death()
+                    except Exception:
+                        pass
                 os._exit(1)
             time.sleep(1.0)
 
     threading.Thread(target=loop, daemon=True).start()
+    return ppid
 
 
 def main() -> int:
     from .service import TaskAgent
 
-    _watch_parent()
+    watch_parent()
     index = int(os.environ["HOROVOD_TASK_INDEX"])
     addrs = [tuple(a) for a in json.loads(os.environ["HOROVOD_DRIVER_ADDRS"])]
     secret = bytes.fromhex(os.environ["HOROVOD_SECRET"])

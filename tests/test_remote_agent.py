@@ -1,0 +1,243 @@
+"""Multi-host launch through resident hvd-agents — the reference launches
+remote workers through Spark executors / mpirun's rsh agent
+(spark/__init__.py:61-77, spark/driver/mpirun_rsh.py:24-43); here two
+separately-started agents with distinct host identities stand in for two
+machines, and the driver must bring up the world, run a collective, and
+survive an agent dying with an actionable error and zero orphans."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner import run, run_command
+from horovod_tpu.runner.network import make_secret
+from horovod_tpu.runner.remote import HostSpec, RemoteSpawner, parse_hosts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _start_agent(fake_host: str, secret: bytes) -> tuple:
+    """Start an agent subprocess with a faked host identity; returns
+    (proc, port). HOROVOD_HOSTNAME feeds service.host_hash, so two local
+    agents register as two distinct 'machines'."""
+    env = dict(os.environ)
+    env["HOROVOD_HOSTNAME"] = fake_host
+    env["HOROVOD_AGENT_SECRET"] = secret.hex()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.agent", "--port", "0"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    info = json.loads(line)
+    assert info["agent"] == "ready"
+    return proc, info["port"]
+
+
+@pytest.fixture()
+def two_agents():
+    secret = make_secret()
+    a, port_a = _start_agent("fake-host-a", secret)
+    b, port_b = _start_agent("fake-host-b", secret)
+    try:
+        yield secret, port_a, port_b, a, b
+    finally:
+        for p in (a, b):
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in (a, b):
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_parse_hosts():
+    specs = parse_hosts("host1:4,host2:4")
+    assert specs == [HostSpec("host1", 4), HostSpec("host2", 4)]
+    specs = parse_hosts("127.0.0.1@9001:2, 127.0.0.1@9002:1")
+    assert specs[0] == HostSpec("127.0.0.1", 2, 9001)
+    assert specs[1] == HostSpec("127.0.0.1", 1, 9002)
+    assert parse_hosts("solo") == [HostSpec("solo", 1)]
+    assert parse_hosts([("h", 2), ("g", 3, 7000)]) == [
+        HostSpec("h", 2), HostSpec("g", 3, 7000)]
+    with pytest.raises(ValueError, match="slots"):
+        parse_hosts("host:0")
+    with pytest.raises(ValueError, match="host spec"):
+        parse_hosts("host:abc")
+    with pytest.raises(ValueError, match="no hosts"):
+        parse_hosts("")
+
+
+def test_agent_rejects_wrong_secret(two_agents):
+    _, port_a, _, _, _ = two_agents
+    with pytest.raises(ConnectionError, match="cannot reach hvd-agent"):
+        RemoteSpawner(parse_hosts(f"127.0.0.1@{port_a}:1"), make_secret(),
+                      connect_timeout=10)
+
+
+def test_unreachable_agent_is_actionable():
+    # Nothing listens on this port: the error must say which host:port and
+    # how to start an agent there.
+    with pytest.raises(ConnectionError, match="start one there"):
+        RemoteSpawner(parse_hosts("127.0.0.1@1:1"), make_secret(),
+                      connect_timeout=5)
+
+
+@pytest.mark.slow
+def test_remote_run_two_hosts_collective(two_agents):
+    """4-rank world through 2 agents: rank/topology correct (2 'hosts' ×
+    2 slots), collective correct, results ordered by rank — the reference's
+    test_happy_run shape (test/test_spark.py:51) across fake machines."""
+    secret, port_a, port_b, _, _ = two_agents
+
+    def train_fn(scale):
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        hvd.init()
+        out = hvd.allreduce(np.full((2,), float(hvd.rank()) * scale), average=True)
+        result = (hvd.rank(), hvd.size(), hvd.cross_rank(), hvd.cross_size(),
+                  hvd.local_size(), out.tolist())
+        hvd.shutdown()
+        return result
+
+    results = run(train_fn, args=(2.0,),
+                  hosts=f"127.0.0.1@{port_a}:2,127.0.0.1@{port_b}:2",
+                  agent_secret=secret, timeout=180)
+    assert len(results) == 4
+    mean = sum(r * 2.0 for r in range(4)) / 4
+    cross_ranks = set()
+    for rank, (r, size, cross_rank, cross_size, local_size, reduced) in enumerate(results):
+        assert r == rank
+        assert size == 4
+        assert cross_size == 2
+        assert local_size == 2
+        cross_ranks.add(cross_rank)
+        assert reduced == [mean, mean]
+    assert cross_ranks == {0, 1}
+
+
+@pytest.mark.slow
+def test_remote_run_command(two_agents):
+    """CLI path across agents: HOROVOD_* env exported, supervised workers
+    propagate the exit code."""
+    secret, port_a, port_b, _, _ = two_agents
+    script = (
+        "import os, sys; sys.path.insert(0, os.environ['HVD_REPO'])\n"
+        "assert os.environ['HOROVOD_SIZE'] == '3'\n"
+        "assert os.environ['HOROVOD_CROSS_SIZE'] == '2'\n"
+    )
+    rc = run_command([sys.executable, "-c", script],
+                     hosts=f"127.0.0.1@{port_a}:2,127.0.0.1@{port_b}:1",
+                     agent_secret=secret, env={"HVD_REPO": REPO}, timeout=120)
+    assert rc == 0
+    rc = run_command([sys.executable, "-c", "raise SystemExit(3)"],
+                     hosts=f"127.0.0.1@{port_a}:1,127.0.0.1@{port_b}:1",
+                     agent_secret=secret, timeout=120)
+    assert rc == 3
+    # A signal-killed worker must NOT read as success: SIGKILL maps to
+    # 128+9 by shell convention (a raw -9 would lose to 0 in max()).
+    rc = run_command(
+        [sys.executable, "-c",
+         "import os, signal; os.kill(os.getpid(), signal.SIGKILL)"],
+        hosts=f"127.0.0.1@{port_a}:1,127.0.0.1@{port_b}:1",
+        agent_secret=secret, timeout=120)
+    assert rc == 137
+
+
+@pytest.mark.slow
+def test_remote_fn_failure_surfaces_traceback(two_agents):
+    """A raising fn must surface its remote traceback, not a bare
+    'exited with code 1' (the worker reports the error result before it
+    exits; the driver must prefer it over the liveness poll)."""
+    secret, port_a, port_b, _, _ = two_agents
+
+    def failing_fn():
+        import os
+
+        if os.environ["HOROVOD_RANK"] == "1":
+            raise ValueError("intentional remote rank-1 explosion")
+        import time
+
+        time.sleep(30)  # others busy: the failure must cut them short
+
+    with pytest.raises(RuntimeError, match="intentional remote rank-1 explosion"):
+        run(failing_fn, hosts=f"127.0.0.1@{port_a}:1,127.0.0.1@{port_b}:1",
+            agent_secret=secret, timeout=120)
+
+
+@pytest.mark.slow
+def test_agent_death_is_actionable_and_leaves_no_orphans(two_agents, tmp_path):
+    """SIGKILL one agent mid-job: the driver must fail with an error naming
+    the unreachable agent, and every worker (both the dead agent's and the
+    survivor's) must be gone afterwards — the zero-orphan contract the
+    reference gets from Spark task teardown."""
+    secret, port_a, port_b, agent_a, _ = two_agents
+    piddir = str(tmp_path)
+
+    def stall_fn(piddir):
+        import os
+        import time
+
+        with open(os.path.join(piddir, f"{os.getpid()}.pid"), "w") as f:
+            f.write(str(os.getpid()))
+        time.sleep(120)
+
+    box: dict = {}
+
+    def launch():
+        try:
+            run(stall_fn, args=(piddir,),
+                hosts=f"127.0.0.1@{port_a}:2,127.0.0.1@{port_b}:2",
+                agent_secret=secret, timeout=180)
+            box["error"] = None
+        except BaseException as e:
+            box["error"] = e
+
+    t = threading.Thread(target=launch)
+    t.start()
+    # Wait until every worker has checked in, then kill agent A hard.
+    deadline = time.monotonic() + 60
+    while len(os.listdir(piddir)) < 4:
+        assert time.monotonic() < deadline, "workers never started"
+        time.sleep(0.2)
+    pids = [int(name.split(".")[0]) for name in os.listdir(piddir)]
+    agent_a.kill()
+    t.join(timeout=90)
+    assert not t.is_alive(), "driver hung after agent death"
+    assert box["error"] is not None, "driver did not notice the dead agent"
+    assert "unreachable" in str(box["error"])
+    # Zero orphans: dead agent's workers exit via the parent-death watchdog,
+    # survivor's workers are killed by the driver's cleanup.
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        alive = [p for p in pids if _pid_alive(p)]
+        if not alive:
+            break
+        time.sleep(0.5)
+    assert not alive, f"orphaned workers survived: {alive}"
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    # Zombies count as dead once reaped by their (dead) parent's reaper;
+    # check process state to avoid counting zombies as alive.
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().split()[2] != "Z"
+    except OSError:
+        return False
