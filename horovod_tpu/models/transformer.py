@@ -81,10 +81,12 @@ class Block(nn.Module):
         q = _rope(q.reshape(b, t, self.heads, head_dim), positions)
         k = _rope(k.reshape(b, t, kvh, head_dim), positions)
         v = v.reshape(b, t, kvh, head_dim)
-        if self.attention == "dense" and kvh != self.heads:
-            # The einsum paths are plain multi-head; replicate kv heads for
-            # them (the flash kernels alias the shared head via the grid
-            # index map and never materialize the copies).
+        if self.attention == "dense" and kvh != self.heads and self.sp_axis is None:
+            # The local dense einsum path is plain multi-head; replicate kv
+            # heads for it. The ring path replicates INSIDE the per-step
+            # block product (ring_attention GQA support) so the ring rotates
+            # small kv blocks over ICI; the flash kernels alias the shared
+            # head via the grid index map and never materialize the copies.
             k = jnp.repeat(k, self.heads // kvh, axis=2)
             v = jnp.repeat(v, self.heads // kvh, axis=2)
         if self.sp_axis is not None:

@@ -107,10 +107,22 @@ def ring_attention(q, k, v, axis_name: str, zigzag: bool = False):
     2n-1-r), which balances the causal workload across ranks; the masking
     uses explicit global positions so correctness is independent of the
     layout (oracle-tested both ways).
+
+    GQA: k/v may carry fewer heads than q (grouped-query attention). The
+    ring rotates the SMALL k/v blocks — the ICI bandwidth saving is
+    heads/kv_heads — and each step's local block product replicates heads
+    on the fly (the flash variant in ring_flash.py aliases the shared head
+    in-kernel instead).
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
+    kvh = k.shape[2]
+    if h % kvh != 0 or v.shape[2] != kvh:
+        raise ValueError(
+            f"q heads {h} must be a multiple of kv heads {kvh} "
+            f"(v has {v.shape[2]})")
+    rep = h // kvh
     scale = d**-0.5
 
     o = jnp.zeros((b, t, h, d), jnp.float32)
@@ -139,7 +151,10 @@ def ring_attention(q, k, v, axis_name: str, zigzag: bool = False):
             fully_masked,
             lambda o, m, l, *_: (o, m, l),
             lambda o, m, l, kb, vb, kp: _block_update(
-                q, kb, vb, o, m, l, q_pos, kp, scale),
+                q,
+                kb if rep == 1 else jnp.repeat(kb, rep, axis=2),
+                vb if rep == 1 else jnp.repeat(vb, rep, axis=2),
+                o, m, l, q_pos, kp, scale),
             o, m, l, k_blk, v_blk, k_pos,
         )
         if step + 1 < n:
@@ -175,8 +190,19 @@ def ulysses_attention(q, k, v, axis_name: str, impl: str = "dense"):
     the (T, T) logits and stops compiling around seq 8k)."""
     n = lax.axis_size(axis_name)
     h = q.shape[2]
+    kvh = k.shape[2]
     if h % n != 0:
         raise ValueError(f"heads {h} not divisible by axis size {n}")
+    if v.shape[2] != kvh:
+        raise ValueError(f"k has {kvh} heads but v has {v.shape[2]}")
+    if kvh != h and (kvh % n != 0 or h % kvh != 0):
+        # GQA shards cleanly iff every device gets whole kv heads AND the
+        # q→kv grouping stays contiguous after the split (h % kvh == 0
+        # makes per-device rep = (h/n)/(kvh/n) integral).
+        raise ValueError(
+            f"GQA kv heads {kvh} must divide axis size {n} (and q heads "
+            f"{h} must be a multiple of {kvh}) for the all-to-all head "
+            f"split; use ring_attention/ring_flash_attention otherwise")
     if impl not in ("dense", "flash"):
         raise ValueError(f"unknown impl={impl!r}; use 'dense' or 'flash'")
 
@@ -187,6 +213,15 @@ def ulysses_attention(q, k, v, axis_name: str, impl: str = "dense"):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    if kvh != h and impl == "dense":
+        # The all-to-all moved the SMALL kv head set (the ICI saving);
+        # replicate locally for the plain multi-head einsum. The flash
+        # kernel aliases the shared head in its index map instead — the
+        # post-split local grouping (q head j → kv head j//rep) matches
+        # the global GQA grouping because h % kvh == 0.
+        rep = h // kvh
+        kh = jnp.repeat(kh, rep, axis=2)
+        vh = jnp.repeat(vh, rep, axis=2)
     if impl == "flash":
         from .flash_attention import flash_attention
 

@@ -59,7 +59,10 @@ def allreduce(x, axis_name: str = HVD_AXIS, op: ReduceOp = ReduceOp.AVERAGE):
     if op == ReduceOp.MAX:
         return lax.pmax(x, axis_name)
     if op == ReduceOp.PRODUCT:
-        return jnp.exp(lax.psum(jnp.log(x), axis_name))  # fallback; rarely used
+        # Exact for negatives, zeros, and infs: gather the axis's values and
+        # multiply (a log-space psum would NaN on negatives and mishandle
+        # zeros). O(axis) memory for one op nobody fuses — correctness wins.
+        return jnp.prod(lax.all_gather(x, axis_name), axis=0)
     raise ValueError(f"unknown op {op}")
 
 

@@ -129,9 +129,23 @@ def fused_allreduce(
     horovod_tpu.compression (reference tensorflow/compression.py:FP16Compressor).
     """
     pad_to = 1
+    if hierarchical and op not in (collectives.ReduceOp.SUM,
+                                   collectives.ReduceOp.AVERAGE):
+        # The reduce-scatter → psum → all-gather ladder is a sum machine;
+        # silently summing a requested MAX/MIN/PRODUCT would be wrong.
+        raise ValueError(
+            f"hierarchical fusion supports SUM/AVERAGE only (got {op}); "
+            f"use hierarchical=False for {op.name}")
     if hierarchical:
         # psum_scatter needs dim 0 divisible by the ici axis size; plan pads.
-        pad_to = jax.lax.axis_size(ici_axis) if _in_trace(tree) else 1
+        # The size must resolve whether or not the leaves are tracers (a
+        # shard_map body may pass closed-over concrete arrays), so fall back
+        # from the trace's axis env to the ambient `with Mesh(...)` context.
+        pad_to = _axis_size(ici_axis)
+        if pad_to is None:
+            raise ValueError(
+                f"hierarchical fusion needs the size of axis {ici_axis!r}: "
+                f"call inside shard_map/pmap or under `with mesh:`")
     plan = build_plan(tree, threshold, pad_to=pad_to)
     buffers = fuse(tree, plan)
     out = []
@@ -152,6 +166,16 @@ def fused_allreduce(
     return unfuse(out, plan)
 
 
-def _in_trace(tree) -> bool:
-    leaves = jax.tree_util.tree_leaves(tree)
-    return bool(leaves) and isinstance(leaves[0], jax.core.Tracer)
+def _axis_size(axis_name: str):
+    """Resolve a mesh axis size from the active trace or, failing that, the
+    ambient ``with Mesh(...)`` context; None if neither binds the name."""
+    try:
+        return int(jax.lax.axis_size(axis_name))
+    except NameError:
+        pass
+    from jax._src import mesh as mesh_lib
+
+    env_mesh = mesh_lib.thread_resources.env.physical_mesh
+    if not env_mesh.empty and axis_name in env_mesh.shape:
+        return int(env_mesh.shape[axis_name])
+    return None
