@@ -38,14 +38,16 @@ def save(path: str, state: Any, step: Optional[int] = None, force: bool = True) 
     the eager engine keeps ranks from racing ahead of an unfinished save."""
     import numpy as np
 
-    if basics.rank() == 0:
+    # Uninitialized == single-process (a plain post-training export script);
+    # rank 0 writes, and only a multi-rank world needs the barrier.
+    if not basics.is_initialized() or basics.rank() == 0:
         ocp = _ocp()
         ckptr = ocp.StandardCheckpointer()
         target = os.path.join(os.path.abspath(path), f"step_{step}") \
             if step is not None else os.path.abspath(path)
         ckptr.save(target, state, force=force)
         ckptr.wait_until_finished()
-    if basics.size() > 1:
+    if basics.is_initialized() and basics.size() > 1:
         # barrier: everyone waits until rank 0's save completed
         basics.engine().run("allreduce", np.zeros(1), f"ckpt.barrier.{path}.{step}")
 
@@ -117,6 +119,96 @@ def _verify_cross_rank_digest(state: Any, tag: str) -> None:
             f"different state than rank 0 (non-shared or stale filesystem?); "
             f"restore on rank 0 only and broadcast, or fix the filesystem"
         )
+
+
+def merge_stacked_stats(stats: Any, axis: int = 0) -> Any:
+    """Consolidate per-device batch statistics that carry a leading device
+    dimension (the single-process sharded layout: bench.py keeps one BN-stat
+    row per mesh position) into single-replica values by averaging over
+    ``axis``. Pure function — usable inside or outside jit."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(lambda t: jnp.mean(t, axis=axis), stats)
+
+
+def average_stats_across_ranks(stats: Any) -> Any:
+    """Consolidate per-PROCESS batch statistics (the multi-process eager
+    layout: each rank tracked its own BN running stats, reference-style) by
+    averaging through the eager engine. Collective: every rank must call."""
+    import numpy as np
+
+    if _world_size() == 1:
+        return stats
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(stats)
+    # Enqueue everything, then synchronize: the leaves pipeline through the
+    # engine's fusion machinery in one pass instead of paying one collective
+    # round trip per BN layer (same pattern as _verify_cross_rank_digest).
+    eng = basics.engine()
+    arrs = [np.asarray(leaf) for leaf in leaves]
+    handles = [eng.enqueue("allreduce", a.astype(np.float64),
+                           f"export.stats.{i}", average=True)
+               for i, a in enumerate(arrs)]
+    out = [np.asarray(eng.synchronize(h)).reshape(a.shape).astype(a.dtype)
+           for h, a in zip(handles, arrs)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def export_for_inference(path: str, state: Any, *,
+                         drop: tuple = ("opt_state",),
+                         stats_key: str = "batch_stats",
+                         stacked_stats_axis: Optional[int] = None,
+                         cross_rank: Optional[bool] = None) -> Any:
+    """Strip the distributed machinery from a training state and write a
+    single-replica serving checkpoint (the reference's optimize-for-inference
+    step, /root/reference/docs/inference.md:1-16 — there a TF graph pass that
+    removes HorovodAllreduce ops; here the training-only state).
+
+    - ``drop``: top-level keys removed (optimizer state, step counters you
+      don't serve with).
+    - ``stats_key``: per-rank/per-device batch statistics to consolidate.
+      With ``stacked_stats_axis`` the leaves carry a leading device dim and
+      are averaged over it (single-process sharded layout); with
+      ``cross_rank`` (default: whenever the world is larger than one) each
+      process's stats are averaged through the eager engine (collective —
+      every rank must call export_for_inference).
+    - Writes on rank 0 only, with the same completion barrier as
+      :func:`save`; returns the serving state on every rank.
+
+    The result restores with :func:`load_for_inference` in a process that
+    never imports the distributed pieces, let alone calls ``hvd.init()``.
+    """
+    if not isinstance(state, dict):
+        raise TypeError(f"state must be a dict of top-level keys, got {type(state)}")
+    serving = {k: v for k, v in state.items() if k not in set(drop)}
+    if stats_key in serving:
+        stats = serving[stats_key]
+        if stacked_stats_axis is not None:
+            stats = merge_stacked_stats(stats, axis=stacked_stats_axis)
+        if cross_rank if cross_rank is not None else _world_size() > 1:
+            stats = average_stats_across_ranks(stats)
+        serving[stats_key] = stats
+    save(path, serving)
+    return serving
+
+
+def _world_size() -> int:
+    return basics.size() if basics.is_initialized() else 1
+
+
+def load_for_inference(path: str, template: Any = None) -> Any:
+    """Restore a serving checkpoint written by :func:`export_for_inference`.
+    Standalone by design: no ``hvd.init()``, no collectives, no engine — a
+    fresh serving process restores and runs a plain single-replica forward
+    (the property the reference's inference doc is about: the serving side
+    must not need the Horovod library's ops)."""
+    ocp = _ocp()
+    ckptr = ocp.StandardCheckpointer()
+    target = os.path.abspath(path)
+    return ckptr.restore(target, template) if template is not None \
+        else ckptr.restore(target)
 
 
 def latest_step(path: str) -> Optional[int]:

@@ -200,9 +200,10 @@ def ulysses_attention(q, k, v, axis_name: str, impl: str = "dense"):
         # q→kv grouping stays contiguous after the split (h % kvh == 0
         # makes per-device rep = (h/n)/(kvh/n) integral).
         raise ValueError(
-            f"GQA kv heads {kvh} must divide axis size {n} (and q heads "
-            f"{h} must be a multiple of {kvh}) for the all-to-all head "
-            f"split; use ring_attention/ring_flash_attention otherwise")
+            f"GQA kv heads {kvh} must be a multiple of the axis size {n} "
+            f"(and q heads {h} a multiple of {kvh}) so the all-to-all can "
+            f"hand every device whole kv heads; use "
+            f"ring_attention/ring_flash_attention otherwise")
     if impl not in ("dense", "flash"):
         raise ValueError(f"unknown impl={impl!r}; use 'dense' or 'flash'")
 

@@ -256,3 +256,32 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
         container[key] = type(value)(out) if not isinstance(value, bool) else bool(out)
 
     optimizer.load_state_dict(state_dict)
+
+
+def consolidate_bn_stats(module: "torch.nn.Module") -> None:
+    """Average every BatchNorm-style running statistic across ranks, in
+    place — the export-for-inference consolidation for the torch path.
+
+    Distributed training keeps per-rank running_mean/running_var (each rank
+    only saw its shard of the data); a checkpoint written from rank 0 alone
+    serves with rank 0's statistics. Call this once before exporting so the
+    served stats reflect the whole world (the jax-side analog is
+    checkpoint.average_stats_across_ranks). num_batches_tracked is averaged
+    too (identical across ranks in lockstep training, so a no-op there).
+    """
+    if size() == 1:
+        return
+    import torch.nn.modules.batchnorm as bn
+
+    for name, m in sorted(module.named_modules()):
+        if not isinstance(m, bn._NormBase) or not m.track_running_stats:
+            continue
+        for stat in ("running_mean", "running_var"):
+            t = getattr(m, stat, None)
+            if t is not None:
+                allreduce_(t, average=True, name=f"bn.{name}.{stat}")
+        nbt = getattr(m, "num_batches_tracked", None)
+        if nbt is not None:
+            wrapped = nbt.to(torch.float64)
+            allreduce_(wrapped, average=True, name=f"bn.{name}.nbt")
+            nbt.copy_(wrapped.to(nbt.dtype))
