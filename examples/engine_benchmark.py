@@ -32,6 +32,9 @@ def parse_args():
                    help="number of tensors (ResNet-50 has ~161 param tensors)")
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--dtype", default="f64", choices=["f64", "f32", "bf16", "f16"],
+                   help="payload dtype; 16-bit moves 2 bytes/element on the "
+                        "wire (native-width ring reduction)")
     return p.parse_args()
 
 
@@ -41,12 +44,18 @@ def main():
     eng = basics.engine()
     rank, size = hvd.rank(), hvd.size()
 
-    total_elems = int(args.mb * 1e6 / 8)  # float64 payloads
+    if args.dtype == "bf16":
+        import ml_dtypes  # ships with jax; only needed for bf16 payloads
+
+        dt = ml_dtypes.bfloat16
+    else:
+        dt = {"f64": np.float64, "f32": np.float32, "f16": np.float16}[args.dtype]
+    total_elems = int(args.mb * 1e6 / np.dtype(dt).itemsize)
     # Realistic skew: a few big tensors hold most bytes (conv kernels),
     # many small ones (biases/BN) ride the fusion path.
     weights = np.geomspace(1.0, 200.0, args.tensors)
     sizes = np.maximum((weights / weights.sum() * total_elems).astype(int), 16)
-    tensors = [np.full(s, float(rank), np.float64) for s in sizes]
+    tensors = [np.full(s, float(rank), dt) for s in sizes]
     payload_bytes = sum(t.nbytes for t in tensors)
 
     def step(tag):

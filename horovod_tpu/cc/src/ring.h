@@ -176,9 +176,13 @@ class RingLinks {
 };
 
 // ------------------------------------------------------------ typed arithmetic
-// Ring reduction runs in a "work dtype": f16/bf16 buffers are pre-converted
-// to f32 by the engine (the reference reduces fp16 through a f32-accumulating
-// custom MPI op for the same reason, half.h:135), so only these types appear.
+// Ring reduction runs at the tensor's NATIVE width: f16/bf16 move 2 bytes
+// per element on the wire and in DRAM, with each per-element add performed
+// in f32 (the reference's custom MPI fp16 op does exactly this, half.h:135
+// float16_sum: load halves -> float add -> store half). The accumulator is
+// re-rounded to 16 bits each ring step, the same semantics as an MPI
+// reduction tree at native width; the win is half the wire bytes on the
+// host-DRAM-bound eager path.
 
 template <typename T>
 static void add_chunk_t(uint8_t* dst, const uint8_t* src, size_t count) {
@@ -187,18 +191,34 @@ static void add_chunk_t(uint8_t* dst, const uint8_t* src, size_t count) {
   for (size_t i = 0; i < count; i++) d[i] += s[i];
 }
 
+inline void add_chunk_f16(uint8_t* dst, const uint8_t* src, size_t count) {
+  uint16_t* d = (uint16_t*)dst;
+  const uint16_t* s = (const uint16_t*)src;
+  for (size_t i = 0; i < count; i++)
+    d[i] = float_to_half(half_to_float(d[i]) + half_to_float(s[i]));
+}
+
+inline void add_chunk_bf16(uint8_t* dst, const uint8_t* src, size_t count) {
+  uint16_t* d = (uint16_t*)dst;
+  const uint16_t* s = (const uint16_t*)src;
+  for (size_t i = 0; i < count; i++)
+    d[i] = float_to_bf16(bf16_to_float(d[i]) + bf16_to_float(s[i]));
+}
+
 inline void add_chunk(DataType t, uint8_t* dst, const uint8_t* src,
                       size_t count) {
   switch (t) {
     case DataType::F32: add_chunk_t<float>(dst, src, count); return;
     case DataType::F64: add_chunk_t<double>(dst, src, count); return;
+    case DataType::F16: add_chunk_f16(dst, src, count); return;
+    case DataType::BF16: add_chunk_bf16(dst, src, count); return;
     case DataType::I32: add_chunk_t<int32_t>(dst, src, count); return;
     case DataType::I64: add_chunk_t<int64_t>(dst, src, count); return;
     case DataType::U8:
     case DataType::BOOL: add_chunk_t<uint8_t>(dst, src, count); return;
     case DataType::I8: add_chunk_t<int8_t>(dst, src, count); return;
     default:
-      throw std::runtime_error("ring reduction on unsupported work dtype");
+      throw std::runtime_error("ring reduction on unsupported dtype");
   }
 }
 
@@ -209,16 +229,25 @@ static void scale_chunk_t(uint8_t* p, size_t count, int world) {
 }
 
 inline void scale_chunk(DataType t, uint8_t* p, size_t count, int world) {
+  uint16_t* u16 = (uint16_t*)p;
   switch (t) {
     case DataType::F32: scale_chunk_t<float>(p, count, world); return;
     case DataType::F64: scale_chunk_t<double>(p, count, world); return;
+    case DataType::F16:
+      for (size_t i = 0; i < count; i++)
+        u16[i] = float_to_half(half_to_float(u16[i]) / (float)world);
+      return;
+    case DataType::BF16:
+      for (size_t i = 0; i < count; i++)
+        u16[i] = float_to_bf16(bf16_to_float(u16[i]) / (float)world);
+      return;
     case DataType::I32: scale_chunk_t<int32_t>(p, count, world); return;
     case DataType::I64: scale_chunk_t<int64_t>(p, count, world); return;
     case DataType::U8:
     case DataType::BOOL: scale_chunk_t<uint8_t>(p, count, world); return;
     case DataType::I8: scale_chunk_t<int8_t>(p, count, world); return;
     default:
-      throw std::runtime_error("ring scaling on unsupported work dtype");
+      throw std::runtime_error("ring scaling on unsupported dtype");
   }
 }
 
