@@ -96,6 +96,15 @@ def parse_args():
     p.add_argument("--stop-after-epoch", type=int, default=0,
                    help="exit after saving this epoch's checkpoint "
                         "(simulates a preempted/killed job for the resume drill)")
+    p.add_argument("--data-dir", default=None,
+                   help="train from npy files on disk (rank-sharded memmap "
+                        "reads via horovod_tpu.data) instead of in-memory "
+                        "synthetic tensors — the reference's real-data "
+                        "variant, docs/benchmarks.md:40-63")
+    p.add_argument("--make-data", type=int, default=0, metavar="N",
+                   help="with --data-dir: write N synthetic samples as "
+                        "images.npy/labels.npy first (rank 0), then train "
+                        "from the files")
     p.add_argument("--seed", type=int, default=42)
     return p.parse_args()
 
@@ -133,18 +142,46 @@ def main():
     resume_from_epoch = int(hvd.broadcast(
         torch.tensor(resume_from_epoch), root_rank=0, name="resume_from_epoch"))
 
-    # Synthetic ImageNet-shaped dataset, partitioned with DistributedSampler
-    # exactly as the real-data script would be.
-    g = torch.Generator().manual_seed(args.seed)  # same data on every rank...
-    data = torch.randn(args.samples_per_rank * hvd.size(), 3,
-                       args.image_size, args.image_size, generator=g)
-    target = torch.randint(0, args.num_classes,
-                           (args.samples_per_rank * hvd.size(),), generator=g)
-    dataset = torch.utils.data.TensorDataset(data, target)
-    sampler = torch.utils.data.distributed.DistributedSampler(
-        dataset, num_replicas=hvd.size(), rank=hvd.rank())  # ...sharded here
-    loader = torch.utils.data.DataLoader(
-        dataset, batch_size=args.batch_size, sampler=sampler)
+    if args.data_dir:
+        # REAL file IO, rank-sharded: every rank memmaps the same npy files
+        # and reads only its sampler's disjoint 1/N of the indices per epoch
+        # (the reference's DistributedSampler recipe on an actual dataset
+        # tree, docs/benchmarks.md:40-63).
+        from horovod_tpu.data import (DistributedSampler, MemmapArrayDataset,
+                                      write_synthetic_shards)
+
+        if args.make_data and hvd.rank() == 0 and \
+                not os.path.exists(os.path.join(args.data_dir, "images.npy")):
+            write_synthetic_shards(args.data_dir, args.make_data,
+                                   (3, args.image_size, args.image_size),
+                                   args.num_classes, seed=args.seed)
+        hvd.allreduce(torch.zeros(1), name="data_ready")  # files exist barrier
+        dataset = MemmapArrayDataset(args.data_dir)
+        sampler = DistributedSampler(len(dataset), seed=args.seed)
+
+        def epoch_batches(epoch):
+            sampler.set_epoch(epoch)
+            nb = len(sampler) // args.batch_size
+            return ((torch.from_numpy(x), torch.from_numpy(y))
+                    for x, y in (dataset[idx]
+                                 for idx in sampler.batches(args.batch_size))), nb
+    else:
+        # Synthetic in-memory dataset, partitioned with DistributedSampler
+        # exactly as the real-data path is.
+        g = torch.Generator().manual_seed(args.seed)  # same data on every rank...
+        data = torch.randn(args.samples_per_rank * hvd.size(), 3,
+                           args.image_size, args.image_size, generator=g)
+        target = torch.randint(0, args.num_classes,
+                               (args.samples_per_rank * hvd.size(),), generator=g)
+        dataset = torch.utils.data.TensorDataset(data, target)
+        sampler = torch.utils.data.distributed.DistributedSampler(
+            dataset, num_replicas=hvd.size(), rank=hvd.rank())  # ...sharded here
+        loader = torch.utils.data.DataLoader(
+            dataset, batch_size=args.batch_size, sampler=sampler)
+
+        def epoch_batches(epoch):
+            sampler.set_epoch(epoch)
+            return iter(loader), len(loader)
 
     model = SmallResNet(num_classes=args.num_classes)
     optimizer = torch.optim.SGD(model.parameters(), lr=args.base_lr,
@@ -165,10 +202,11 @@ def main():
 
     for epoch in range(resume_from_epoch, args.epochs):
         model.train()
-        sampler.set_epoch(epoch)
+        batch_iter, batches_per_epoch = epoch_batches(epoch)
         running_loss, batches = 0.0, 0
-        for batch_idx, (x, y) in enumerate(loader):
-            adjust_learning_rate(args, optimizer, epoch, batch_idx, len(loader))
+        for batch_idx, (x, y) in enumerate(batch_iter):
+            adjust_learning_rate(args, optimizer, epoch, batch_idx,
+                                 batches_per_epoch)
             optimizer.zero_grad()
             loss = F.cross_entropy(model(x), y)
             loss.backward()

@@ -62,6 +62,10 @@ def _load():
         getattr(lib, fn).argtypes = []
     lib.hvd_cycle_time_ms.restype = ctypes.c_double
     lib.hvd_cycle_time_ms.argtypes = []
+    lib.hvd_timeline_start.restype = ctypes.c_int
+    lib.hvd_timeline_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.hvd_timeline_stop.restype = None
+    lib.hvd_timeline_stop.argtypes = []
     lib.hvd_shutdown.restype = None
     lib.hvd_enqueue.restype = ctypes.c_longlong
     lib.hvd_enqueue.argtypes = [
@@ -184,6 +188,15 @@ class NativeEngine:
             "fusion_threshold": int(self._lib.hvd_fusion_threshold()),
             "cycle_time_ms": float(self._lib.hvd_cycle_time_ms()),
         }
+
+    def timeline_start(self, path: str, mark_cycles: bool = False) -> int:
+        """Scoped timeline attach (hvd.timeline.trace): 1 if this call
+        opened it (caller owns the stop), 0 otherwise."""
+        return int(self._lib.hvd_timeline_start(path.encode(),
+                                                int(mark_cycles)))
+
+    def timeline_stop(self) -> None:
+        self._lib.hvd_timeline_stop()
 
     def shutdown(self) -> None:
         self._lib.hvd_shutdown()

@@ -168,6 +168,18 @@ long long hvd_ring_bytes_sent() {
   return eng ? (long long)eng->stats().bytes_sent.load() : -1;
 }
 
+// Scoped timeline attach (hvd.timeline.trace): returns 1 when this call
+// opened the timeline (caller owns the stop), 0 when one was already
+// configured (HOROVOD_TIMELINE) or this rank doesn't write.
+int hvd_timeline_start(const char* path, int mark_cycles) {
+  auto eng = engine();
+  return eng ? eng->timeline_start(path ? path : "", mark_cycles != 0) : 0;
+}
+void hvd_timeline_stop() {
+  auto eng = engine();
+  if (eng) eng->timeline_stop();
+}
+
 // ---- standalone autotuner objects (tests + compiled-path tuning) ----
 
 void* hvd_pm_create(long long fusion_threshold, double cycle_time_ms,

@@ -118,6 +118,17 @@ class Engine {
   uint32_t knob_version() const { return applied_knob_version_; }
   const RingStats& stats() const { return stats_; }
 
+  // Scoped timeline attach for hvd.timeline.trace(): start a timeline at
+  // runtime when none was configured via HOROVOD_TIMELINE. Returns 1 if
+  // THIS call opened it (the caller must stop it), 0 if one is already
+  // running or this rank doesn't write (rank 0 only, like the reference).
+  int timeline_start(const std::string& path, bool mark_cycles) {
+    if (topo_.rank != 0 || timeline_.healthy()) return 0;
+    timeline_.init(path, mark_cycles);
+    return timeline_.healthy() ? 1 : 0;
+  }
+  void timeline_stop() { timeline_.shutdown(); }
+
  private:
   struct Entry {
     Request req;

@@ -10,6 +10,7 @@
 #ifndef HVD_TIMELINE_H
 #define HVD_TIMELINE_H
 
+#include <atomic>
 #include <chrono>
 #include <condition_variable>
 #include <cstdio>
@@ -29,12 +30,14 @@ class Timeline {
   void init(const std::string& path, bool mark_cycles) {
     std::lock_guard<std::mutex> g(mu_);
     if (file_) return;
+    if (writer_.joinable()) writer_.join();  // previous trace fully retired
     file_ = std::fopen(path.c_str(), "w");
     if (!file_) return;
     std::fputs("[\n", file_);
+    pids_.clear();  // fresh lane map per trace file
     mark_cycles_ = mark_cycles;
-    healthy_ = true;
     start_ = now_us();
+    healthy_ = true;
     writer_ = std::thread([this] { writer_loop(); });
   }
 
@@ -58,7 +61,9 @@ class Timeline {
   void end(const std::string& tensor) { emit(tensor, 'E', "", ""); }
 
   void mark_cycle_start() {
-    if (healthy_ && mark_cycles_) emit("CYCLE", 'i', "CYCLE_START", "");
+    if (healthy_.load(std::memory_order_relaxed) &&
+        mark_cycles_.load(std::memory_order_relaxed))
+      emit("CYCLE", 'i', "CYCLE_START", "");
   }
 
   void shutdown() {
@@ -92,8 +97,13 @@ class Timeline {
 
   void emit(const std::string& tensor, char phase, const std::string& name,
             const std::string&) {
-    if (!healthy_) return;
+    if (!healthy_.load(std::memory_order_relaxed)) return;  // cheap fast-out
     std::lock_guard<std::mutex> g(mu_);
+    // Re-check under the lock: timeline_start/stop may now run from a user
+    // thread (hvd.timeline.trace) concurrently with engine emits, and an
+    // event enqueued after shutdown drained the queue would leak into the
+    // NEXT trace file with a stale start_ baseline.
+    if (!healthy_) return;
     if (queue_.size() >= kCapacity) return;  // drop, like a full SPSC queue
     queue_.push_back(Event{phase, tensor, name, now_us() - start_});
     cv_.notify_one();
@@ -169,8 +179,10 @@ class Timeline {
 
   static constexpr size_t kCapacity = 1 << 20;  // reference timeline.h:66
   std::FILE* file_ = nullptr;
-  bool healthy_ = false;
-  bool mark_cycles_ = false;
+  // atomics: read lock-free on the emit fast path, written by runtime
+  // attach/detach (timeline_start/stop) from another thread
+  std::atomic<bool> healthy_{false};
+  std::atomic<bool> mark_cycles_{false};
   int64_t start_ = 0;
   std::mutex mu_;
   std::condition_variable cv_;

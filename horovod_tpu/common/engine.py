@@ -238,6 +238,22 @@ class PyEngine:
     def run(self, op: str, array: np.ndarray, name: str, **kw) -> Any:
         return self.synchronize(self.enqueue(op, array, name, **kw))
 
+    def timeline_start(self, path: str, mark_cycles: bool = False) -> int:
+        """Scoped timeline attach (hvd.timeline.trace): returns 1 when this
+        call opened the timeline (caller owns the stop), 0 when one is
+        already configured or this rank doesn't write (rank 0 only)."""
+        if self.topo.rank != 0 or self._timeline is not None:
+            return 0
+        from ..utils.timeline import Timeline
+
+        self._timeline = Timeline(path, mark_cycles=mark_cycles)
+        return 1
+
+    def timeline_stop(self) -> None:
+        if self._timeline is not None:
+            self._timeline.close()
+            self._timeline = None
+
     def shutdown(self) -> None:
         self._shutdown.set()
         self._thread.join(timeout=5)

@@ -1,0 +1,128 @@
+"""Rank-sharded input pipeline — the data-distribution half of the
+reference's real-data benchmarks.
+
+The reference's real-data recipe (docs/benchmarks.md:40-63) is
+``torch.utils.data.distributed.DistributedSampler(dataset, num_replicas=
+hvd.size(), rank=hvd.rank())``: every rank reads a disjoint 1/N of the
+dataset per epoch, reshuffled each epoch, padded so all ranks take the same
+number of steps (a straggler-free lockstep world — a rank with fewer
+batches would hang the collectives). This module provides the same contract
+framework-free, plus an ``np.memmap``-backed dataset so the pipeline can be
+demonstrated on actual file IO without torchvision in the image:
+
+    ds = MemmapArrayDataset(data_dir)             # images.npy + labels.npy
+    sampler = DistributedSampler(len(ds))          # rank/size from hvd env
+    for epoch in range(E):
+        sampler.set_epoch(epoch)                   # reference sampler's
+        for idx in sampler.batches(batch_size):    # per-epoch reshuffle
+            x, y = ds[idx]                         # memmap slice -> RAM
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from .common import basics
+
+
+class DistributedSampler:
+    """Torch ``DistributedSampler`` semantics without torch:
+
+    - the index space is split round-robin after a per-epoch shuffle;
+    - every rank gets exactly ``ceil(n / size)`` indices — the tail is
+      padded by wrapping, so all ranks run the same number of steps
+      (lockstep collectives never starve);
+    - ``set_epoch(e)`` reseeds the shuffle (seed + epoch), the reference's
+      cross-epoch randomization contract.
+    """
+
+    def __init__(self, n: int, rank: Optional[int] = None,
+                 size: Optional[int] = None, shuffle: bool = True,
+                 seed: int = 0) -> None:
+        if n <= 0:
+            raise ValueError(f"empty dataset (n={n})")
+        self.n = n
+        self.rank = rank if rank is not None else basics.rank()
+        self.size = size if size is not None else basics.size()
+        if not (0 <= self.rank < self.size):
+            raise ValueError(f"rank {self.rank} outside world {self.size}")
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.per_rank = -(-n // self.size)  # ceil
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def indices(self) -> np.ndarray:
+        order = np.arange(self.n)
+        if self.shuffle:
+            np.random.default_rng(self.seed + self.epoch).shuffle(order)
+        total = self.per_rank * self.size
+        if total > self.n:  # pad by wrapping (reference sampler does the same)
+            order = np.concatenate([order, order[: total - self.n]])
+        return order[self.rank::self.size]
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.indices())
+
+    def __len__(self) -> int:
+        return self.per_rank
+
+    def batches(self, batch_size: int, drop_last: bool = True) -> Iterator[np.ndarray]:
+        """Index batches for one epoch. ``drop_last`` defaults True so every
+        rank sees identically-sized batches (shape-stable steps — on the
+        compiled path a ragged tail batch would retrace)."""
+        idx = self.indices()
+        end = (len(idx) // batch_size) * batch_size if drop_last else len(idx)
+        for i in range(0, end, batch_size):
+            yield idx[i:i + batch_size]
+
+
+class MemmapArrayDataset:
+    """File-backed (images, labels) pairs via ``np.memmap`` — rank-sharded
+    reading of ACTUAL files with no torchvision dependency. Layout:
+    ``<dir>/images.npy`` [N, ...] and ``<dir>/labels.npy`` [N]."""
+
+    def __init__(self, data_dir: str) -> None:
+        self.images = np.load(os.path.join(data_dir, "images.npy"), mmap_mode="r")
+        self.labels = np.load(os.path.join(data_dir, "labels.npy"), mmap_mode="r")
+        if len(self.images) != len(self.labels):
+            raise ValueError(
+                f"images ({len(self.images)}) / labels ({len(self.labels)}) "
+                f"length mismatch in {data_dir}")
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        """Materialize the selected rows into RAM (memmap slice copy)."""
+        idx = np.asarray(idx)
+        return np.ascontiguousarray(self.images[idx]), \
+            np.ascontiguousarray(self.labels[idx])
+
+
+def write_synthetic_shards(data_dir: str, n: int, image_shape: Sequence[int],
+                           num_classes: int, seed: int = 0,
+                           chunk: int = 1024) -> str:
+    """Write an ImageNet-shaped synthetic dataset to ``<dir>/{images,labels}
+    .npy`` so the real-IO pipeline is demonstrable anywhere (the reference's
+    real-data variant assumes an ImageNet tree on disk). The images file is
+    filled through a memmap in ``chunk``-row pieces — writing never holds
+    more than one chunk in RAM, the same property the read path has."""
+    os.makedirs(data_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    out = np.lib.format.open_memmap(
+        os.path.join(data_dir, "images.npy"), mode="w+", dtype=np.float32,
+        shape=(n, *image_shape))
+    for i in range(0, n, chunk):
+        m = min(chunk, n - i)
+        out[i:i + m] = rng.standard_normal((m, *image_shape), dtype=np.float32)
+    out.flush()
+    del out
+    labels = rng.integers(0, num_classes, size=(n,), dtype=np.int64)
+    np.save(os.path.join(data_dir, "labels.npy"), labels)
+    return data_dir

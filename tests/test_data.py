@@ -1,0 +1,90 @@
+"""Rank-sharded input pipeline — the DistributedSampler contract
+(disjoint per-rank coverage, per-epoch reshuffle, equal step counts) and
+real file IO through np.memmap (reference real-data recipe,
+docs/benchmarks.md:40-63)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from horovod_tpu.data import (
+    DistributedSampler,
+    MemmapArrayDataset,
+    write_synthetic_shards,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_sampler_partitions_disjoint_and_complete():
+    n, size = 103, 4  # non-divisible: tail is padded by wrapping
+    per_rank = [list(DistributedSampler(n, rank=r, size=size, shuffle=False))
+                for r in range(size)]
+    lengths = {len(ix) for ix in per_rank}
+    assert lengths == {26}, "all ranks must take the same number of steps"
+    flat = [i for ix in per_rank for i in ix]
+    assert set(flat) == set(range(n)), "every sample must be covered"
+    # only the wrap-pad duplicates: total - n
+    assert len(flat) - len(set(flat)) == 26 * size - n
+
+
+def test_sampler_reshuffles_per_epoch_identically_across_ranks():
+    samplers = [DistributedSampler(64, rank=r, size=2, seed=7) for r in (0, 1)]
+    first = [s.indices().tolist() for s in samplers]
+    assert not set(first[0]) & set(first[1]), "ranks must be disjoint"
+    for s in samplers:
+        s.set_epoch(1)
+    second = [s.indices().tolist() for s in samplers]
+    assert first[0] != second[0], "epoch must reshuffle"
+    assert not set(second[0]) & set(second[1]), \
+        "ranks must stay disjoint after reshuffle (same permutation)"
+
+
+def test_sampler_batches_drop_ragged_tail():
+    s = DistributedSampler(100, rank=0, size=2, shuffle=False)  # 50 idx
+    batches = list(s.batches(16))
+    assert [len(b) for b in batches] == [16, 16, 16]
+    assert [len(b) for b in s.batches(16, drop_last=False)][-1] == 2
+
+
+def test_memmap_dataset_roundtrip(tmp_path):
+    d = write_synthetic_shards(str(tmp_path), 20, (3, 4, 4), 10, seed=1)
+    ds = MemmapArrayDataset(d)
+    assert len(ds) == 20
+    x, y = ds[[3, 7, 7]]
+    assert x.shape == (3, 3, 4, 4) and y.shape == (3,)
+    assert x.dtype == np.float32 and y.dtype == np.int64
+    np.testing.assert_array_equal(ds[[7]][0][0], x[1])
+    # memmap: the file is the storage, not RAM
+    assert isinstance(ds.images, np.memmap)
+
+
+def test_sampler_rejects_bad_world():
+    with pytest.raises(ValueError, match="outside world"):
+        DistributedSampler(10, rank=3, size=2)
+    with pytest.raises(ValueError, match="empty dataset"):
+        DistributedSampler(0, rank=0, size=1)
+
+
+@pytest.mark.slow
+def test_imagenet_example_trains_from_files(tmp_path):
+    """E2e: 2 ranks write + read npy shards from disk through the launcher;
+    each rank reads a disjoint half per epoch and training completes."""
+    data_dir = str(tmp_path / "shards")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2", "--",
+         sys.executable, "examples/pytorch_imagenet_resnet50.py",
+         "--epochs", "2", "--data-dir", data_dir, "--make-data", "128",
+         "--batch-size", "16", "--image-size", "8",
+         "--checkpoint-dir", str(tmp_path / "ck")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert os.path.exists(os.path.join(data_dir, "images.npy"))
+    assert '"epoch": 2' in proc.stdout
