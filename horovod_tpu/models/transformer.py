@@ -56,6 +56,10 @@ class Block(nn.Module):
     moe_experts: int = 0           # >0: switch-MoE MLP instead of dense
     attention: str = "dense"       # "dense" | "flash" (pallas fused kernel)
     kv_heads: Optional[int] = None  # < heads: grouped-query attention
+    # flash kernel tile sizes (None = kernel defaults; sweep with
+    # examples/transformer_benchmark.py --sweep-blocks)
+    block_q: Optional[int] = None
+    block_k: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -89,11 +93,17 @@ class Block(nn.Module):
             # head via the grid index map and never materialize the copies.
             k = jnp.repeat(k, self.heads // kvh, axis=2)
             v = jnp.repeat(v, self.heads // kvh, axis=2)
+        from ..ops.flash_attention import DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+
+        bq = self.block_q if self.block_q is not None else DEFAULT_BLOCK_Q
+        bk = self.block_k if self.block_k is not None else DEFAULT_BLOCK_K
         if self.sp_axis is not None:
             if self.attention == "flash":
                 from ..ops.ring_flash import ring_flash_attention
 
-                attn = ring_flash_attention(q, k, v, axis_name=self.sp_axis)
+                # positional: custom_vjp nondiff_argnums
+                attn = ring_flash_attention(q, k, v, self.sp_axis, False,
+                                            bq, bk)
             else:
                 from ..ops.ring_attention import ring_attention
 
@@ -101,7 +111,7 @@ class Block(nn.Module):
         elif self.attention == "flash":
             from ..ops.flash_attention import flash_attention
 
-            attn = flash_attention(q, k, v)
+            attn = flash_attention(q, k, v, block_q=bq, block_k=bk)
         else:
             attn = causal_attention(q, k, v)
         attn = attn.reshape(b, t, self.dim)
@@ -149,6 +159,10 @@ class TransformerLM(nn.Module):
     # knob that buys deeper models / longer sequences when activations,
     # not weights, are the memory ceiling. Composes with flash and sp.
     remat: bool = False
+    # flash kernel tile sizes (None = ops/flash_attention.py defaults;
+    # sweep per sequence length with transformer_benchmark --sweep-blocks)
+    block_q: Optional[int] = None
+    block_k: Optional[int] = None
 
     @nn.compact
     def __call__(self, tokens, positions=None, return_hidden: bool = False):
@@ -165,6 +179,8 @@ class TransformerLM(nn.Module):
                 sp_axis=self.sp_axis,
                 attention=self.attention,
                 kv_heads=self.kv_heads,
+                block_q=self.block_q,
+                block_k=self.block_k,
                 moe_experts=(self.moe_experts
                              if self.moe_experts > 0 and i % self.moe_every == self.moe_every - 1
                              else 0),

@@ -193,6 +193,16 @@ def _fit_block(t, want, quantum):
     return t
 
 
+# Default kernel tiles — the single source of truth (Block/TransformerLM
+# and the benchmark read these). Measured by the r3 sweep
+# (examples/transformer_benchmark.py --sweep-blocks, table in
+# docs/benchmarks.md): 1024/1024 wins at every feasible sequence length on
+# v5e at D=64 (+12% over the old 1024/512 at seq 4k, +27% at 16k);
+# block_q=2048 exceeds the backward kernel's scoped VMEM (19.3M > 16M).
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+
+
 def _check_blocks(t, block_q, block_k, interpret):
     # TPU lowering wants the lse/delta blocks (1, 8, block_q) 128-divisible
     # in the last dim and the K/V blocks (1, block_k, d) 8-divisible in the
@@ -239,8 +249,10 @@ def _q_row(r, j, nq, h, hkv, group):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 1024,
-                    block_k: int = 512, interpret: bool | None = None):
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool | None = None):
     """Fused attention, trainable. q: ``(B, T, H, D)``, k/v: ``(B, T, H, D)``
     or ``(B, T, Hkv, D)`` with ``H % Hkv == 0`` for grouped-query attention
     (each kv head serves a contiguous group of q heads — no head

@@ -44,6 +44,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import (
+    DEFAULT_BLOCK_K,
+    DEFAULT_BLOCK_Q,
     NEG_INF,
     _check_blocks,
     _gqa_group,
@@ -217,6 +219,10 @@ def _fwd_block_call(qr, k_blk, v_blk, o, m, l, qpos, kpos, bq, bk,
         scratch_shapes=[pltpu.VMEM((1, bq, d), jnp.float32),
                         pltpu.VMEM((1, 8, bq), jnp.float32),
                         pltpu.VMEM((1, 8, bq), jnp.float32)],
+        # The (o, m, l) carries update IN PLACE across ring steps: without
+        # the aliases every step round-trips fresh HBM output buffers for
+        # state that is dead on entry (~2x carry HBM traffic per step).
+        input_output_aliases={3: 0, 4: 1, 5: 2},
         interpret=interpret,
     )(qr, k_blk, v_blk, o, m, l, qpos, kpos)
 
@@ -237,6 +243,7 @@ def _dq_block_call(qr, k_blk, v_blk, dor, lse, delta, qpos, kpos, dq,
         out_specs=_qd_spec(bq, d),
         out_shape=jax.ShapeDtypeStruct((R, t, d), jnp.float32),
         scratch_shapes=[pltpu.VMEM((1, bq, d), jnp.float32)],
+        input_output_aliases={8: 0},  # dq accumulator updates in place
         interpret=interpret,
     )(qr, k_blk, v_blk, dor, lse, delta, qpos, kpos, dq)
 
@@ -268,6 +275,7 @@ def _dkv_block_call(qr, k_blk, v_blk, dor, lse, delta, qpos, kpos, dk, dv,
                    jax.ShapeDtypeStruct((Rkv, t, d), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((1, bk, d), jnp.float32),
                         pltpu.VMEM((1, bk, d), jnp.float32)],
+        input_output_aliases={8: 0, 9: 1},  # dk/dv ride the ring in place
         interpret=interpret,
     )(qr, k_blk, v_blk, dor, lse, delta, qpos, kpos, dk, dv)
 
@@ -290,7 +298,8 @@ def _kpos_arr(pos, t):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def ring_flash_attention(q, k, v, axis_name: str, zigzag: bool = False,
-                         block_q: int = 1024, block_k: int = 512,
+                         block_q: int = DEFAULT_BLOCK_Q,
+                         block_k: int = DEFAULT_BLOCK_K,
                          interpret: bool | None = None):
     """Causal ring attention over ``axis_name`` with pallas-fused local
     blocks, trainable. q: ``(B, T_local, H, D)``; k, v: same or
