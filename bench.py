@@ -32,10 +32,12 @@ import sys
 REFERENCE_PER_DEVICE_IMG_S = 1656.82 / 16.0
 
 
-def _build(fusion_threshold=None, compression=None):
+def _build(fusion_threshold=None, compression=None, hierarchical=False):
     """Model + jitted train step + fresh state. The knob arguments exist for
     --autotune, which re-builds (re-jits) per candidate config — trace-time
-    knobs can only be tuned between traces."""
+    knobs can only be tuned between traces. ``hierarchical`` runs the
+    gradient allreduce as the RS(ici)→psum(dcn)→AG(ici) ladder over the
+    2-D ``('dcn','ici')`` mesh — only meaningful on multi-chip topologies."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -45,7 +47,7 @@ def _build(fusion_threshold=None, compression=None):
     import horovod_tpu as hvd
     from horovod_tpu.models import ResNet50
 
-    mesh = hvd.default_mesh()
+    mesh = hvd.hierarchical_mesh() if hierarchical else hvd.default_mesh()
     n_dev = len(jax.devices())
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
 
@@ -80,6 +82,7 @@ def _build(fusion_threshold=None, compression=None):
         optax.sgd(0.01 * n_dev, momentum=0.9),
         fusion_threshold=fusion_threshold or tuned_default,
         compression=compression or hvd.Compression.none,
+        hierarchical=hierarchical,
     )
     opt_state = opt.init(params)
 
@@ -101,10 +104,11 @@ def _build(fusion_threshold=None, compression=None):
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         batch_stats = jax.tree_util.tree_map(lambda t: t[None], local_stats)
-        loss = jax.lax.pmean(loss, hvd.HVD_AXIS)
+        loss = jax.lax.pmean(loss, A)
         return params, batch_stats, opt_state, loss
 
-    A = hvd.HVD_AXIS
+    # Data axis: the flat world, or both levels of the 2-D hierarchy.
+    A = ("dcn", "ici") if hierarchical else hvd.HVD_AXIS
     step = jax.jit(
         shard_map(
             train_step,
@@ -132,9 +136,9 @@ def autotune_main() -> None:
 
     hvd.init()
 
-    def step_factory(fusion_threshold, compression):
+    def step_factory(fusion_threshold, compression, hierarchical=False):
         comp = hvd.Compression.bf16 if compression == "bf16" else hvd.Compression.none
-        step, state, (x, y), _, _ = _build(fusion_threshold, comp)
+        step, state, (x, y), _, _ = _build(fusion_threshold, comp, hierarchical)
         state = list(state)
         loss_box = [None]
 
@@ -144,10 +148,18 @@ def autotune_main() -> None:
 
         return run, lambda: float(loss_box[0])  # window-end hard sync
 
+    branches = [{"compression": "none"}, {"compression": "bf16"}]
+    if hvd.hierarchical_mesh().shape.get("dcn", 1) > 1:
+        # The RS->psum->AG ladder only exists to trade DCN for ICI traffic;
+        # on a flat/single-chip topology it is pure overhead, so the
+        # branches join the search only when there are two real levels to
+        # trade (both pairings: compression halves the ladder's bytes too).
+        branches.append({"compression": "none", "hierarchical": True})
+        branches.append({"compression": "bf16", "hierarchical": True})
     report = tune(
         step_factory,
         thresholds=DEFAULT_THRESHOLDS,
-        branches=[{"compression": "none"}, {"compression": "bf16"}],
+        branches=branches,
         warmup=3, iters=8, reps=3, gp_rounds=2,
         log_path=os.environ.get("HVD_AUTOTUNE_LOG", "autotune_compiled.csv"),
         verbose=True,
@@ -170,7 +182,14 @@ def main() -> None:
         return autotune_main()
 
     hvd.init()
-    step, (params, batch_stats, opt_state), (x, y), batch, n_dev = _build()
+    # Apply tuned winners from --autotune: threshold via
+    # HOROVOD_FUSION_THRESHOLD (read in _build) and the ladder via
+    # HOROVOD_HIERARCHICAL_ALLREDUCE — the same env knobs the eager engine
+    # honors (common/config.py), so the tuning loop closes for both paths.
+    from horovod_tpu.common.config import Config
+
+    step, (params, batch_stats, opt_state), (x, y), batch, n_dev = _build(
+        hierarchical=Config.from_env().hierarchical_allreduce)
 
     # Warmup (compile) + timed windows, reference-style (synthetic_benchmark
     # num_warmup_batches=10, num_batches_per_iter=10 over num_iters=10 with
