@@ -29,8 +29,11 @@ def lib_path(build: bool = True) -> str:
         if os.path.exists(_LIB):
             lib_mtime = os.path.getmtime(_LIB)
             src_dir = os.path.join(_DIR, "src")
-            for f in os.listdir(src_dir):
-                if os.path.getmtime(os.path.join(src_dir, f)) > lib_mtime:
+            # The Makefile counts as a source: flag changes must rebuild.
+            watched = [os.path.join(src_dir, f) for f in os.listdir(src_dir)]
+            watched.append(os.path.join(_DIR, "Makefile"))
+            for f in watched:
+                if os.path.getmtime(f) > lib_mtime:
                     sources_newer = True
                     break
         if (not os.path.exists(_LIB) or sources_newer) and build:
