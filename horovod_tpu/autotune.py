@@ -32,6 +32,15 @@ def _lib():
     lib.hvd_pm_cycle_time_ms.restype = ctypes.c_double
     lib.hvd_pm_cycle_time_ms.argtypes = [ctypes.c_void_p]
     lib.hvd_pm_set_log.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.hvd_pm_set_hierarchy.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                         ctypes.c_int, ctypes.c_int,
+                                         ctypes.c_int]
+    lib.hvd_pm_enable_hierarchy.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                            ctypes.c_int]
+    lib.hvd_pm_hier_allreduce.restype = ctypes.c_int
+    lib.hvd_pm_hier_allreduce.argtypes = [ctypes.c_void_p]
+    lib.hvd_pm_hier_allgather.restype = ctypes.c_int
+    lib.hvd_pm_hier_allgather.argtypes = [ctypes.c_void_p]
     lib.hvd_gp_fit_predict.restype = ctypes.c_int
     lib.hvd_gp_fit_predict.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_double),
@@ -91,6 +100,33 @@ class ParameterManager:
     @property
     def cycle_time_ms(self) -> float:
         return float(self._lib.hvd_pm_cycle_time_ms(self._h))
+
+    def set_hierarchy(self, allreduce_on: bool, allgather_on: bool,
+                      allreduce_pinned: bool = False,
+                      allgather_pinned: bool = False) -> None:
+        """Seed the categorical hierarchical knobs (and optionally pin them
+        out of the search), mirroring the env-seeded values the eager
+        engine's embedded manager starts from."""
+        self._lib.hvd_pm_set_hierarchy(
+            self._h, int(allreduce_on), int(allgather_on),
+            int(allreduce_pinned), int(allgather_pinned))
+
+    def enable_hierarchy(self, allreduce_capable: bool = True,
+                         allgather_capable: bool = True) -> None:
+        """Open the categorical hierarchical dimensions for exploration
+        (reference parameter_manager.h:172 tunes the same flags). Only
+        meaningful on a multi-host topology; the eager engine's embedded
+        manager calls this automatically after registration."""
+        self._lib.hvd_pm_enable_hierarchy(
+            self._h, int(allreduce_capable), int(allgather_capable))
+
+    @property
+    def hier_allreduce(self) -> bool:
+        return bool(self._lib.hvd_pm_hier_allreduce(self._h))
+
+    @property
+    def hier_allgather(self) -> bool:
+        return bool(self._lib.hvd_pm_hier_allgather(self._h))
 
     def close(self) -> None:
         if self._h:

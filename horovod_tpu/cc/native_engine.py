@@ -54,11 +54,16 @@ def _load():
         ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_double,
         ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
         ctypes.c_double, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
-        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int,
     ]
     for fn in ("hvd_knob_version", "hvd_ring_passes", "hvd_ring_bytes_sent",
-               "hvd_fusion_threshold"):
+               "hvd_ring_cross_bytes_sent", "hvd_fusion_threshold"):
         getattr(lib, fn).restype = ctypes.c_longlong
+        getattr(lib, fn).argtypes = []
+    for fn in ("hvd_hier_allreduce_on", "hvd_hier_allgather_on",
+               "hvd_hier_capable"):
+        getattr(lib, fn).restype = ctypes.c_int
         getattr(lib, fn).argtypes = []
     lib.hvd_cycle_time_ms.restype = ctypes.c_double
     lib.hvd_cycle_time_ms.argtypes = []
@@ -118,7 +123,11 @@ class NativeEngine:
             float(getattr(config, "stall_warning_s", 60.0)),
             int(config.autotune), config.autotune_log.encode(),
             int("HOROVOD_FUSION_THRESHOLD" in pinned),
-            int("HOROVOD_CYCLE_TIME" in pinned), err, 1024,
+            int("HOROVOD_CYCLE_TIME" in pinned),
+            int(getattr(config, "hierarchical_allreduce", False)),
+            int(getattr(config, "hierarchical_allgather", False)),
+            int("HOROVOD_HIERARCHICAL_ALLREDUCE" in pinned),
+            int("HOROVOD_HIERARCHICAL_ALLGATHER" in pinned), err, 1024,
         )
         if rc != 0:
             raise HorovodInternalError(f"native init failed: {err.value.decode()}")
@@ -184,9 +193,13 @@ class NativeEngine:
         return {
             "ring_passes": int(self._lib.hvd_ring_passes()),
             "ring_bytes_sent": int(self._lib.hvd_ring_bytes_sent()),
+            "ring_cross_bytes_sent": int(self._lib.hvd_ring_cross_bytes_sent()),
             "knob_version": int(self._lib.hvd_knob_version()),
             "fusion_threshold": int(self._lib.hvd_fusion_threshold()),
             "cycle_time_ms": float(self._lib.hvd_cycle_time_ms()),
+            "hier_allreduce": int(self._lib.hvd_hier_allreduce_on()),
+            "hier_allgather": int(self._lib.hvd_hier_allgather_on()),
+            "hier_capable": int(self._lib.hvd_hier_capable()),
         }
 
     def timeline_start(self, path: str, mark_cycles: bool = False) -> int:

@@ -150,7 +150,18 @@ class GaussianProcess {
 class BayesianOptimizer {
  public:
   explicit BayesianOptimizer(int dims, double xi = 0.01, uint64_t seed = 1234)
-      : dims_(dims), xi_(xi), rng_(seed) {}
+      : dims_(dims), xi_(xi), rng_(seed), fixed_((size_t)dims, false),
+        fixed_val_((size_t)dims, 0.0) {}
+
+  // Pin a coordinate: candidates always carry `v` there. Without this, a
+  // dead dimension (pinned knob, non-capable categorical) inflates EI far
+  // from the recorded samples along that axis and the search burns rounds
+  // re-measuring configs that collapse to already-tested real ones.
+  void fix_dim(int d, double v) {
+    fixed_[(size_t)d] = true;
+    fixed_val_[(size_t)d] = v;
+  }
+  void unfix_dim(int d) { fixed_[(size_t)d] = false; }
 
   void add_sample(const std::vector<double>& x, double y) {
     X_.push_back(x);
@@ -195,13 +206,16 @@ class BayesianOptimizer {
   std::vector<double> random_point() {
     std::uniform_real_distribution<double> u(0.0, 1.0);
     std::vector<double> x((size_t)dims_);
-    for (auto& v : x) v = u(rng_);
+    for (size_t i = 0; i < x.size(); i++)
+      x[i] = fixed_[i] ? fixed_val_[i] : u(rng_);
     return x;
   }
 
   int dims_;
   double xi_;
   std::mt19937_64 rng_;
+  std::vector<bool> fixed_;
+  std::vector<double> fixed_val_;
   std::vector<std::vector<double>> X_;
   std::vector<double> y_;
 };
@@ -221,16 +235,28 @@ class ParameterManager {
   struct Knobs {
     int64_t fusion_threshold;
     double cycle_time_ms;
+    // Categorical dimensions (reference parameter_manager.h:172 tunes
+    // hierarchical_allreduce / hierarchical_allgather as categorical
+    // parameters alongside the numeric chain).
+    bool hier_allreduce = false;
+    bool hier_allgather = false;
   };
 
   ParameterManager(int64_t init_threshold, double init_cycle_ms,
                    bool threshold_pinned, bool cycle_pinned)
-      : bo_(2),
-        current_{init_threshold, init_cycle_ms},
-        best_{init_threshold, init_cycle_ms},
+      : bo_(4),
+        current_{init_threshold, init_cycle_ms, false, false},
+        best_{init_threshold, init_cycle_ms, false, false},
         threshold_pinned_(threshold_pinned),
         cycle_pinned_(cycle_pinned) {
     active_ = !(threshold_pinned_ && cycle_pinned_);
+    // Dead dimensions stay clamped to the live config's coordinates so the
+    // acquisition never wastes rounds exploring axes from_unit ignores.
+    auto u = to_unit(current_);
+    if (threshold_pinned_) bo_.fix_dim(0, u[0]);
+    if (cycle_pinned_) bo_.fix_dim(1, u[1]);
+    bo_.fix_dim(2, u[2]);  // categorical dims open via enable_hierarchy_tuning
+    bo_.fix_dim(3, u[3]);
   }
 
   bool active() const { return active_; }
@@ -238,6 +264,31 @@ class ParameterManager {
   Knobs best() const { return best_; }
 
   void set_log_path(const std::string& p) { log_path_ = p; }
+
+  // Seed the categorical knobs from config (env) and record pins. Called
+  // before any tick updates.
+  void set_hierarchy(bool allreduce_on, bool allgather_on,
+                     bool allreduce_pinned, bool allgather_pinned) {
+    current_.hier_allreduce = best_.hier_allreduce = allreduce_on;
+    current_.hier_allgather = best_.hier_allgather = allgather_on;
+    hier_ar_pinned_ = allreduce_pinned;
+    hier_ag_pinned_ = allgather_pinned;
+    bo_.fix_dim(2, allreduce_on ? 1.0 : 0.0);
+    bo_.fix_dim(3, allgather_on ? 1.0 : 0.0);
+  }
+
+  // Open the categorical dimensions for exploration. Only meaningful on a
+  // genuinely multi-level topology — the coordinator calls this once after
+  // registration, when it has every rank's local/cross coordinates and has
+  // validated that the two-level rings exist (engine.cc analyze_hier).
+  void enable_hierarchy_tuning(bool allreduce_capable, bool allgather_capable) {
+    tune_hier_ar_ = allreduce_capable && !hier_ar_pinned_;
+    tune_hier_ag_ = allgather_capable && !hier_ag_pinned_;
+    if (tune_hier_ar_) bo_.unfix_dim(2);
+    if (tune_hier_ag_) bo_.unfix_dim(3);
+    if (tune_hier_ar_ || tune_hier_ag_) active_ = true;
+  }
+  bool tunes_hierarchy() const { return tune_hier_ar_ || tune_hier_ag_; }
 
   // Record one engine sample: bytes moved in `seconds`. Returns true when the
   // knobs changed (caller re-reads knobs()).
@@ -289,7 +340,8 @@ class ParameterManager {
     double t = std::log2((double)k.fusion_threshold / (1 << 20));
     double lo = std::log2(kMinThresholdMB), hi = std::log2(kMaxThresholdMB);
     return {(t - lo) / (hi - lo),
-            (k.cycle_time_ms - kMinCycleMs) / (kMaxCycleMs - kMinCycleMs)};
+            (k.cycle_time_ms - kMinCycleMs) / (kMaxCycleMs - kMinCycleMs),
+            k.hier_allreduce ? 1.0 : 0.0, k.hier_allgather ? 1.0 : 0.0};
   }
 
   Knobs from_unit(const std::vector<double>& x) const {
@@ -302,6 +354,10 @@ class ParameterManager {
     if (!cycle_pinned_) {
       k.cycle_time_ms = kMinCycleMs + x[1] * (kMaxCycleMs - kMinCycleMs);
     }
+    // Threshold the continuous BO coordinate into the categorical branch
+    // (candidate search covers [0,1], so both branches get explored).
+    if (tune_hier_ar_) k.hier_allreduce = x[2] >= 0.5;
+    if (tune_hier_ag_) k.hier_allgather = x[3] >= 0.5;
     return k;
   }
 
@@ -310,14 +366,18 @@ class ParameterManager {
     std::FILE* f = std::fopen(log_path_.c_str(), "a");
     if (!f) return;
     // CSV like the reference autotuner log (parameter_manager.cc:93-99)
-    std::fprintf(f, "%lld,%.3f,%.6f\n", (long long)current_.fusion_threshold,
-                 current_.cycle_time_ms, score);
+    std::fprintf(f, "%lld,%.3f,%d,%d,%.6f\n",
+                 (long long)current_.fusion_threshold, current_.cycle_time_ms,
+                 current_.hier_allreduce ? 1 : 0, current_.hier_allgather ? 1 : 0,
+                 score);
     std::fclose(f);
   }
 
   BayesianOptimizer bo_;
   Knobs current_, best_;
   bool threshold_pinned_, cycle_pinned_;
+  bool hier_ar_pinned_ = false, hier_ag_pinned_ = false;
+  bool tune_hier_ar_ = false, tune_hier_ag_ = false;
   bool active_ = true;
   int updates_ = 0;
   int warmups_left_ = 3;  // reference: 3 warmup samples discarded

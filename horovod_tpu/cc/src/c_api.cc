@@ -37,6 +37,8 @@ int hvd_init(int rank, int size, int local_rank, int local_size, int cross_rank,
              const char* timeline_path, int timeline_mark_cycles,
              int stall_check_disable, double stall_warning_s, int autotune,
              const char* autotune_log, int threshold_pinned, int cycle_pinned,
+             int hierarchical_allreduce, int hierarchical_allgather,
+             int hier_allreduce_pinned, int hier_allgather_pinned,
              char* err, int errcap) {
   std::lock_guard<std::mutex> g(g_mu);
   if (g_engine) return 0;  // idempotent (reference InitializeHorovodOnce)
@@ -53,6 +55,10 @@ int hvd_init(int rank, int size, int local_rank, int local_size, int cross_rank,
     c.autotune_log = autotune_log ? autotune_log : "";
     c.threshold_pinned = threshold_pinned != 0;
     c.cycle_pinned = cycle_pinned != 0;
+    c.hierarchical_allreduce = hierarchical_allreduce != 0;
+    c.hierarchical_allgather = hierarchical_allgather != 0;
+    c.hier_allreduce_pinned = hier_allreduce_pinned != 0;
+    c.hier_allgather_pinned = hier_allgather_pinned != 0;
     c.coord_host = coord_host ? coord_host : "";
     c.coord_port = coord_port;
     g_engine = std::make_shared<Engine>(t, c);
@@ -167,6 +173,27 @@ long long hvd_ring_bytes_sent() {
   auto eng = engine();
   return eng ? (long long)eng->stats().bytes_sent.load() : -1;
 }
+// Bytes whose next hop crosses a host boundary (hierarchical-collective
+// tests and the scaling harness read this to prove the two-level ladder
+// shrinks inter-host traffic).
+long long hvd_ring_cross_bytes_sent() {
+  auto eng = engine();
+  return eng ? (long long)eng->cross_stats().bytes_sent.load() : -1;
+}
+// Live hierarchical state: 1 = the two-level algorithm runs for the op,
+// 0 = flat ring, -1 = no engine.
+int hvd_hier_allreduce_on() {
+  auto eng = engine();
+  return eng ? (eng->hierarchical_allreduce_on() ? 1 : 0) : -1;
+}
+int hvd_hier_allgather_on() {
+  auto eng = engine();
+  return eng ? (eng->hierarchical_allgather_on() ? 1 : 0) : -1;
+}
+int hvd_hier_capable() {
+  auto eng = engine();
+  return eng ? (eng->hierarchical_capable() ? 1 : 0) : -1;
+}
 
 // Scoped timeline attach (hvd.timeline.trace): returns 1 when this call
 // opened the timeline (caller owns the stop), 0 when one was already
@@ -200,6 +227,23 @@ double hvd_pm_cycle_time_ms(void* pm) {
 }
 void hvd_pm_set_log(void* pm, const char* path) {
   ((ParameterManager*)pm)->set_log_path(path ? path : "");
+}
+void hvd_pm_set_hierarchy(void* pm, int allreduce_on, int allgather_on,
+                          int allreduce_pinned, int allgather_pinned) {
+  ((ParameterManager*)pm)->set_hierarchy(allreduce_on != 0, allgather_on != 0,
+                                         allreduce_pinned != 0,
+                                         allgather_pinned != 0);
+}
+void hvd_pm_enable_hierarchy(void* pm, int allreduce_capable,
+                             int allgather_capable) {
+  ((ParameterManager*)pm)->enable_hierarchy_tuning(allreduce_capable != 0,
+                                                   allgather_capable != 0);
+}
+int hvd_pm_hier_allreduce(void* pm) {
+  return ((ParameterManager*)pm)->knobs().hier_allreduce ? 1 : 0;
+}
+int hvd_pm_hier_allgather(void* pm) {
+  return ((ParameterManager*)pm)->knobs().hier_allgather ? 1 : 0;
 }
 
 // One-shot GP fit/predict (n samples of dimension dims, row-major X).

@@ -107,17 +107,82 @@ Engine::Engine(const Topology& topo, const EngineConfig& cfg)
           "distributes it) or export the same secret on every rank");
     }
     ring_.open_listener();
-    std::vector<std::pair<std::string, int>> peers;
+    // Offer the two-level rings whenever this rank's own coordinates say the
+    // world spans multiple hosts with multiple ranks per host; whether they
+    // are actually established depends on the full registered map below.
+    bool offer_sub = topo_.local_size > 1 && topo_.cross_size > 1;
+    if (offer_sub) {
+      local_ring_.open_listener();
+      cross_ring_.open_listener();
+    }
+    PeerInfo me;
+    me.port = ring_.port();
+    me.local_port = offer_sub ? local_ring_.port() : 0;
+    me.cross_port = offer_sub ? cross_ring_.port() : 0;
+    me.local_rank = topo_.local_rank;
+    me.local_size = topo_.local_size;
+    me.cross_rank = topo_.cross_rank;
+    me.cross_size = topo_.cross_size;
+    std::vector<PeerInfo> peers;
     if (topo_.rank == 0) {
       coord_ = std::make_unique<Coordinator>(topo_.size, cfg_.coord_host,
                                              cfg_.coord_port, &timeline_, cfg_);
-      peers = coord_->hello(0, cfg_.coord_host, ring_.port());
+      me.host = cfg_.coord_host;
+      peers = coord_->hello(0, me);
     } else {
       client_ = std::make_unique<Client>(cfg_.coord_host, cfg_.coord_port,
                                          topo_.rank, 60.0);
-      peers = client_->hello(client_->local_host(), ring_.port());
+      me.host = client_->local_host();
+      peers = client_->hello(me);
     }
-    ring_.establish(topo_.rank, topo_.size, peers, secret);
+    std::vector<std::pair<std::string, int>> flat;
+    flat.reserve(peers.size());
+    for (auto& p : peers) flat.emplace_back(p.host, p.port);
+    ring_.establish(topo_.rank, topo_.size, flat, secret);
+    hier_ = analyze_hier(peers, topo_.rank);
+    if (hier_.capable) {
+      // Intra-host ring: position = local_rank among my host's ranks; the
+      // cross-host ring: position = cross_rank among the ranks sharing my
+      // local_rank. Distinct auth purposes keep a misdirected connection
+      // from one ring passing the other's accept check.
+      std::vector<std::pair<std::string, int>> lp, xp;
+      for (int r : hier_.local_group)
+        lp.emplace_back(peers[(size_t)r].host, peers[(size_t)r].local_port);
+      for (int r : hier_.cross_group)
+        xp.emplace_back(peers[(size_t)r].host, peers[(size_t)r].cross_port);
+      local_ring_.establish(topo_.local_rank, topo_.local_size, lp, secret,
+                            60.0, "hvd-ring-local");
+      cross_ring_.establish(topo_.cross_rank, topo_.cross_size, xp, secret,
+                            60.0, "hvd-ring-cross");
+      // Every cross-ring send crosses hosts by construction.
+      cross_ring_.set_cross_stats(&cross_stats_);
+    } else if (offer_sub) {
+      local_ring_.close();
+      cross_ring_.close();
+    }
+    // Inter-host byte accounting on the FLAT ring is independent of
+    // hierarchical capability: on any topology (including heterogeneous
+    // ones that fail analyze_hier) the outgoing link crosses hosts iff the
+    // next rank reported a different cross_rank — the scaling harness needs
+    // the flat baseline's cross bytes to be real there too.
+    int next = (topo_.rank + 1) % topo_.size;
+    if (peers[(size_t)next].cross_rank != topo_.cross_rank)
+      ring_.set_cross_stats(&cross_stats_);
+    hier_allreduce_ = cfg_.hierarchical_allreduce && hier_.capable;
+    hier_allgather_ = cfg_.hierarchical_allgather && hier_.capable &&
+                      hier_.blocked;
+    if (cfg_.hierarchical_allreduce && !hier_.capable) {
+      HVD_WARN(
+          "HOROVOD_HIERARCHICAL_ALLREDUCE=1 but the topology is not a "
+          "homogeneous multi-host grid (need local_size>1, cross_size>1, "
+          "equal local_size on every host); using the flat ring");
+    }
+    if (cfg_.hierarchical_allgather && !(hier_.capable && hier_.blocked)) {
+      HVD_WARN(
+          "HOROVOD_HIERARCHICAL_ALLGATHER=1 but the topology is not a "
+          "homogeneous blocked multi-host grid (rank == "
+          "cross_rank*local_size+local_rank); using the flat ring");
+    }
   } else if (cfg_.autotune) {
     // Single-process world: tune locally (multi-process tuning lives in the
     // coordinator so every rank flips knobs on the same tick).
@@ -219,7 +284,50 @@ void Engine::shutdown() {
   }
   client_.reset();
   ring_.close();
+  local_ring_.close();
+  cross_ring_.close();
   timeline_.shutdown();
+}
+
+// Validate the registered topology for two-level collectives and compute
+// this rank's intra-host and cross-host ring memberships. Deterministic over
+// the identical broadcast map, so every rank reaches the same `capable`
+// verdict (an asymmetric verdict would deadlock ring establishment).
+HierPlan analyze_hier(const std::vector<PeerInfo>& peers, int my_rank) {
+  HierPlan plan;
+  if (peers.empty()) return plan;
+  const PeerInfo& me = peers[(size_t)my_rank];
+  int L = me.local_size, C = me.cross_size;
+  if (L <= 1 || C <= 1) return plan;
+  if ((size_t)(L * C) != peers.size()) return plan;
+  // Homogeneity + exactly-once grid coverage.
+  std::vector<int> cell((size_t)L * (size_t)C, -1);
+  for (size_t r = 0; r < peers.size(); r++) {
+    const PeerInfo& p = peers[r];
+    if (p.local_size != L || p.cross_size != C) return plan;
+    if (p.local_rank < 0 || p.local_rank >= L || p.cross_rank < 0 ||
+        p.cross_rank >= C)
+      return plan;
+    if (p.local_port == 0 || p.cross_port == 0) return plan;
+    int& slot = cell[(size_t)p.cross_rank * (size_t)L + (size_t)p.local_rank];
+    if (slot != -1) return plan;
+    slot = (int)r;
+  }
+  plan.capable = true;
+  plan.blocked = true;
+  for (size_t r = 0; r < peers.size(); r++) {
+    if ((int)r != peers[r].cross_rank * L + peers[r].local_rank) {
+      plan.blocked = false;
+      break;
+    }
+  }
+  plan.local_group.resize((size_t)L);
+  for (int l = 0; l < L; l++)
+    plan.local_group[(size_t)l] = cell[(size_t)me.cross_rank * (size_t)L + (size_t)l];
+  plan.cross_group.resize((size_t)C);
+  for (int c = 0; c < C; c++)
+    plan.cross_group[(size_t)c] = cell[(size_t)c * (size_t)L + (size_t)me.local_rank];
+  return plan;
 }
 
 void Engine::loop() {
@@ -287,13 +395,23 @@ bool Engine::tick_multiprocess(bool shutting) {
     fail_everything(std::string("control plane failed: ") + ex.what());
     return false;
   }
+  // The categorical knobs are applied from EVERY response, not just on a
+  // version bump: the algorithm choice must be identical on all ranks for a
+  // given collective (a flat rank facing a hierarchical peer deadlocks the
+  // data plane), so the coordinator's value is authoritative even when one
+  // rank's env disagreed at init. Capability is identical everywhere
+  // (analyze_hier over the same broadcast map), so the && is safe.
+  hier_allreduce_ = out.hier_allreduce != 0 && hier_.capable;
+  hier_allgather_ = out.hier_allgather != 0 && hier_.capable && hier_.blocked;
   if (out.knob_version != applied_knob_version_.load()) {
     applied_knob_version_ = out.knob_version;
     fusion_threshold_ = out.fusion_threshold;
     cycle_time_ms_ = out.cycle_time_ms;
     HVD_DEBUG("autotune sync: fusion_threshold=" +
               std::to_string(out.fusion_threshold) +
-              " cycle_time_ms=" + std::to_string(out.cycle_time_ms));
+              " cycle_time_ms=" + std::to_string(out.cycle_time_ms) +
+              " hier_allreduce=" + std::to_string((int)out.hier_allreduce) +
+              " hier_allgather=" + std::to_string((int)out.hier_allgather));
   }
   // Stall warnings: the coordinator process (us, when coord_ is set) already
   // logged them at creation; only worker ranks log on receipt.
@@ -411,6 +529,44 @@ void Engine::execute_entry(const ResponseEntry& re) {
   }
 }
 
+// One allreduce pass over a contiguous buffer. Flat: ring reduce-scatter +
+// allgather over all N ranks. Hierarchical (two-level ladder, the TCP
+// re-design of the reference's NCCL-ReduceScatter → cross-node-MPI-allreduce
+// → NCCL-Allgather ladder, operations.cc:1284-1446):
+//   1. intra-host ring reduce-scatter — local_rank l ends holding chunk l
+//      reduced across this host (loopback traffic only);
+//   2. cross-host ring allreduce of chunk l among the ranks sharing
+//      local_rank l — local_size rings run in parallel, each carrying
+//      1/local_size of the payload over the inter-host links;
+//   3. intra-host ring allgather redistributes the fully reduced chunks.
+// Inter-host bytes per rank drop from 2·B·(N-1)/N (the flat boundary rank)
+// to 2·(B/L)·(C-1)/C — the 1/local_size reduction the per-rank cross-byte
+// counters measure.
+void Engine::allreduce_buffer(uint8_t* buf, size_t count, size_t esize,
+                              DataType d, bool average) {
+  if (!(hier_allreduce_.load() && hier_.capable)) {
+    ring_allreduce(ring_, topo_.rank, topo_.size, buf, count, esize, d,
+                   average, &stats_);
+    return;
+  }
+  int L = topo_.local_size, C = topo_.cross_size;
+  auto counts = split_counts(count, L);
+  auto offs = offsets_of(counts);
+  stats_.passes++;
+  ring_reduce_scatter(local_ring_, topo_.local_rank, L, buf, counts, offs,
+                      esize, d, &stats_);
+  uint8_t* mine = buf + offs[(size_t)topo_.local_rank] * esize;
+  size_t mine_n = counts[(size_t)topo_.local_rank];
+  // average=false here: the division is by the full world size, applied once
+  // below (the cross ring's own world is only cross_size).
+  ring_allreduce(cross_ring_, topo_.cross_rank, C, mine, mine_n, esize, d,
+                 false, &stats_);
+  stats_.passes--;  // the cross pass is a stage of this allreduce, not its own
+  if (average) scale_chunk(d, mine, mine_n, topo_.size);
+  ring_allgather(local_ring_, topo_.local_rank, L, buf, counts, offs, esize,
+                 &stats_);
+}
+
 // One fused bucket: memcpy every tensor into the fusion buffer (at native
 // width — f16/bf16 reduce 2 bytes/element, ring.h), one ring allreduce over
 // the whole buffer, memcpy back out. This is the executed analog of the
@@ -420,6 +576,8 @@ void Engine::execute_allreduce(const ResponseEntry& re,
                                std::vector<Entry>& ents) {
   DataType d = re.dtype;
   size_t wes = dtype_size(d);
+  const char* act =
+      hier_allreduce_.load() ? "HIER_ALLREDUCE" : "RING_ALLREDUCE";
   // Fast path: a single tensor ring-reduces in place over its own
   // contribution buffer and moves it into the response — no fusion-buffer
   // round trip (2x full-size memcpy) on the big-gradient hot path.
@@ -427,9 +585,8 @@ void Engine::execute_allreduce(const ResponseEntry& re,
     Entry& e = ents[0];
     size_t n = e.req.elements();
     if (timeline_.healthy())
-      timeline_.activity_start(e.req.name, "RING_ALLREDUCE");
-    ring_allreduce(ring_, topo_.rank, topo_.size, e.data.data(), n, wes, d,
-                   re.average != 0, &stats_);
+      timeline_.activity_start(e.req.name, act);
+    allreduce_buffer(e.data.data(), n, wes, d, re.average != 0);
     if (timeline_.healthy()) timeline_.activity_end(e.req.name);
     Response res;
     res.kind = Response::OK;
@@ -453,10 +610,9 @@ void Engine::execute_allreduce(const ResponseEntry& re,
     off += n;
   }
   if (timeline_.healthy()) {
-    for (auto& e : ents) timeline_.activity_start(e.req.name, "RING_ALLREDUCE");
+    for (auto& e : ents) timeline_.activity_start(e.req.name, act);
   }
-  ring_allreduce(ring_, topo_.rank, topo_.size, buf, total, wes, d,
-                 re.average != 0, &stats_);
+  allreduce_buffer(buf, total, wes, d, re.average != 0);
   if (timeline_.healthy()) {
     for (auto& e : ents) timeline_.activity_end(e.req.name);
   }
@@ -505,8 +661,52 @@ void Engine::execute_allgather(const ResponseEntry& re, Entry& ent) {
   std::memcpy(res.data.data() + offs[(size_t)topo_.rank] * esize,
               ent.data.data(), ent.data.size());
   stats_.passes++;
-  ring_allgather(ring_, topo_.rank, topo_.size, res.data.data(), counts, offs,
-                 esize, &stats_);
+  if (hier_allgather_.load() && hier_.capable && hier_.blocked) {
+    // Two-stage allgather (reference hierarchical allgather: intra-node
+    // shared-memory window + cross-node Allgatherv among node roots +
+    // local copy-out, operations.cc:929-1034; loopback plays the role of
+    // the shared window here):
+    //   1. intra-host ring allgather — every rank ends holding its host's
+    //      whole contiguous block (blocked layout guarantees contiguity);
+    //   2. the host representative (local_rank 0) ring-allgathers the host
+    //      blocks across hosts — the only stage that crosses host links,
+    //      C-1 steps instead of N-1;
+    //   3. the representative pipeline-broadcasts the foreign blocks (the
+    //      regions before and after the own-host block) over the local ring.
+    int L = topo_.local_size, C = topo_.cross_size;
+    uint8_t* base = res.data.data();
+    std::vector<size_t> lcounts((size_t)L), loffs((size_t)L);
+    for (int l = 0; l < L; l++) {
+      int r = topo_.cross_rank * L + l;
+      lcounts[(size_t)l] = counts[(size_t)r];
+      loffs[(size_t)l] = offs[(size_t)r];
+    }
+    ring_allgather(local_ring_, topo_.local_rank, L, base, lcounts, loffs,
+                   esize, &stats_);
+    std::vector<size_t> bcounts((size_t)C), boffs((size_t)C);
+    for (int c = 0; c < C; c++) {
+      boffs[(size_t)c] = offs[(size_t)c * (size_t)L];
+      bcounts[(size_t)c] =
+          offs[(size_t)(c + 1) * (size_t)L] - boffs[(size_t)c];
+    }
+    if (topo_.local_rank == 0) {
+      ring_allgather(cross_ring_, topo_.cross_rank, C, base, bcounts, boffs,
+                     esize, &stats_);
+    }
+    size_t pre = boffs[(size_t)topo_.cross_rank] * esize;
+    size_t own_end =
+        (boffs[(size_t)topo_.cross_rank] + bcounts[(size_t)topo_.cross_rank]) *
+        esize;
+    size_t post = res.data.size() - own_end;
+    ring_broadcast(local_ring_, topo_.local_rank, L, 0, base, pre, &stats_);
+    stats_.passes -= pre > 0 ? 1 : 0;  // stages of this allgather, not passes
+    ring_broadcast(local_ring_, topo_.local_rank, L, 0, base + own_end, post,
+                   &stats_);
+    stats_.passes -= post > 0 ? 1 : 0;
+  } else {
+    ring_allgather(ring_, topo_.rank, topo_.size, res.data.data(), counts,
+                   offs, esize, &stats_);
+  }
   finish(ent, Status::OK_(), std::move(res));
 }
 
@@ -580,15 +780,21 @@ Coordinator::Coordinator(int world, const std::string& host, int port,
       secret_(job_secret()),
       peers_((size_t)world),
       knob_threshold_((int64_t)cfg.fusion_threshold),
-      knob_cycle_ms_(cfg.cycle_time_ms) {
+      knob_cycle_ms_(cfg.cycle_time_ms),
+      knob_hier_allreduce_(cfg.hierarchical_allreduce),
+      knob_hier_allgather_(cfg.hierarchical_allgather) {
   if (cfg_.autotune) {
     pm_ = std::make_unique<ParameterManager>(knob_threshold_, knob_cycle_ms_,
                                              cfg_.threshold_pinned,
                                              cfg_.cycle_pinned);
+    pm_->set_hierarchy(cfg_.hierarchical_allreduce, cfg_.hierarchical_allgather,
+                       cfg_.hier_allreduce_pinned, cfg_.hier_allgather_pinned);
     if (!cfg_.autotune_log.empty()) pm_->set_log_path(cfg_.autotune_log);
   }
   current_.fusion_threshold = knob_threshold_;
   current_.cycle_time_ms = knob_cycle_ms_;
+  current_.hier_allreduce = knob_hier_allreduce_ ? 1 : 0;
+  current_.hier_allgather = knob_hier_allgather_ ? 1 : 0;
   listen_fd_ = listen_on(host, port, world + 4);
   last_barrier_ = std::chrono::steady_clock::now();
   accept_thread_ = std::thread([this] { accept_loop(); });
@@ -667,16 +873,29 @@ void Coordinator::serve(int fd) {
       Reader r(frame.data(), frame.size());
       if (r.u8() != 0) throw std::runtime_error("expected hello");
       rank = r.i32();
-      std::string host = r.str();
-      int port = r.i32();
+      PeerInfo info;
+      info.host = r.str();
+      info.port = r.i32();
+      info.local_port = r.i32();
+      info.cross_port = r.i32();
+      info.local_rank = r.i32();
+      info.local_size = r.i32();
+      info.cross_rank = r.i32();
+      info.cross_size = r.i32();
       if (rank <= 0 || rank >= world_)
         throw std::runtime_error("hello from invalid rank");
-      auto peers = hello(rank, host, port);
+      auto peers = hello(rank, info);
       Writer w;
       w.u32((uint32_t)peers.size());
       for (auto& p : peers) {
-        w.str(p.first);
-        w.i32(p.second);
+        w.str(p.host);
+        w.i32(p.port);
+        w.i32(p.local_port);
+        w.i32(p.cross_port);
+        w.i32(p.local_rank);
+        w.i32(p.local_size);
+        w.i32(p.cross_rank);
+        w.i32(p.cross_size);
       }
       send_frame(fd, w.buf);
     }
@@ -724,11 +943,34 @@ void Coordinator::serve(int fd) {
   ::close(fd);
 }
 
-std::vector<std::pair<std::string, int>> Coordinator::hello(
-    int rank, const std::string& host, int port) {
+std::vector<PeerInfo> Coordinator::hello(int rank, const PeerInfo& info) {
   std::unique_lock<std::mutex> lk(mu_);
-  if (peers_[(size_t)rank].second == 0) hello_count_++;
-  peers_[(size_t)rank] = {host, port};
+  if (peers_[(size_t)rank].port == 0) hello_count_++;
+  peers_[(size_t)rank] = info;
+  if (hello_count_ >= world_) {
+    // Registration complete — the finishing rank opens the autotuner's
+    // categorical dimensions iff the registered topology supports the
+    // two-level rings (same verdict every engine reaches; ticks cannot
+    // arrive before every hello has returned, so this runs before any
+    // build_response_list).
+    HierPlan plan = analyze_hier(peers_, 0);
+    if (!plan.capable) {
+      knob_hier_allreduce_ = false;
+      knob_hier_allgather_ = false;
+      current_.hier_allreduce = 0;
+      current_.hier_allgather = 0;
+      if (pm_) pm_->set_hierarchy(false, false, true, true);  // pin off
+    } else if (!plan.blocked) {
+      knob_hier_allgather_ = false;
+      current_.hier_allgather = 0;
+      if (pm_)
+        pm_->set_hierarchy(cfg_.hierarchical_allreduce, false,
+                           cfg_.hier_allreduce_pinned, true);
+    }
+    if (pm_) {
+      pm_->enable_hierarchy_tuning(plan.capable, plan.capable && plan.blocked);
+    }
+  }
   cv_.notify_all();
   cv_.wait(lk, [&] { return hello_count_ >= world_ || stop_.load(); });
   if (hello_count_ < world_)
@@ -949,6 +1191,8 @@ void Coordinator::build_response_list() {
       auto k = pm_->knobs();
       knob_threshold_ = k.fusion_threshold;
       knob_cycle_ms_ = k.cycle_time_ms;
+      knob_hier_allreduce_ = k.hier_allreduce;
+      knob_hier_allgather_ = k.hier_allgather;
       knob_version_++;
     }
   }
@@ -956,6 +1200,8 @@ void Coordinator::build_response_list() {
   out.knob_version = knob_version_;
   out.fusion_threshold = knob_threshold_;
   out.cycle_time_ms = knob_cycle_ms_;
+  out.hier_allreduce = knob_hier_allreduce_ ? 1 : 0;
+  out.hier_allgather = knob_hier_allgather_ ? 1 : 0;
 
   current_ = std::move(out);
   gen_++;
@@ -1051,24 +1297,33 @@ Client::~Client() {
 
 std::string Client::local_host() const { return local_addr(fd_); }
 
-std::vector<std::pair<std::string, int>> Client::hello(
-    const std::string& data_host, int data_port) {
+std::vector<PeerInfo> Client::hello(const PeerInfo& info) {
   std::lock_guard<std::mutex> g(mu_);
   Writer w;
   w.u8(0);
   w.i32(rank_);
-  w.str(data_host);
-  w.i32(data_port);
+  w.str(info.host);
+  w.i32(info.port);
+  w.i32(info.local_port);
+  w.i32(info.cross_port);
+  w.i32(info.local_rank);
+  w.i32(info.local_size);
+  w.i32(info.cross_rank);
+  w.i32(info.cross_size);
   send_frame(fd_, w.buf);
   auto frame = recv_frame(fd_);
   Reader r(frame.data(), frame.size());
   uint32_t n = r.u32();
-  std::vector<std::pair<std::string, int>> peers;
-  peers.reserve(n);
+  std::vector<PeerInfo> peers((size_t)n);
   for (uint32_t i = 0; i < n; i++) {
-    std::string host = r.str();
-    int port = r.i32();
-    peers.emplace_back(std::move(host), port);
+    peers[i].host = r.str();
+    peers[i].port = r.i32();
+    peers[i].local_port = r.i32();
+    peers[i].cross_port = r.i32();
+    peers[i].local_rank = r.i32();
+    peers[i].local_size = r.i32();
+    peers[i].cross_rank = r.i32();
+    peers[i].cross_size = r.i32();
   }
   return peers;
 }

@@ -55,6 +55,34 @@ struct Topology {
       cross_size = 1;
 };
 
+// One rank's registration record: ring endpoints plus its host coordinates.
+// The coordinator gathers these in hello and broadcasts the full map, which
+// is what lets every rank build the two-level (intra-host / cross-host)
+// rings without any side channel — the reference gets the same information
+// from its local_comm / cross_comm MPI splits (operations.cc:1684-1721).
+struct PeerInfo {
+  std::string host;
+  int port = 0;        // flat-ring listener (always present)
+  int local_port = 0;  // intra-host ring listener (0 = not offered)
+  int cross_port = 0;  // cross-host ring listener (0 = not offered)
+  int local_rank = 0, local_size = 1, cross_rank = 0, cross_size = 1;
+};
+
+// The two-level ring plan derived from the registered PeerInfo map.
+// `capable` requires a homogeneous grid: every rank offers sub-ring ports,
+// local_size/cross_size agree everywhere, and each (cross_rank, local_rank)
+// cell is occupied exactly once (the reference gates its hierarchical ops on
+// the same homogeneity check, operations.cc:1712-1721). `blocked` addition-
+// ally requires global rank == cross_rank*local_size + local_rank, which the
+// two-stage allgather needs so host blocks are contiguous in rank order.
+struct HierPlan {
+  bool capable = false;
+  bool blocked = false;
+  std::vector<int> local_group;  // global ranks on my host, by local_rank
+  std::vector<int> cross_group;  // global ranks sharing my local_rank, by cross_rank
+};
+HierPlan analyze_hier(const std::vector<PeerInfo>& peers, int my_rank);
+
 struct EngineConfig {
   double cycle_time_ms = 5.0;            // HOROVOD_CYCLE_TIME
   size_t fusion_threshold = 64u << 20;   // HOROVOD_FUSION_THRESHOLD
@@ -66,6 +94,10 @@ struct EngineConfig {
   std::string autotune_log;              // HOROVOD_AUTOTUNE_LOG
   bool threshold_pinned = false;         // env pinned HOROVOD_FUSION_THRESHOLD
   bool cycle_pinned = false;             // env pinned HOROVOD_CYCLE_TIME
+  bool hierarchical_allreduce = false;   // HOROVOD_HIERARCHICAL_ALLREDUCE
+  bool hierarchical_allgather = false;   // HOROVOD_HIERARCHICAL_ALLGATHER
+  bool hier_allreduce_pinned = false;    // env pinned the allreduce flag
+  bool hier_allgather_pinned = false;    // env pinned the allgather flag
   std::string coord_host;
   int coord_port = 0;
 };
@@ -117,6 +149,10 @@ class Engine {
   int64_t fusion_threshold() const { return fusion_threshold_; }
   uint32_t knob_version() const { return applied_knob_version_; }
   const RingStats& stats() const { return stats_; }
+  const RingStats& cross_stats() const { return cross_stats_; }
+  bool hierarchical_allreduce_on() const { return hier_allreduce_.load(); }
+  bool hierarchical_allgather_on() const { return hier_allgather_.load(); }
+  bool hierarchical_capable() const { return hier_.capable; }
 
   // Scoped timeline attach for hvd.timeline.trace(): start a timeline at
   // runtime when none was configured via HOROVOD_TIMELINE. Returns 1 if
@@ -145,6 +181,10 @@ class Engine {
   void execute_list(const ResponseList& list);
   void execute_entry(const ResponseEntry& re);
   void execute_allreduce(const ResponseEntry& re, std::vector<Entry>& ents);
+  // One allreduce pass over `count` elements in `buf`: flat ring, or the
+  // two-level ladder when the hierarchical knob is on and topology allows.
+  void allreduce_buffer(uint8_t* buf, size_t count, size_t esize, DataType d,
+                        bool average);
   void execute_allgather(const ResponseEntry& re, Entry& ent);
   void execute_broadcast(const ResponseEntry& re, Entry& ent);
   void execute_reducescatter(const ResponseEntry& re, Entry& ent);
@@ -175,7 +215,17 @@ class Engine {
   std::unique_ptr<Coordinator> coord_;
   std::unique_ptr<Client> client_;
   RingLinks ring_;
+  // Two-level data plane (hierarchical collectives): a ring among the ranks
+  // of this host, and a ring among the ranks sharing this local_rank across
+  // hosts. Established only when the registered topology is a homogeneous
+  // multi-host grid (analyze_hier).
+  RingLinks local_ring_;
+  RingLinks cross_ring_;
+  HierPlan hier_;
+  std::atomic<bool> hier_allreduce_{false};
+  std::atomic<bool> hier_allgather_{false};
   RingStats stats_;
+  RingStats cross_stats_;  // bytes whose next hop crosses a host boundary
   FusionBuffer fusion_buf_;
   std::unique_ptr<ParameterManager> pm_;  // single-process tuning only
   std::atomic<double> cycle_time_ms_{5.0};
@@ -199,11 +249,9 @@ class Coordinator {
   ~Coordinator();
   void stop();
 
-  // Registration: blocks until every rank reported its ring endpoint, then
-  // returns the full peer map (rank-indexed host:port).
-  std::vector<std::pair<std::string, int>> hello(int rank,
-                                                 const std::string& host,
-                                                 int port);
+  // Registration: blocks until every rank reported its ring endpoints and
+  // host coordinates, then returns the full rank-indexed peer map.
+  std::vector<PeerInfo> hello(int rank, const PeerInfo& info);
   // One tick: contribute this rank's request list, block on the generation
   // barrier, return the broadcast ResponseList. In-process for rank 0,
   // called from serve threads for the rest.
@@ -247,7 +295,7 @@ class Coordinator {
   std::mutex mu_;
   std::condition_variable cv_;
   // hello stage
-  std::vector<std::pair<std::string, int>> peers_;
+  std::vector<PeerInfo> peers_;
   int hello_count_ = 0;
   // tick stage
   uint64_t gen_ = 0;
@@ -272,6 +320,8 @@ class Coordinator {
   uint32_t knob_version_ = 0;
   int64_t knob_threshold_;
   double knob_cycle_ms_;
+  bool knob_hier_allreduce_ = false;
+  bool knob_hier_allgather_ = false;
   std::chrono::steady_clock::time_point last_barrier_;
 };
 
@@ -280,8 +330,7 @@ class Client {
   Client(const std::string& host, int port, int rank, double timeout_s);
   ~Client();
   // Registration round-trip; returns the rank-indexed peer map.
-  std::vector<std::pair<std::string, int>> hello(const std::string& data_host,
-                                                 int data_port);
+  std::vector<PeerInfo> hello(const PeerInfo& info);
   ResponseList tick(const TickRequest& req);
   // Local address of the control connection — the interface that routes to
   // the coordinator, advertised for this rank's ring listener.

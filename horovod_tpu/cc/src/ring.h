@@ -43,6 +43,14 @@ struct RingStats {
   std::atomic<uint64_t> bytes_sent{0};  // bytes pushed to the next neighbour
 };
 
+// Separate accounting of bytes whose next-hop crosses a host boundary.
+// The hierarchical-collective tests (and the scaling harness) need to prove
+// the two-level ring actually shrinks inter-host traffic, so every link
+// knows at establish time whether its outgoing neighbour lives on another
+// host and bills sends to this secondary counter too (reference analog: the
+// NCCL-intra/MPI-inter split of hierarchical allreduce makes the same
+// distinction structurally, operations.cc:1284-1446).
+
 // numpy array_split semantics: the first n % parts chunks get one extra.
 inline std::vector<size_t> split_counts(size_t n, int parts) {
   std::vector<size_t> out((size_t)parts, n / (size_t)parts);
@@ -74,9 +82,13 @@ class RingLinks {
 
   // Connect to next and accept prev (world > 1). Peer addresses come from
   // the coordinator's hello response. Throws on timeout or auth failure.
+  // `purpose` namespaces the HMAC handshake per ring (flat/local/cross), so
+  // a connection that reaches the wrong ring's listener fails auth instead
+  // of wiring in a neighbour with mismatched transfer sizes.
   void establish(int rank, int world,
                  const std::vector<std::pair<std::string, int>>& peers,
-                 const std::string& secret, double timeout_s = 60.0) {
+                 const std::string& secret, double timeout_s = 60.0,
+                 const std::string& purpose = "hvd-ring") {
     if (world <= 1) return;
     int next = (rank + 1) % world;
     int prev = (rank - 1 + world) % world;
@@ -85,7 +97,7 @@ class RingLinks {
       try {
         int fd = connect_to(peers[(size_t)next].first, peers[(size_t)next].second,
                             timeout_s);
-        auth_connect(fd, secret, "hvd-ring");
+        auth_connect(fd, secret, purpose);
         int32_t my_rank = rank;
         send_all(fd, &my_rank, 4);
         next_fd_ = fd;
@@ -112,7 +124,7 @@ class RingLinks {
         timeval tv{10, 0};
         ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
         ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-        if (!auth_accept(fd, secret, "hvd-ring")) {
+        if (!auth_accept(fd, secret, purpose)) {
           ::close(fd);
           continue;
         }
@@ -157,14 +169,21 @@ class RingLinks {
 
   bool active() const { return next_fd_ >= 0 && prev_fd_ >= 0; }
 
+  // Bill sends on this link to `s` as inter-host traffic (set when the
+  // outgoing neighbour has a different cross_rank, or for every link of the
+  // cross-host ring).
+  void set_cross_stats(RingStats* s) { cross_stats_ = s; }
+
   void transfer(const uint8_t* out, size_t n, uint8_t* in, size_t m,
                 RingStats* stats) {
     duplex(next_fd_, out, n, prev_fd_, in, m);
     if (stats) stats->bytes_sent += n;
+    if (cross_stats_) cross_stats_->bytes_sent += n;
   }
   void send(const uint8_t* p, size_t n, RingStats* stats) {
     send_all(next_fd_, p, n);
     if (stats) stats->bytes_sent += n;
+    if (cross_stats_) cross_stats_->bytes_sent += n;
   }
   void recv(uint8_t* p, size_t n) { recv_all(prev_fd_, p, n); }
 
@@ -173,6 +192,7 @@ class RingLinks {
   int prev_fd_ = -1;
   int next_fd_ = -1;
   int port_ = 0;
+  RingStats* cross_stats_ = nullptr;
 };
 
 // ------------------------------------------------------------ typed arithmetic

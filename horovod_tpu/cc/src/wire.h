@@ -209,6 +209,11 @@ struct ResponseList {
   uint32_t knob_version = 0;         // bumps when the autotuner moves knobs
   int64_t fusion_threshold = 0;
   double cycle_time_ms = 0.0;
+  // Categorical knobs (reference ParameterManager tunes the hierarchical
+  // flags alongside the numeric ones, parameter_manager.h:172). Broadcast
+  // per tick so every rank flips algorithms on the same cycle.
+  uint8_t hier_allreduce = 0;
+  uint8_t hier_allgather = 0;
   std::vector<std::string> stall_warnings;
   std::vector<ResponseEntry> entries;
 
@@ -217,6 +222,8 @@ struct ResponseList {
     w.u32(knob_version);
     w.i64(fusion_threshold);
     w.f64(cycle_time_ms);
+    w.u8(hier_allreduce);
+    w.u8(hier_allgather);
     w.u32((uint32_t)stall_warnings.size());
     for (auto& s : stall_warnings) w.str(s);
     w.u32((uint32_t)entries.size());
@@ -228,6 +235,8 @@ struct ResponseList {
     l.knob_version = r.u32();
     l.fusion_threshold = r.i64();
     l.cycle_time_ms = r.f64();
+    l.hier_allreduce = r.u8();
+    l.hier_allgather = r.u8();
     uint32_t ns = r.u32();
     l.stall_warnings.reserve(ns);
     for (uint32_t i = 0; i < ns; i++) l.stall_warnings.push_back(r.str());
