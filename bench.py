@@ -180,6 +180,14 @@ def main() -> None:
 
     if "--autotune" in sys.argv:
         return autotune_main()
+    if "--scaling" in sys.argv:
+        # Scaling-efficiency curves (the reference's headline artifact,
+        # README.md:53-58): eager ring worlds 2..16, compiled virtual mesh
+        # 1..8, analytic pod projection. Full doc: docs/scaling.md.
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "examples"))
+        import scaling_benchmark
+
+        return scaling_benchmark.main()
 
     hvd.init()
     # Apply tuned winners from --autotune: threshold via

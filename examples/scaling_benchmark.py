@@ -1,0 +1,400 @@
+"""Scaling-efficiency benchmark — the north-star metric of the reference.
+
+The reference's headline artifact is its efficiency-vs-world-size curve
+(90% at 512 GPUs for Inception V3 / ResNet-101, 68% for VGG-16; reference
+README.md:53-58, docs/benchmarks.md:5-6, measured with tf_cnn_benchmarks
+over worlds 1..512). This harness produces the same curve on every plane a
+single machine can measure, plus an analytic projection to the pod scale it
+cannot:
+
+(a) EAGER plane — real multi-process native-ring allreduce over localhost
+    worlds 2/4/8/16: fixed payload per rank, efficiency = per-rank reduced
+    bytes/s vs the world-2 baseline. All ranks share one host's memory
+    bandwidth and loopback, so this measures the engine's software scaling
+    (coordinator tick + ring protocol overhead), not network physics — the
+    honest claim is "the runtime does not degrade superlinearly with
+    world", the same property the reference's flat MPI curve shows.
+    A 2-host-grid variant runs the hierarchical ladder and reports the
+    measured inter-host byte reduction (the quantity that DOES transfer to
+    real pods, where cross-host links are the scarce resource).
+
+(b) COMPILED plane — the DistributedOptimizer step over a virtual CPU mesh,
+    worlds 1..8, fixed per-device batch (weak scaling): efficiency =
+    step_time(1) / step_time(w). Measures the collective-overhead TREND xla
+    inserts as the mesh grows; absolute CPU times are meaningless for TPU.
+
+(c) POD projection — an analytic ICI/DCN roofline for ResNet-50 data
+    parallelism on v5e, parameterized by the measured single-chip step time
+    (bench.py) and public link bandwidths, including the hierarchical
+    ladder's DCN-bytes/ici_size advantage for multi-pod worlds.
+
+Run:  python examples/scaling_benchmark.py            # all sections
+      python examples/scaling_benchmark.py --eager    # one section
+      python examples/scaling_benchmark.py --compiled
+      python examples/scaling_benchmark.py --project
+Emits one JSON document on stdout; human-readable tables on stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# ---------------------------------------------------------------- (a) eager
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# Worker process body: native engine only — no jax import, so a world-16
+# sweep doesn't pay 16 backend initializations (this box has 1 core; 16
+# jax imports would dominate the measurement). That constraint is why this
+# example carries its own minimal spawner instead of runner.run() (whose
+# workers bootstrap the full package) or tests/launch_util.py (an example
+# must run standalone from a checkout without the test tree). If you touch
+# the kill/timeout handling here, check tests/launch_util.launch_world for
+# the same fix.
+_WORKER = r"""
+import json, os, sys, time
+import numpy as np
+sys.path.insert(0, os.environ["HVD_REPO"])
+from horovod_tpu.cc.native_engine import NativeEngine
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.topology import Topology
+
+rank = int(os.environ["HOROVOD_RANK"])
+world = int(os.environ["HOROVOD_SIZE"])
+local = int(os.environ.get("HVD_SCALE_LOCAL", world))  # ranks per sim host
+elems = int(os.environ["HVD_SCALE_ELEMS"])
+iters = int(os.environ["HVD_SCALE_ITERS"])
+hier = os.environ.get("HVD_SCALE_HIER", "0") == "1"
+
+topo = Topology(rank, world, rank % local, local, rank // local,
+                max(world // local, 1))
+cfg = Config(cycle_time_ms=1.0, hierarchical_allreduce=hier,
+             pinned={"HOROVOD_HIERARCHICAL_ALLREDUCE"})
+eng = NativeEngine(topo, cfg)
+buf = np.ones(elems, dtype=np.float32)
+eng.run("allreduce", buf, "warmup", average=False)  # links + first pass
+t0 = time.perf_counter()
+for i in range(iters):
+    eng.run("allreduce", buf, f"it{i}", average=False)
+dt = time.perf_counter() - t0
+st = eng.stats()
+eng.shutdown()
+print(json.dumps({
+    "rank": rank, "seconds": dt,
+    "bytes_per_s": elems * 4 * iters / dt,
+    "cross_bytes": st["ring_cross_bytes_sent"],
+    "hier_on": st["hier_allreduce"],
+}))
+"""
+
+
+def _run_world(world: int, elems: int, iters: int, local: int | None = None,
+               hier: bool = False, timeout: float = 600) -> list[dict]:
+    port = _free_port()
+    secret = secrets.token_hex(16)
+    procs = []
+    for rank in range(world):
+        env = dict(os.environ)
+        env.update({
+            "HVD_REPO": REPO,
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(world),
+            "HOROVOD_COORD_ADDR": f"127.0.0.1:{port}",
+            "HOROVOD_SECRET": secret,
+            "HVD_SCALE_ELEMS": str(elems),
+            "HVD_SCALE_ITERS": str(iters),
+            "HVD_SCALE_LOCAL": str(local or world),
+            "HVD_SCALE_HIER": "1" if hier else "0",
+        })
+        procs.append(subprocess.Popen([sys.executable, "-c", _WORKER], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True))
+    out = []
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=timeout)
+            if p.returncode != 0:
+                raise RuntimeError(f"rank failed:\n{stderr[-2000:]}")
+            out.append(json.loads(stdout.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return out
+
+
+def eager_scaling(worlds=(2, 4, 8, 16), payload_mb: float = 100.0,
+                  iters: int = 3) -> dict:
+    """Efficiency-vs-world-size for the native eager ring. Per rank the
+    scored rate is reduced bytes/s (payload/time — 'algorithm bandwidth').
+    On real clusters each rank's host brings its own NIC and memory
+    bandwidth, so the reference's efficiency is per-rank rate held
+    constant. Here ALL ranks share one box, so the per-rank rate must fall
+    ~1/world on hardware grounds alone; the software-scaling signal is the
+    AGGREGATE rate (sum over ranks) staying flat — any drop below the
+    world-2 aggregate is protocol/coordinator overhead, the quantity this
+    plane can honestly measure. Both are reported."""
+    elems = int(payload_mb * (1 << 20) / 4)
+    rows = []
+    for w in worlds:
+        res = _run_world(w, elems, iters)
+        # slowest rank bounds the collective
+        rate = min(r["bytes_per_s"] for r in res)
+        rows.append({"world": w, "bytes_per_s": rate})
+    base = rows[0]["bytes_per_s"]
+    agg_base = base * worlds[0]
+    for r in rows:
+        agg = r["bytes_per_s"] * r["world"]
+        r["MB_per_s_rank"] = round(r["bytes_per_s"] / (1 << 20), 1)
+        r["per_rank_efficiency"] = round(r["bytes_per_s"] / base, 3)
+        r["aggregate_MB_per_s"] = round(agg / (1 << 20), 1)
+        r["software_efficiency"] = round(agg / agg_base, 3)
+        del r["bytes_per_s"]
+    return {"payload_mb": payload_mb, "iters": iters,
+            "baseline_world": worlds[0], "host_cpus": os.cpu_count(),
+            "note": "single host: all ranks share one memory system and "
+                    f"{os.cpu_count()} CPU core(s); software_efficiency "
+                    "(aggregate vs world-2) is the scaling signal, "
+                    "per_rank_efficiency necessarily ~1/N",
+            "worlds": rows}
+
+
+def eager_hierarchical(world: int = 8, local: int | None = None,
+                       payload_mb: float = 100.0, iters: int = 3) -> dict:
+    """Flat vs hierarchical ladder on a simulated 2-host grid at the same
+    world size: reports the measured per-rank inter-host byte reduction —
+    the quantity that transfers to real pods — alongside wall time (on one
+    box both rings ride loopback, so time parity is expected; the byte
+    ratio is the result)."""
+    local = local or world // 2
+    elems = int(payload_mb * (1 << 20) / 4)
+    flat = _run_world(world, elems, iters, local=local, hier=False)
+    hier = _run_world(world, elems, iters, local=local, hier=True)
+    assert all(r["hier_on"] == 1 for r in hier)
+    max_flat = max(r["cross_bytes"] for r in flat)
+    max_hier = max(r["cross_bytes"] for r in hier)
+    return {
+        "world": world, "hosts": world // local, "ranks_per_host": local,
+        "payload_mb": payload_mb,
+        "flat_worst_rank_cross_MB": round(max_flat / (1 << 20), 1),
+        "hier_worst_rank_cross_MB": round(max_hier / (1 << 20), 1),
+        "cross_byte_ratio": round(max_hier / max_flat, 3),
+        "flat_s": round(min(r["seconds"] for r in flat), 3),
+        "hier_s": round(min(r["seconds"] for r in hier), 3),
+    }
+
+
+# -------------------------------------------------------------- (b) compiled
+
+
+def compiled_scaling(worlds=(1, 2, 4, 8), global_batch: int = 64,
+                     steps: int = 8, reps: int = 3) -> dict:
+    """Collective-overhead trend of the compiled DistributedOptimizer step
+    on a virtual CPU mesh, worlds 1..8 over subsets of the 8 virtual
+    devices. The global batch is FIXED (strong scaling): all worlds run the
+    same total FLOPs on the same time-shared silicon, so under zero
+    collective/partition overhead the step time would be flat — any rise is
+    the overhead the mesh adds, which is the only quantity a virtual mesh
+    can honestly measure (per-device weak scaling would just measure CPU
+    core saturation). IMPORTANT: steps are dispatched one-at-a-time with a
+    block_until_ready fence — chained async dispatches deadlock XLA's
+    in-process CPU collectives."""
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+        os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import TransformerLM
+
+    hvd.init()
+    devices = jax.devices()
+    if len(devices) < max(worlds):
+        # A pre-set XLA_FLAGS with a smaller device count would silently
+        # mislabel the rows (an "8-world" that never ran 8 devices).
+        raise RuntimeError(
+            f"compiled scaling needs {max(worlds)} virtual devices, found "
+            f"{len(devices)}; fix XLA_FLAGS=--xla_force_host_platform_"
+            f"device_count={max(worlds)}")
+    model = TransformerLM(vocab=256, dim=128, heads=4, layers=2,
+                          dtype=jnp.float32)
+    rows = []
+    for w in worlds:
+        mesh = Mesh(devices[:w], ("hvd",))
+        x = jnp.zeros((global_batch, 128), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), x[:2])
+        opt = hvd.jax.DistributedOptimizer(optax.sgd(0.01))
+        opt_state = opt.init(params)
+
+        def loss_fn(params, x):
+            logits = model.apply(params, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], x[:, 1:]).mean()
+
+        def train(params, opt_state, x):
+            loss, g = jax.value_and_grad(loss_fn)(params, x)
+            up, opt_state = opt.update(g, opt_state, params)
+            return optax.apply_updates(params, up), opt_state, loss
+
+        step = jax.jit(shard_map(train, mesh=mesh,
+                                 in_specs=(P(), P(), P("hvd")),
+                                 out_specs=(P(), P(), P()),
+                                 check_vma=False))
+        state = [params, opt_state]
+        step_out = step(state[0], state[1], x)       # compile
+        jax.block_until_ready(step_out)
+        state[:] = step_out[:2]
+        windows = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                p, o, loss = step(state[0], state[1], x)
+                jax.block_until_ready(loss)          # per-step fence (CPU mesh)
+                state[:] = (p, o)
+            windows.append(time.perf_counter() - t0)
+        windows.sort()
+        rows.append({"world": w,
+                     "step_ms": round(windows[len(windows) // 2] / steps * 1e3, 1)})
+    base = rows[0]["step_ms"]
+    for r in rows:
+        r["efficiency"] = round(base / r["step_ms"], 3)
+    return {"model": "TransformerLM(2L,128d)", "global_batch": global_batch,
+            "mode": "strong scaling, fixed total compute on time-shared "
+                    "virtual devices; efficiency < 1 = collective+partition "
+                    "overhead", "worlds": rows}
+
+
+# ------------------------------------------------------------ (c) projection
+
+# Public v5e numbers (Google Cloud TPU docs / the scaling-book mental
+# model): 16x16 2-D torus per pod; each chip has 4 ICI links; commonly
+# quoted aggregate 1600 Gbit/s per chip. A bidirectional ring allreduce
+# along torus rings sustains roughly one link-pair per dimension; we charge
+# an EFFECTIVE per-chip allreduce bandwidth and state it, rather than
+# pretending to model the torus schedule exactly.
+V5E_ICI_EFFECTIVE_GBS = 100.0   # conservative: half the 200 GB/s aggregate
+V5E_DCN_PER_HOST_GBS = 25.0     # 200 Gbit/s NIC per host (8 chips share it)
+RESNET50_PARAMS = 25.56e6
+
+
+def project_pod_efficiency(step_ms: float | None = None,
+                           grad_bytes: float = RESNET50_PARAMS * 4,
+                           overlap: float = 0.7) -> dict:
+    """Analytic ICI/DCN roofline for data-parallel ResNet-50 on v5e.
+
+    Model (stated, simple, falsifiable):
+      t_comm(N)  = 2 * G * (N-1)/N / BW_eff       (ring/torus allreduce)
+      exposed    = max(0, t_comm - overlap * t_step)   (overlap with bwd)
+      efficiency = t_step / (t_step + exposed)
+    `overlap` is the fraction of the step the gradient exchange can hide
+    behind (backward pass ≈ 2/3 of compute, plus XLA's bucketed overlap);
+    0.7 matches the reference's observed 90%-at-512 regime for ResNet.
+    Multi-pod worlds add a DCN stage: without the hierarchical ladder every
+    chip's full G crosses DCN; with it each pod's DCN traffic is G per
+    HOST-GROUP (the ladder reduces over ICI first), i.e. G/ici_size per
+    chip — the measured eager-plane cross-byte ratio is the same effect.
+    """
+    if step_ms is None:
+        # measured single-chip rate from bench.py (BENCH_r03: 2489 img/s,
+        # batch 128)
+        step_ms = 128.0 / 2489.0 * 1e3
+    t_step = step_ms / 1e3
+    rows = []
+    for n in (8, 64, 256):
+        t_comm = 2 * grad_bytes * (n - 1) / n / (V5E_ICI_EFFECTIVE_GBS * 1e9)
+        exposed = max(0.0, t_comm - overlap * t_step)
+        rows.append({"chips": n, "fabric": "ICI (one pod)",
+                     "t_comm_ms": round(t_comm * 1e3, 2),
+                     "efficiency": round(t_step / (t_step + exposed), 3)})
+    # two pods over DCN, 256 chips each: flat vs hierarchical ladder
+    for hier in (False, True):
+        chips, per_host = 512, 8
+        g_dcn = grad_bytes / (256 if hier else 1) * 2  # 2 pods exchange
+        # per-host NIC carries per_host chips' DCN traffic
+        t_dcn = g_dcn * per_host / (V5E_DCN_PER_HOST_GBS * 1e9)
+        t_ici = 2 * grad_bytes * 255 / 256 / (V5E_ICI_EFFECTIVE_GBS * 1e9)
+        t_comm = t_ici + t_dcn
+        exposed = max(0.0, t_comm - overlap * t_step)
+        rows.append({"chips": chips,
+                     "fabric": "2 pods over DCN"
+                               + (" + hierarchical ladder" if hier else " flat"),
+                     "t_comm_ms": round(t_comm * 1e3, 2),
+                     "efficiency": round(t_step / (t_step + exposed), 3)})
+    return {
+        "model": "ResNet-50 DP, bf16-capable v5e",
+        "assumptions": {
+            "step_ms_single_chip": round(step_ms, 2),
+            "grad_bytes": int(grad_bytes),
+            "ici_effective_GBs": V5E_ICI_EFFECTIVE_GBS,
+            "dcn_per_host_GBs": V5E_DCN_PER_HOST_GBS,
+            "overlap_fraction": overlap,
+        },
+        "rows": rows,
+    }
+
+
+# ---------------------------------------------------------------------- main
+
+
+def main() -> None:
+    argv = set(sys.argv[1:])
+    run_all = not (argv & {"--eager", "--compiled", "--project", "--hier"})
+    out: dict = {}
+    if run_all or "--eager" in argv:
+        print("eager plane: native ring, worlds 2/4/8/16 ...", file=sys.stderr)
+        out["eager"] = eager_scaling()
+        for r in out["eager"]["worlds"]:
+            print(f"  world {r['world']:>2}: {r['MB_per_s_rank']:>8.1f} "
+                  f"MB/s/rank  aggregate {r['aggregate_MB_per_s']:>8.1f} MB/s"
+                  f"  software eff {r['software_efficiency']:.3f}",
+                  file=sys.stderr)
+    if run_all or "--hier" in argv:
+        print("eager plane: hierarchical ladder on 2-host grid ...",
+              file=sys.stderr)
+        out["eager_hierarchical"] = eager_hierarchical()
+        h = out["eager_hierarchical"]
+        print(f"  cross-byte ratio hier/flat = {h['cross_byte_ratio']}"
+              f" (1/local_size = {1.0 / h['ranks_per_host']:.3f})",
+              file=sys.stderr)
+    if run_all or "--compiled" in argv:
+        print("compiled plane: virtual CPU mesh, worlds 1/2/4/8 ...",
+              file=sys.stderr)
+        out["compiled"] = compiled_scaling()
+        for r in out["compiled"]["worlds"]:
+            print(f"  world {r['world']}: {r['step_ms']:>7.1f} ms/step  "
+                  f"eff {r['efficiency']:.3f}", file=sys.stderr)
+    if run_all or "--project" in argv:
+        out["projection"] = project_pod_efficiency()
+        for r in out["projection"]["rows"]:
+            print(f"  {r['chips']:>3} chips {r['fabric']:<32}"
+                  f" t_comm {r['t_comm_ms']:>6.2f} ms  eff {r['efficiency']:.3f}",
+                  file=sys.stderr)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
