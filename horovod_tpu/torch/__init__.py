@@ -36,7 +36,11 @@ from ..common.basics import (  # noqa: F401
     mpi_threads_supported,
 )
 from .compression import Compression  # noqa: F401
+from . import mpi_ops
 from .mpi_ops import (  # noqa: F401
+    sparse_allreduce,
+    sparse_allreduce_async,
+    sparse_synchronize,
     allgather,
     allgather_async,
     allreduce,
@@ -82,6 +86,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if len(names) != len(set(names)):
             raise ValueError("parameter names must be unique")
         self._parameter_names = {v: n for n, v in named_parameters}
+        # Parameters observed producing sparse gradients: the unused-branch
+        # zeros fallback must stay collective-compatible with ranks that DID
+        # fire the sparse hook (two allgathers, not one dense allreduce).
+        self._sparse_params: set[torch.Tensor] = set()
         self._handles: dict[torch.Tensor, int] = {}
         self._grad_ctx: dict[torch.Tensor, Any] = {}
         self._allreduce_delay: dict[torch.Tensor, int] = {}
@@ -113,6 +121,17 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def _allreduce_grad_async(self, p):
         name = self._parameter_names.get(p)
+        if p.grad is not None and p.grad.is_sparse:
+            self._sparse_params.add(p)
+            # Sparse embedding gradients ride the (values, indices)
+            # allgather pair instead of being densified (the reference's TF
+            # IndexedSlices semantics, tensorflow/__init__.py:72-83; its
+            # torch binding can only densify via sparse_as_dense).
+            # Compression is skipped: nnz values are already the compact
+            # form, and fp16-compressing indices would corrupt them.
+            self._handles[p] = mpi_ops.sparse_allreduce_async(
+                p.grad, average=True, name=name)
+            return
         compressed, ctx = self._compression.compress(p.grad)
         self._grad_ctx[p] = (compressed, ctx)
         handle = allreduce_async_(compressed, average=True, name=name)
@@ -130,12 +149,30 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 continue
             if p.grad is None:
                 if delay == self.backward_passes_per_step:
-                    # never had a gradient: contribute zeros to stay collective
-                    p.grad = p.data.new_zeros(p.shape)
+                    # never had a gradient: contribute zeros to stay
+                    # collective. A parameter KNOWN to produce sparse grads
+                    # must contribute an empty (values, indices) pair — a
+                    # dense zeros allreduce here would mismatch the sparse
+                    # ranks' two allgathers and stall the job. (Residual
+                    # edge: a sparse parameter unused on this rank in the
+                    # very FIRST step, before any rank-local sparse grad was
+                    # observed, still takes the dense branch; make the first
+                    # batch touch every sparse parameter, as with any
+                    # collective framework.)
+                    if p in self._sparse_params:
+                        p.grad = torch.sparse_coo_tensor(
+                            torch.zeros((1, 0), dtype=torch.int64),
+                            p.data.new_zeros((0,) + p.shape[1:]), p.shape)
+                    else:
+                        p.grad = p.data.new_zeros(p.shape)
                 else:  # pragma: no cover - grad exists once any pass ran
                     continue
             self._allreduce_grad_async(p)
         for p, handle in list(self._handles.items()):
+            if isinstance(handle, tuple):  # sparse (values, indices) pair
+                p.grad = mpi_ops.sparse_synchronize(handle).to(p.grad.dtype)
+                self._allreduce_delay[p] = self.backward_passes_per_step
+                continue
             output = synchronize(handle)
             compressed, ctx = self._grad_ctx.pop(p)
             with torch.no_grad():

@@ -118,6 +118,57 @@ def reducescatter_async(tensor: torch.Tensor, average: bool = False,
     return h
 
 
+# --------------------------------------------------------------- sparse path
+
+def sparse_allreduce_async(tensor: torch.Tensor, average: bool = True,
+                           name: Optional[str] = None) -> tuple[int, int]:
+    """Allreduce of a torch sparse COO tensor without densifying: allgather
+    the (values, indices) pair over the ring, exactly the reference's
+    IndexedSlices decomposition (tensorflow/__init__.py:72-83 — allgather of
+    values and indices; its torch binding only offers sparse_as_dense
+    densification, so this is a capability the reference reserves for TF).
+    The engine's ragged allgather carries per-rank nnz naturally. Returns
+    the two handles; pass them to :func:`sparse_synchronize`."""
+    t = tensor if tensor.is_coalesced() else tensor.coalesce()
+    eng = _engine()
+    values = t.values().contiguous()
+    # COO indices are (sparse_dim, nnz); allgather concatenates dim 0, so
+    # ship them row-per-entry as (nnz, sparse_dim).
+    indices = t.indices().t().contiguous()
+    base = name or ""
+    h_v = eng.enqueue("allgather", _to_numpy(values),
+                      f"{base}.values" if base else None)
+    h_i = eng.enqueue("allgather", _to_numpy(indices),
+                      f"{base}.indices" if base else None)
+    _handle_map[h_v] = (values, None)
+    _handle_map[h_i] = (indices, None)
+    _sparse_meta[(h_v, h_i)] = (tuple(tensor.shape), average)
+    return h_v, h_i
+
+
+def sparse_synchronize(handles: tuple[int, int]) -> torch.Tensor:
+    """Complete a :func:`sparse_allreduce_async`: returns a COALESCED sparse
+    tensor — coalescing performs the local scatter-add of same-index rows
+    from different ranks. ``average`` divides values by world size, like the
+    dense op."""
+    h_v, h_i = handles
+    shape, average = _sparse_meta.pop(handles)
+    all_values = synchronize(h_v)
+    all_indices = synchronize(h_i)
+    if average:
+        all_values = all_values / basics.size()
+    out = torch.sparse_coo_tensor(all_indices.t(), all_values, shape)
+    return out.coalesce()
+
+
+def sparse_allreduce(tensor: torch.Tensor, average: bool = True,
+                     name: Optional[str] = None) -> torch.Tensor:
+    return sparse_synchronize(sparse_allreduce_async(tensor, average, name))
+
+
+_sparse_meta: dict[tuple[int, int], tuple[tuple, bool]] = {}
+
+
 def poll(handle: int) -> bool:
     return _engine().poll(handle)
 
