@@ -63,17 +63,41 @@ def init(comm: Optional[Sequence[int]] = None) -> None:
                 raise ValueError(
                     "comm must be a list of ranks on TPU (MPI communicators do not exist here)"
                 )
-            ranks = sorted(comm)
+            ranks = sorted(set(comm))
+            if any(not (0 <= r < topo.size) for r in ranks):
+                raise ValueError(
+                    f"comm {ranks} contains ranks outside the launched world "
+                    f"of size {topo.size}")
             if topo.rank not in ranks and topo.size > 1:
-                raise ValueError(f"rank {topo.rank} not in comm {ranks}")
-            if topo.size > 1:
+                raise ValueError(
+                    f"rank {topo.rank} is not a member of comm {ranks}: this "
+                    "process cannot participate in the sub-world's "
+                    "collectives. Only member processes may call "
+                    "init(comm=...); non-members should skip Horovod work "
+                    "(or exit) — they must NOT fall back to init(), which "
+                    "would target the same coordinator address.")
+            if topo.size > 1 and len(ranks) != topo.size:
+                # Sub-world semantics (reference horovod_init with ranks[],
+                # operations.cc:2415): rank/size are re-indexed within the
+                # subset — the member at ranks[0] becomes rank 0 and binds
+                # the coordinator address, so the control plane and ring are
+                # exactly a world of len(ranks). Host coordinates are NOT
+                # preserved: a member only knows its own host placement, not
+                # the other members', so any local/cross guess would build
+                # wrong topology (the round-3 bug: local_size=min(...) could
+                # group ranks that share no host). The subset world uses the
+                # consistent one-rank-per-host view — local_rank 0, hierarchy
+                # simply not available — which every rank derives identically
+                # from `ranks` alone. A ranks list naming the FULL world is
+                # plain init (reference accepts this too) and keeps the real
+                # host topology — the branch guard above.
                 topo = Topology(
                     rank=ranks.index(topo.rank),
                     size=len(ranks),
-                    local_rank=topo.local_rank,
-                    local_size=min(topo.local_size, len(ranks)),
-                    cross_rank=topo.cross_rank,
-                    cross_size=topo.cross_size,
+                    local_rank=0,
+                    local_size=1,
+                    cross_rank=ranks.index(topo.rank),
+                    cross_size=len(ranks),
                 )
         _state.topology = topo
         _state.config = Config.from_env()
