@@ -77,6 +77,17 @@ def init(comm: Optional[Sequence[int]] = None) -> None:
                     "(or exit) — they must NOT fall back to init(), which "
                     "would target the same coordinator address.")
             if topo.size > 1 and len(ranks) != topo.size:
+                if ranks[0] != 0:
+                    # The sub-world's rank 0 binds HOROVOD_COORD_ADDR, which
+                    # names ORIGINAL rank 0's host: on a multi-host job where
+                    # the member at ranks[0] lives elsewhere, that bind fails
+                    # (EADDRNOTAVAIL). Warn with the fix up front.
+                    log("warning",
+                        f"init(comm={ranks}): member rank {ranks[0]} will "
+                        "bind the coordinator at HOROVOD_COORD_ADDR. If it "
+                        "is not on the same host as the original rank 0, "
+                        "re-export HOROVOD_COORD_ADDR on every member to an "
+                        "address local to that member before init.")
                 # Sub-world semantics (reference horovod_init with ranks[],
                 # operations.cc:2415): rank/size are re-indexed within the
                 # subset — the member at ranks[0] becomes rank 0 and binds

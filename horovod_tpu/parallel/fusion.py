@@ -168,14 +168,23 @@ def fused_allreduce(
 
 def _axis_size(axis_name: str):
     """Resolve a mesh axis size from the active trace or, failing that, the
-    ambient ``with Mesh(...)`` context; None if neither binds the name."""
+    ambient ``with Mesh(...)`` context; None if neither binds the name.
+
+    The ambient-mesh fallback reads ``jax._src.mesh.thread_resources`` — a
+    private API a jax upgrade may move (ADVICE r3). It is best-effort
+    behind try/except: if it disappears, we return None and the caller
+    raises its actionable "pass ici_axis_size=" ValueError instead of an
+    ImportError at trace time."""
     try:
         return int(jax.lax.axis_size(axis_name))
     except NameError:
         pass
-    from jax._src import mesh as mesh_lib
+    try:
+        from jax._src import mesh as mesh_lib
 
-    env_mesh = mesh_lib.thread_resources.env.physical_mesh
-    if not env_mesh.empty and axis_name in env_mesh.shape:
-        return int(env_mesh.shape[axis_name])
+        env_mesh = mesh_lib.thread_resources.env.physical_mesh
+        if not env_mesh.empty and axis_name in env_mesh.shape:
+            return int(env_mesh.shape[axis_name])
+    except (ImportError, AttributeError):
+        pass
     return None

@@ -41,22 +41,27 @@ def _spawn_worker(index: int, driver_addrs, secret: bytes, argv: Sequence[str],
     env["HOROVOD_DRIVER_ADDRS"] = json.dumps([list(a) for a in driver_addrs])
     env["HOROVOD_SECRET"] = secret.hex()
     env["HOROVOD_TASK_INDEX"] = str(index)
+    env["HVD_PARENT_PID"] = str(os.getpid())  # startup-race watchdog anchor
     env.update(extra_env or {})
     # Own session per worker: on abort the launcher signals the whole
     # process group, so grandchildren die too (proc_tree.terminate_tree).
     return subprocess.Popen(list(argv), env=env, start_new_session=True)
 
 
-def _worker_env(index: int, driver_addrs, secret: bytes,
+def _worker_env(index: int, driver_addrs, secret: Optional[bytes],
                 extra_env: Optional[dict]) -> dict:
-    # The per-job secret rides the agent channel, which is authenticated but
-    # not encrypted — same trust model as the reference shipping its secret
-    # through Spark executor env (spark/__init__.py:109).
+    # secret=None on the remote-agent path: the per-job secret is DERIVED
+    # independently by the agent (agent.py _spawn) and the driver
+    # (RemoteSpawner.job_secret) from the agent secret + job id, so it never
+    # rides the authenticated-but-unencrypted agent channel. (The reference
+    # ships its secret through Spark executor env, spark/__init__.py:109 —
+    # this build deliberately does not.)
     env = {
         "HOROVOD_DRIVER_ADDRS": json.dumps([list(a) for a in driver_addrs]),
-        "HOROVOD_SECRET": secret.hex(),
         "HOROVOD_TASK_INDEX": str(index),
     }
+    if secret is not None:
+        env["HOROVOD_SECRET"] = secret.hex()
     env.update(extra_env or {})
     return env
 
@@ -103,12 +108,15 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
                 f"num_proc={num_proc} contradicts hosts spec "
                 f"({spawner.num_proc} total slots)")
         num_proc = spawner.num_proc
+        # Per-job secret DERIVED on both ends (here and agent._spawn), not
+        # shipped in worker env over the unencrypted agent channel.
+        secret = spawner.job_secret()
         driver = DriverService(num_proc, secret, fn=fn, args=args, kwargs=kwargs)
         argv = [python or sys.executable, "-m", "horovod_tpu.runner.task_main"]
         try:
             spawner.spawn(
                 make_argv=lambda i: argv,
-                make_env=lambda i: _worker_env(i, driver.addresses(), secret, env))
+                make_env=lambda i: _worker_env(i, driver.addresses(), None, env))
             results = driver.wait_results(timeout=timeout,
                                           liveness=spawner.liveness)
             return [results[r] for r in sorted(results)]
@@ -162,7 +170,7 @@ def run_command(command: Sequence[str], num_proc: Optional[int] = None,
             raise ValueError(
                 f"num_proc={num_proc} contradicts hosts spec "
                 f"({spawner.num_proc} total slots)")
-        secret = make_secret()
+        secret = spawner.job_secret()  # derived on both ends, never shipped
         driver = DriverService(spawner.num_proc, secret, fn=None)
         argv = ([python or sys.executable, "-m", "horovod_tpu.runner.task_exec"]
                 + list(command))
@@ -170,7 +178,7 @@ def run_command(command: Sequence[str], num_proc: Optional[int] = None,
             spawner.spawn(
                 make_argv=lambda i: argv,
                 make_env=lambda i: {
-                    **_worker_env(i, driver.addresses(), secret, env),
+                    **_worker_env(i, driver.addresses(), None, env),
                     "HOROVOD_SUPERVISE": "1",
                 })
             deadline = time.monotonic() + timeout if timeout else None

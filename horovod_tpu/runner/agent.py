@@ -36,7 +36,7 @@ import sys
 import threading
 from typing import Any, Optional
 
-from .network import BasicService
+from .network import BasicService, derive_key
 from .proc_tree import terminate_trees
 from .service import host_hash
 
@@ -87,10 +87,19 @@ class HostAgent(BasicService):
         job_id = req["job_id"]
         cwd = req.get("cwd") or None
         procs: dict[int, subprocess.Popen] = {}
+        # Per-job worker secret, derived locally from the agent secret and
+        # job id (network.derive_key) — the driver derives the same value
+        # (RemoteSpawner.job_secret), so it never crosses the unencrypted
+        # channel in worker env.
+        job_secret = derive_key(self.key, b"hvd-job:" + str(job_id).encode())
         try:
             for w in req["workers"]:
                 env = dict(os.environ)
                 env.update(w.get("env") or {})
+                env["HOROVOD_SECRET"] = job_secret.hex()
+                # Lets the worker's watchdog detect a parent that died
+                # before its first ppid snapshot (task_main.watch_parent).
+                env["HVD_PARENT_PID"] = str(os.getpid())
                 # Own session per worker: abort signals the whole group, so
                 # grandchildren (data loaders, shells) die too.
                 procs[w["index"]] = subprocess.Popen(

@@ -1,11 +1,22 @@
 """Authenticated TCP service layer for the cluster launcher.
 
 Design taken from the reference's Spark network layer
-(horovod/spark/util/network.py:44-117): wire format is
-HMAC-SHA256(digest) + length + pickled body, services bind a random port,
-clients verify the digest with a shared secret before unpickling (never
-unpickle unauthenticated bytes). Used by the driver/task services in
-service.py.
+(horovod/spark/util/network.py:44-117) — HMAC-SHA256 over pickled bodies,
+verified before unpickling — hardened beyond it against replay:
+
+- Per-connection handshake: the server sends a random session nonce; both
+  sides derive a session key = HMAC(secret, nonce). A message captured on
+  one connection fails authentication on every other connection.
+- Per-message sequence numbers and a direction byte inside the MAC: a
+  message replayed (or reflected) WITHIN its own connection also fails.
+  (The reference's digest covers only the payload, so a passive observer
+  who can inject TCP traffic could replay captured requests verbatim.)
+
+The channel remains unencrypted: anyone on the network path can READ
+messages (the reference's trust model too). Secrets therefore never ride
+it — the per-job worker secret is derived independently on each side
+(derive_key), not transmitted. Run agents only on networks where
+eavesdropping is acceptable, exactly as you would treat rsh.
 """
 
 from __future__ import annotations
@@ -26,13 +37,12 @@ def make_secret() -> bytes:
     return _secrets.token_bytes(32)
 
 
-def _digest(key: bytes, payload: bytes) -> bytes:
-    return hmac.new(key, payload, hashlib.sha256).digest()
-
-
-def send_obj(sock: socket.socket, key: bytes, obj: Any) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_digest(key, payload) + struct.pack("!Q", len(payload)) + payload)
+def derive_key(key: bytes, purpose: bytes) -> bytes:
+    """One-block HKDF-style derivation: a purpose-bound subkey of `key`.
+    Used to mint per-job worker secrets from the agent secret on BOTH ends
+    (driver and agent) so the job secret never crosses the unencrypted
+    agent channel."""
+    return hmac.new(key, b"hvd-derive:" + purpose, hashlib.sha256).digest()
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -49,16 +59,62 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 # length so a secretless peer can't force unbounded allocation.
 MAX_PAYLOAD = 256 * 1024 * 1024
 
+_MAGIC = b"HVD2"
+_NONCE_LEN = 16
 
-def recv_obj(sock: socket.socket, key: bytes) -> Any:
-    digest = _recv_exact(sock, 32)
-    (n,) = struct.unpack("!Q", _recv_exact(sock, 8))
-    if n > MAX_PAYLOAD:
-        raise PermissionError(f"payload length {n} exceeds cap {MAX_PAYLOAD}")
-    payload = _recv_exact(sock, n)
-    if not hmac.compare_digest(digest, _digest(key, payload)):
-        raise PermissionError("HMAC digest mismatch: unauthenticated peer")
-    return pickle.loads(payload)
+
+class Channel:
+    """One authenticated connection: session-keyed, sequence-numbered.
+
+    Construction performs the handshake (server sends `HVD2` + nonce;
+    both sides derive session_key = HMAC(secret, "hvd-session:"+nonce)).
+    Each direction numbers its messages from 0 and the MAC covers
+    (direction, seq, payload), so neither cross-connection replay nor
+    in-connection replay/reflection authenticates."""
+
+    def __init__(self, sock: socket.socket, key: bytes, server: bool) -> None:
+        self.sock = sock
+        if server:
+            nonce = _secrets.token_bytes(_NONCE_LEN)
+            sock.sendall(_MAGIC + nonce)
+        else:
+            head = _recv_exact(sock, len(_MAGIC) + _NONCE_LEN)
+            if head[: len(_MAGIC)] != _MAGIC:
+                raise PermissionError(
+                    "bad handshake magic: peer is not an hvd service "
+                    "(or an older, replay-vulnerable build)")
+            nonce = head[len(_MAGIC):]
+        self._key = hmac.new(key, b"hvd-session:" + nonce,
+                             hashlib.sha256).digest()
+        self._send_dir = b"S" if server else b"C"
+        self._recv_dir = b"C" if server else b"S"
+        self._send_seq = 0
+        self._recv_seq = 0
+
+    def _mac(self, direction: bytes, seq: int, payload: bytes) -> bytes:
+        return hmac.new(self._key,
+                        direction + struct.pack("!Q", seq) + payload,
+                        hashlib.sha256).digest()
+
+    def send(self, obj: Any) -> None:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        mac = self._mac(self._send_dir, self._send_seq, payload)
+        self._send_seq += 1
+        self.sock.sendall(mac + struct.pack("!Q", len(payload)) + payload)
+
+    def recv(self) -> Any:
+        digest = _recv_exact(self.sock, 32)
+        (n,) = struct.unpack("!Q", _recv_exact(self.sock, 8))
+        if n > MAX_PAYLOAD:
+            raise PermissionError(f"payload length {n} exceeds cap {MAX_PAYLOAD}")
+        payload = _recv_exact(self.sock, n)
+        if not hmac.compare_digest(
+                digest, self._mac(self._recv_dir, self._recv_seq, payload)):
+            raise PermissionError(
+                "HMAC digest mismatch: unauthenticated, replayed, or "
+                "reordered message")
+        self._recv_seq += 1
+        return pickle.loads(payload)
 
 
 class BasicService:
@@ -106,11 +162,12 @@ class BasicService:
 
     def _serve(self, conn: socket.socket, addr) -> None:
         try:
+            ch = Channel(conn, self.key, server=True)
             while not self._stop.is_set():
-                req = recv_obj(conn, self.key)
+                req = ch.recv()
                 resp = self.handle(req, addr)
-                send_obj(conn, self.key, resp)
-        except (ConnectionError, OSError, EOFError):
+                ch.send(resp)
+        except (ConnectionError, OSError, EOFError, PermissionError):
             pass
         finally:
             try:
@@ -139,17 +196,28 @@ class BasicClient:
         self.key = key
         last: Optional[Exception] = None
         for host, port in addresses:
+            sock = None
             try:
-                self.sock = socket.create_connection((host, port), timeout=timeout)
-                self.sock.settimeout(timeout)
+                sock = socket.create_connection((host, port), timeout=timeout)
+                sock.settimeout(timeout)
+                # The handshake does I/O: a failure here (bad magic from a
+                # non-hvd peer, timeout) must close the already-connected
+                # socket before trying the next address, or it leaks.
+                self._ch = Channel(sock, key, server=False)
+                self.sock = sock
                 return
             except OSError as e:
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
                 last = e
         raise ConnectionError(f"cannot reach service at {addresses}: {last}")
 
     def request(self, obj: Any) -> Any:
-        send_obj(self.sock, self.key, obj)
-        return recv_obj(self.sock, self.key)
+        self._ch.send(obj)
+        return self._ch.recv()
 
     def close(self) -> None:
         try:

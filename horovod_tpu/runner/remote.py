@@ -17,7 +17,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Sequence, Union
 
 from .agent import DEFAULT_AGENT_PORT
-from .network import BasicClient
+from .network import BasicClient, derive_key
 
 
 @dataclass(frozen=True)
@@ -35,8 +35,11 @@ def parse_hosts(hosts: Union[str, Sequence],
     (docs/running.md mpirun examples): ``host[:slots]`` entries separated by
     commas; an optional ``@port`` after the host overrides the agent port
     (``127.0.0.1@9001:2`` — used when several agents share one machine,
-    e.g. tests). Also accepts a sequence of (host, slots) or
-    (host, slots, agent_port) tuples / HostSpec instances.
+    e.g. tests). Bare IPv6 addresses contain colons, so they must be
+    bracketed: ``[::1]:4`` or ``[fe80::1]@9009:2`` (an unbracketed ``::1:4``
+    would be split at the first colon into nonsense). Also accepts a
+    sequence of (host, slots) or (host, slots, agent_port) tuples /
+    HostSpec instances.
     """
     default_port = agent_port or DEFAULT_AGENT_PORT
     specs: list[HostSpec] = []
@@ -45,8 +48,29 @@ def parse_hosts(hosts: Union[str, Sequence],
             entry = entry.strip()
             if not entry:
                 continue
-            host, _, slots_s = entry.partition(":")
-            host, _, port_s = host.partition("@")
+            if entry.startswith("["):  # bracketed IPv6: [addr][@port][:slots]
+                addr, bracket, rest = entry[1:].partition("]")
+                if not bracket:
+                    raise ValueError(
+                        f"unterminated '[' in host spec entry {entry!r}; "
+                        f"IPv6 form is [addr][@port][:slots]")
+                rest, _, slots_s = rest.partition(":")
+                junk, at, port_s = rest.partition("@")
+                if junk or (at and not port_s):
+                    # e.g. "[fe80::1]8000:2" (forgot the '@') — silently
+                    # dropping `junk` would contact the default port instead
+                    raise ValueError(
+                        f"bad text {rest!r} after ']' in {entry!r}; "
+                        f"expected [addr][@port][:slots]")
+                host = addr
+            else:
+                if entry.count(":") > 1:
+                    raise ValueError(
+                        f"entry {entry!r} has multiple ':' — bracket IPv6 "
+                        f"addresses like [::1]:4 so the slot count can be "
+                        f"told apart from the address")
+                host, _, slots_s = entry.partition(":")
+                host, _, port_s = host.partition("@")
             if not host:
                 raise ValueError(f"empty host in spec entry {entry!r}")
             try:
@@ -83,6 +107,7 @@ class RemoteSpawner:
     def __init__(self, specs: Sequence[HostSpec], agent_secret: bytes,
                  connect_timeout: float = 30.0) -> None:
         self.specs = list(specs)
+        self.agent_secret = agent_secret
         self.job_id = _secrets.token_hex(8)
         self._clients: list[Optional[BasicClient]] = []
         self._spawned = False
@@ -105,6 +130,13 @@ class RemoteSpawner:
     @property
     def num_proc(self) -> int:
         return sum(s.slots for s in self.specs)
+
+    def job_secret(self) -> bytes:
+        """The per-job worker secret, derived — never transmitted. The agent
+        performs the same derivation and injects it into worker env
+        (agent.py _spawn), so a passive observer of the unencrypted agent
+        channel learns neither the agent secret nor the job secret."""
+        return derive_key(self.agent_secret, b"hvd-job:" + self.job_id.encode())
 
     def spawn(self, make_argv: Callable[[int], list],
               make_env: Callable[[int], dict]) -> None:

@@ -76,6 +76,19 @@ def test_parse_hosts():
         parse_hosts("")
 
 
+def test_parse_hosts_ipv6():
+    """ADVICE r3: bare IPv6 would be mangled by the first-colon split; the
+    bracketed form parses and the bare form errors with the fix."""
+    assert parse_hosts("[::1]:4") == [HostSpec("::1", 4)]
+    specs = parse_hosts("[fe80::1]@9009:2,[::1]")
+    assert specs[0] == HostSpec("fe80::1", 2, 9009)
+    assert specs[1] == HostSpec("::1", 1)
+    with pytest.raises(ValueError, match="bracket IPv6"):
+        parse_hosts("::1:4")
+    with pytest.raises(ValueError, match="unterminated"):
+        parse_hosts("[::1:4")
+
+
 def test_agent_rejects_wrong_secret(two_agents):
     _, port_a, _, _, _ = two_agents
     with pytest.raises(ConnectionError, match="cannot reach hvd-agent"):

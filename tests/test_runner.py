@@ -13,9 +13,9 @@ import pytest
 from horovod_tpu.runner.network import (
     BasicClient,
     BasicService,
+    Channel,
+    derive_key,
     make_secret,
-    recv_obj,
-    send_obj,
 )
 
 
@@ -79,11 +79,84 @@ def test_hmac_rejects_wrong_secret():
         import socket as s
 
         conn = s.create_connection(("127.0.0.1", svc.port), timeout=10)
-        send_obj(conn, make_secret(), {"evil": True})  # wrong key
-        with pytest.raises((ConnectionError, OSError)):
-            recv_obj(conn, make_secret())  # server dropped us
+        conn.settimeout(10)
+        ch = Channel(conn, make_secret(), server=False)  # wrong key
+        ch.send({"evil": True})
+        with pytest.raises((ConnectionError, OSError, PermissionError)):
+            ch.recv()  # server dropped us without a response
     finally:
         svc.stop()
+
+
+def test_replayed_message_rejected():
+    """ADVICE r3 (medium): a captured request must not authenticate when
+    replayed — neither within its own connection (sequence numbers) nor on
+    a fresh connection (per-connection session nonce)."""
+    import hashlib
+    import hmac as h
+    import socket as s
+    import struct
+    import pickle
+
+    calls = []
+
+    class Spy(BasicService):
+        def handle(self, request, client_addr):
+            calls.append(request)
+            return {"ok": True}
+
+    key = make_secret()
+    svc = Spy(key)
+    try:
+        conn = s.create_connection(("127.0.0.1", svc.port), timeout=10)
+        conn.settimeout(10)
+        # perform the client handshake by hand so we hold the raw frame
+        head = conn.recv(20)
+        assert head[:4] == b"HVD2"
+        session = h.new(key, b"hvd-session:" + head[4:], hashlib.sha256).digest()
+        payload = pickle.dumps({"kind": "spawn", "argv": ["evil"]})
+        mac = h.new(session, b"C" + struct.pack("!Q", 0) + payload,
+                    hashlib.sha256).digest()
+        frame = mac + struct.pack("!Q", len(payload)) + payload
+        conn.sendall(frame)
+        # legitimate first delivery is handled
+        resp_head = conn.recv(1)
+        assert resp_head  # server answered
+        conn.recv(1 << 16)
+        assert len(calls) == 1
+        # in-connection replay: identical bytes, but the server now expects
+        # seq 1 — must be dropped without reaching handle()
+        conn.sendall(frame)
+        conn.settimeout(5)
+        got = b""
+        try:
+            got = conn.recv(1)
+        except (ConnectionError, OSError, TimeoutError):
+            pass
+        assert got == b"", "server answered a replayed frame"
+        # cross-connection replay: fresh connection = fresh nonce, the old
+        # session MAC cannot validate
+        conn2 = s.create_connection(("127.0.0.1", svc.port), timeout=10)
+        conn2.settimeout(5)
+        conn2.recv(20)  # new handshake (different nonce)
+        conn2.sendall(frame)
+        got = b""
+        try:
+            got = conn2.recv(1)
+        except (ConnectionError, OSError, TimeoutError):
+            pass
+        assert got == b"", "server answered a cross-connection replay"
+        assert len(calls) == 1, f"replay reached handle(): {calls}"
+    finally:
+        svc.stop()
+
+
+def test_derive_key_is_purpose_bound():
+    key = make_secret()
+    a = derive_key(key, b"hvd-job:aaaa")
+    b = derive_key(key, b"hvd-job:bbbb")
+    assert a != b and len(a) == 32
+    assert derive_key(key, b"hvd-job:aaaa") == a  # deterministic both ends
 
 
 def test_hmac_happy_roundtrip():
@@ -150,6 +223,8 @@ def test_payload_cap():
     svc = Echo(make_secret())
     try:
         conn = s.create_connection(("127.0.0.1", svc.port), timeout=10)
+        conn.settimeout(10)
+        conn.recv(20)  # consume the server's session-nonce handshake
         conn.sendall(b"\0" * 32 + struct.pack("!Q", 1 << 40))  # 1 TiB claim
         conn.settimeout(5)
         with pytest.raises((ConnectionError, ConnectionResetError, OSError, TimeoutError)):
