@@ -7,6 +7,22 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+echo "== wheel builds (packaging parity: reference setup.py/Dockerfile) =="
+rm -rf build/ dist-ci/
+python -m pip wheel . --no-deps --no-build-isolation -w dist-ci/ -q
+ls dist-ci/horovod_tpu-*.whl
+# The wheel must carry the native core sources so the lazy build works on
+# hosts that install the wheel without the repo checkout.
+python - <<'PY'
+import glob, zipfile
+whl = glob.glob("dist-ci/horovod_tpu-*.whl")[0]
+names = zipfile.ZipFile(whl).namelist()
+assert any(n.endswith("cc/Makefile") for n in names), names
+assert any(n.endswith("src/engine.cc") for n in names), "native sources missing from wheel"
+print("wheel contents ok:", whl)
+PY
+rm -rf dist-ci/ build/
+
 echo "== fast tier (includes the launcher e2e: test_run_happy_path) =="
 python -m pytest tests/ -m fast -q
 
