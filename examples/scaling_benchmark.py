@@ -19,9 +19,11 @@ cannot:
     real pods, where cross-host links are the scarce resource).
 
 (b) COMPILED plane — the DistributedOptimizer step over a virtual CPU mesh,
-    worlds 1..8, fixed per-device batch (weak scaling): efficiency =
-    step_time(1) / step_time(w). Measures the collective-overhead TREND xla
-    inserts as the mesh grows; absolute CPU times are meaningless for TPU.
+    worlds 1..8, fixed GLOBAL batch (strong scaling — all worlds run the
+    same total FLOPs on the same time-shared silicon): efficiency =
+    step_time(1) / step_time(w), so any step-time rise IS the
+    collective/partition overhead XLA inserts as the mesh grows; absolute
+    CPU times are meaningless for TPU.
 
 (c) POD projection — an analytic ICI/DCN roofline for ResNet-50 data
     parallelism on v5e, parameterized by the measured single-chip step time

@@ -131,6 +131,11 @@ class Block(nn.Module):
 
 
 class TransformerLM(nn.Module):
+    # TPU sizing note (measured, docs/benchmarks.md "head_dim and the MXU"):
+    # keep head_dim = dim // heads >= 128. The MXU contracts 128 lanes per
+    # pass, so head_dim 64 runs every attention matmul at half width —
+    # measured 33% tokens/sec swing at dim 1024 between heads=16 (hd 64)
+    # and heads=8 (hd 128), identical FLOPs and params.
     vocab: int = 32000
     dim: int = 512
     heads: int = 8

@@ -78,11 +78,21 @@ class Channel:
             nonce = _secrets.token_bytes(_NONCE_LEN)
             sock.sendall(_MAGIC + nonce)
         else:
-            head = _recv_exact(sock, len(_MAGIC) + _NONCE_LEN)
+            try:
+                head = _recv_exact(sock, len(_MAGIC) + _NONCE_LEN)
+            except (TimeoutError, socket.timeout) as e:
+                # An old (pre-HVD2) server sends nothing until it gets a
+                # request, so a version-skewed peer surfaces as this read
+                # timing out — name the likely cause instead of a bare
+                # "timed out" (a non-hvd peer that sends bytes hits the
+                # magic check below instead).
+                raise ConnectionError(
+                    "no session handshake from peer (timed out): it is "
+                    "either not an hvd service or an older build without "
+                    "replay protection — upgrade both ends") from e
             if head[: len(_MAGIC)] != _MAGIC:
                 raise PermissionError(
-                    "bad handshake magic: peer is not an hvd service "
-                    "(or an older, replay-vulnerable build)")
+                    "bad handshake magic: peer is not an hvd service")
             nonce = head[len(_MAGIC):]
         self._key = hmac.new(key, b"hvd-session:" + nonce,
                              hashlib.sha256).digest()
