@@ -46,7 +46,7 @@ GRID_PRELUDE = textwrap.dedent("""
 
     rank = int(os.environ["HOROVOD_RANK"])
     world = int(os.environ["HOROVOD_SIZE"])
-    L = 2
+    L = int(os.environ.get("TEST_LOCAL_SIZE", "2"))
     topo = Topology(rank, world, rank % L, L, rank // L, world // L)
     hier_ar = os.environ.get("TEST_HIER_ALLREDUCE", "0") == "1"
     hier_ag = os.environ.get("TEST_HIER_ALLGATHER", "0") == "1"
@@ -226,3 +226,76 @@ def test_hierarchical_knob_rides_autotune_broadcast():
     assert all(o["ok"] for o in results)
     states = {o["hier"] for o in results}
     assert len(states) == 1, f"ranks disagree on the hierarchical knob: {results}"
+
+
+@pytest.mark.slow
+def test_hierarchical_2x4_grid_correct():
+    """Bigger geometry: 8 ranks as 2 hosts x 4. The ladder must stay exact
+    (sum oracle) and keep the worst-rank inter-host cut at this shape:
+    flat boundary rank carries 2B(N-1)/N = 1.75B; the ladder spreads
+    2(B/4)(1/2) = B/4 per rank."""
+    script = GRID_PRELUDE + textwrap.dedent("""
+        eng = NativeEngine(topo, cfg)
+        n = 400_000
+        out = eng.run("allreduce", np.full(n, float(rank + 1),
+                                           dtype=np.float32),
+                      "g", average=False)
+        ok = bool(np.allclose(out, float(sum(r + 1 for r in range(world)))))
+        st = eng.stats()
+        eng.shutdown()
+        print(json.dumps({"ok": ok, "cross": st["ring_cross_bytes_sent"],
+                          "hier_on": st["hier_allreduce"],
+                          "payload": n * 4}))
+    """)
+    flat = [r["out"] for r in launch_world(
+        8, script, extra_env={"TEST_HIER_ALLREDUCE": "0",
+                              "TEST_LOCAL_SIZE": "4"}, timeout=300)]
+    hier = [r["out"] for r in launch_world(
+        8, script, extra_env={"TEST_HIER_ALLREDUCE": "1",
+                              "TEST_LOCAL_SIZE": "4"}, timeout=300)]
+    assert all(o["ok"] for o in flat + hier)
+    assert all(o["hier_on"] == 1 for o in hier)
+    L = 4
+    max_flat = max(o["cross"] for o in flat)
+    max_hier = max(o["cross"] for o in hier)
+    assert max_flat >= 1.4 * flat[0]["payload"]
+    assert max_hier <= max_flat / L * 1.10, (max_hier, max_flat)
+
+
+@pytest.mark.slow
+def test_peer_death_mid_hierarchical_fails_cleanly():
+    """Kill a rank mid-stream while the two-level ladder is active: the
+    survivors must error (ring latch + dead-rank coordination), never hang
+    or deliver silently corrupt sums — same contract the flat ring proves
+    in test_ring_engine, now over the local/cross rings."""
+    script = GRID_PRELUDE + textwrap.dedent("""
+        import signal
+        cfg = Config(cycle_time_ms=2.0, hierarchical_allreduce=True,
+                     pinned={"HOROVOD_HIERARCHICAL_ALLREDUCE"})
+        eng = NativeEngine(topo, cfg)
+        out = eng.run("allreduce", np.full(1024, float(rank)), "warm")
+        ok_warm = bool(np.allclose(out, np.mean(range(world))))
+        if rank == 3:
+            os.kill(os.getpid(), signal.SIGKILL)  # die without cleanup
+        results = []
+        for i in range(3):
+            try:
+                eng.run("allreduce", np.full(2_000_000, float(rank)),
+                        f"big{i}", average=False)
+                results.append("ok")
+            except Exception as e:
+                results.append(type(e).__name__ + ":" + str(e)[:80])
+        try:
+            eng.shutdown()
+        except Exception:
+            pass
+        print(json.dumps({"warm": ok_warm, "results": results}))
+    """)
+    res = launch_world(4, script, timeout=300, check=False)
+    assert res[3]["rc"] != 0  # the killed rank
+    for r in res[:3]:
+        assert r["rc"] == 0, f"survivor crashed:\n{r['stderr'][-2000:]}"
+        out = r["out"]
+        assert out is not None, f"survivor printed nothing:\n{r['stderr'][-2000:]}"
+        assert out["warm"] is True
+        assert all(x != "ok" for x in out["results"]), out["results"]
