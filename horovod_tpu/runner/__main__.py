@@ -12,6 +12,47 @@ import argparse
 import sys
 
 
+def check_build() -> int:
+    """Report what this installation supports (reference
+    `horovodrun --check-build`, added upstream after v0.16; here it also
+    probes the native engine build and visible accelerators)."""
+    import importlib.util
+
+    def has(mod: str) -> bool:
+        return importlib.util.find_spec(mod) is not None
+
+    print("horovod_tpu build check")
+    native_err = ""
+    try:
+        from ..cc import lib_path
+
+        path = lib_path()  # triggers the lazy build if needed
+        native = f"yes ({path})"
+    except Exception as e:  # noqa: BLE001 - report, don't crash
+        native = "NO"
+        native_err = f"    ({type(e).__name__}: {e})"
+    print(f"  native eager engine (C++): {native}")
+    if native_err:
+        print(native_err)
+    for label, mod in (("jax (compiled data plane)", "jax"),
+                      ("flax", "flax"), ("optax", "optax"),
+                      ("torch (eager binding)", "torch")):
+        print(f"  {label}: {'yes' if has(mod) else 'NO'}")
+    if has("jax"):
+        try:
+            import jax
+
+            devs = jax.devices()
+            kinds = sorted({d.platform for d in devs})
+            print(f"  devices: {len(devs)} x {'/'.join(kinds)} "
+                  f"({devs[0].device_kind})")
+        except Exception as e:  # noqa: BLE001
+            print(f"  devices: backend init failed ({e})")
+    print("  collectives: allreduce allgather broadcast alltoall "
+          "reducescatter (+ sparse, hierarchical)")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="hvdrun",
@@ -30,9 +71,15 @@ def main(argv=None) -> int:
                              "(hex or raw; default: HOROVOD_AGENT_SECRET env)")
     parser.add_argument("--env", action="append", default=[],
                         metavar="K=V", help="extra env var for workers")
+    parser.add_argument("--check-build", action="store_true",
+                        help="print what this installation can do (native "
+                             "engine, frameworks, devices) and exit — the "
+                             "later-reference `horovodrun --check-build`")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command to run (prefix with --)")
     args = parser.parse_args(argv)
+    if args.check_build:
+        return check_build()
     command = args.command
     if command and command[0] == "--":
         command = command[1:]

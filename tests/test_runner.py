@@ -233,3 +233,20 @@ def test_payload_cap():
                 raise ConnectionError("server closed on oversized claim")
     finally:
         svc.stop()
+
+
+def test_check_build_reports_capabilities():
+    """`hvdrun --check-build` (the later-reference horovodrun flag) must
+    report the native engine and framework availability and exit 0."""
+    from horovod_tpu.cc import lib_path
+
+    lib_path()  # prebuild: the probe must not compile inside the timeout
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "--check-build"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "native eager engine (C++): yes" in proc.stdout
+    assert "jax (compiled data plane): yes" in proc.stdout
+    assert "collectives:" in proc.stdout
