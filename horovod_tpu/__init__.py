@@ -144,6 +144,77 @@ def reducescatter(tensor, average: bool = False, name: str | None = None,
                                average=average)
 
 
+def broadcast_object(obj, root_rank: int = 0, name: str | None = None):
+    """Broadcast an arbitrary picklable Python object from ``root_rank``
+    over the eager engine (the reference grew hvd.broadcast_object after
+    this version, torch/__init__.py upstream; here it is framework-free).
+    Non-root ranks' ``obj`` is ignored; every rank returns root's object.
+
+    Host-side only — objects have no meaning inside jit. The pickle rides
+    the ring as a u8 tensor: one broadcast for the length (objects differ
+    in size per rank, and broadcast requires equal shapes), one for the
+    padded bytes."""
+    import pickle as _pickle
+
+    import numpy as _np
+
+    from .common import basics
+
+    if basics.size() == 1:
+        return obj
+    eng = basics.engine()
+    # Only root serializes: non-root objects are ignored by contract, may
+    # not even be picklable, and broadcast only ever uses root's bytes.
+    # name=None lets the engine auto-name by handle (unique per call,
+    # consistent across ranks when call order matches — same contract as
+    # the raw ops), so concurrent unnamed calls don't collide.
+    if basics.rank() == root_rank:
+        payload = _np.frombuffer(
+            _pickle.dumps(obj, protocol=_pickle.HIGHEST_PROTOCOL),
+            dtype=_np.uint8)
+    else:
+        payload = _np.zeros(0, dtype=_np.uint8)
+    n = eng.run("broadcast", _np.array([payload.size], dtype=_np.int64),
+                f"{name}.len" if name else None, root_rank=root_rank)
+    buf = _np.zeros(int(n[0]), dtype=_np.uint8)
+    buf[: payload.size] = payload
+    out = eng.run("broadcast", buf, f"{name}.bytes" if name else None,
+                  root_rank=root_rank)
+    return _pickle.loads(out.tobytes())
+
+
+def allgather_object(obj, name: str | None = None):
+    """Gather one picklable object per rank; returns [obj_rank0, ...] on
+    every rank (reference hvd.allgather_object, added upstream after this
+    version). Host-side only; rides the ring's RAGGED allgather, so
+    objects may differ in size per rank — no padding round."""
+    import pickle as _pickle
+
+    import numpy as _np
+
+    from .common import basics
+
+    if basics.size() == 1:
+        return [obj]
+    eng = basics.engine()
+    payload = _np.frombuffer(
+        _pickle.dumps(obj, protocol=_pickle.HIGHEST_PROTOCOL), dtype=_np.uint8)
+    # The two gathers have no data dependency — enqueue both so they
+    # negotiate and execute in one engine cycle instead of two.
+    h_len = eng.enqueue("allgather",
+                        _np.array([payload.size], dtype=_np.int64),
+                        f"{name}.len" if name else None)
+    h_bytes = eng.enqueue("allgather", payload,
+                          f"{name}.bytes" if name else None)
+    lens = eng.synchronize(h_len)
+    blob = eng.synchronize(h_bytes)
+    out, off = [], 0
+    for ln in lens.tolist():
+        out.append(_pickle.loads(blob[off:off + int(ln)].tobytes()))
+        off += int(ln)
+    return out
+
+
 def run_on_mesh(fn, mesh=None, axis_name: str = HVD_AXIS, in_specs=None, out_specs=None):
     """shard_map ``fn`` over the (default data-parallel) mesh so the in-jit
     collectives above have their axis in scope. Batch dim 0 is sharded across

@@ -23,6 +23,7 @@ from typing import Any, Iterator, Optional
 
 import torch
 
+from .. import allgather_object, broadcast_object  # noqa: F401
 from ..common.basics import (  # noqa: F401
     init,
     shutdown,

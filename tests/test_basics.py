@@ -143,3 +143,45 @@ def test_comm_subset_multiprocess():
     for m in members:
         np.testing.assert_allclose(m["sum"], [2.0, 2.0, 2.0])
     assert sum(not o["member"] for o in outs) == 2
+
+
+def test_object_collectives_single_process():
+    hvd.init()
+    try:
+        assert hvd.broadcast_object({"a": 1}) == {"a": 1}
+        assert hvd.allgather_object("x") == ["x"]
+    finally:
+        hvd.shutdown()
+
+
+def test_object_collectives_multiprocess():
+    """broadcast_object / allgather_object (post-reference upstream API,
+    framework-free here): arbitrary picklable objects of DIFFERENT sizes
+    per rank ride the ring."""
+    import sys as _sys
+    import textwrap
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from launch_util import launch_world
+
+    script = textwrap.dedent("""
+        import json, os, sys
+        sys.path.insert(0, os.environ["HVD_REPO"])
+        import horovod_tpu as hvd
+
+        import threading
+        hvd.init()
+        r = hvd.rank()
+        # non-root objects are ignored BY CONTRACT: even unpicklable ones
+        got = hvd.broadcast_object({"cfg": [1, 2, 3], "root": "r0"}
+                                   if r == 0 else threading.Lock())
+        objs = hvd.allgather_object({"rank": r, "pad": "x" * (10 * (r + 1))})
+        hvd.shutdown()
+        print(json.dumps({"bcast": got, "ranks": [o["rank"] for o in objs],
+                          "lens": [len(o["pad"]) for o in objs]}))
+    """)
+    for res in launch_world(3, script):
+        out = res["out"]
+        assert out["bcast"] == {"cfg": [1, 2, 3], "root": "r0"}
+        assert out["ranks"] == [0, 1, 2]
+        assert out["lens"] == [10, 20, 30]
