@@ -19,6 +19,7 @@ operations.cc:2384-2401); ``shutdown()`` allows re-init (operations.cc:2424-2432
 from __future__ import annotations
 
 import atexit
+import os
 import threading
 from typing import Optional, Sequence
 
@@ -50,6 +51,68 @@ class _State:
 _state = _State()
 
 
+def _maybe_init_jax_distributed() -> None:
+    """Join the JAX distributed runtime when launched for it.
+
+    This is the compiled plane's world formation — the analog of the
+    reference's MPI_COMM_WORLD + NCCL communicator setup
+    (operations.cc:1728-1797), done once per process before any backend use.
+    The launcher (horovod_tpu.runner) negotiates a coordination-service
+    address on rank 0's host and exports it as HOROVOD_JAX_COORDINATOR;
+    opting in (hvdrun --jax-distributed / run(jax_distributed=True) /
+    HOROVOD_JAX_DISTRIBUTED=1) makes init() federate the processes so
+    ``jax.devices()`` becomes the GLOBAL device list and jitted collectives
+    span process boundaries (N hosts x M local chips, the pod execution
+    shape). Off by default: a single-chip box can't share its chip between
+    workers, and eager/torch-only jobs don't need a JAX backend at all.
+    """
+    if os.environ.get("HOROVOD_JAX_DISTRIBUTED") != "1":
+        return
+    coord = os.environ.get("HOROVOD_JAX_COORDINATOR")
+    if not coord:
+        raise RuntimeError(
+            "HOROVOD_JAX_DISTRIBUTED=1 but no HOROVOD_JAX_COORDINATOR: "
+            "launch through horovod_tpu.runner (hvdrun --jax-distributed), "
+            "or export the coordinator address yourself.")
+    if "HOROVOD_SIZE" not in os.environ or "HOROVOD_RANK" not in os.environ:
+        raise RuntimeError(
+            "HOROVOD_JAX_DISTRIBUTED=1 needs HOROVOD_RANK and HOROVOD_SIZE "
+            "(process_id / num_processes for the JAX runtime); the launcher "
+            "exports them — a hand-rolled launch must too.")
+    import jax
+
+    if jax.distributed.is_initialized():
+        return  # re-init after shutdown(): the runtime outlives the hvd state
+    try:  # diagnostics-only guard on a private API: skip if jax moved it
+        from jax._src import xla_bridge
+
+        backend_up = xla_bridge.backends_are_initialized()
+    except Exception:  # pragma: no cover - jax internals changed
+        backend_up = False
+    if backend_up:  # pragma: no cover - misuse guard
+        raise RuntimeError(
+            "hvd.init() with HOROVOD_JAX_DISTRIBUTED=1 must run before any "
+            "JAX computation: the backend is already initialized, so this "
+            "process can no longer join the multi-process runtime.")
+    # Cross-process collectives on the CPU backend (virtual-device testing,
+    # SURVEY.md §4) ride gloo; a no-op for the TPU backend, which uses ICI/DCN.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - older jaxlib without the option
+        pass
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ["HOROVOD_SIZE"]),
+        process_id=int(os.environ["HOROVOD_RANK"]),
+    )
+    # Log from env, not jax.process_index()/device_count(): those would
+    # force full backend initialization inside init() as a side effect of a
+    # debug message.
+    log("debug",
+        f"joined JAX distributed runtime at {coord} as process "
+        f"{os.environ['HOROVOD_RANK']}/{os.environ['HOROVOD_SIZE']}")
+
+
 def init(comm: Optional[Sequence[int]] = None) -> None:
     """Initialize. ``comm`` may be a list of ranks forming a subset world
     (reference horovod_init with ranks[], operations.cc:2415; mpi4py comms have
@@ -57,6 +120,7 @@ def init(comm: Optional[Sequence[int]] = None) -> None:
     with _state._lock:
         if _state.initialized:
             return
+        _maybe_init_jax_distributed()
         topo = detect()
         if comm is not None:
             if not isinstance(comm, (list, tuple)):

@@ -177,6 +177,39 @@ def broadcast_object(obj, root_rank: int = 0, axis_name: str = HVD_AXIS):
     )
 
 
+def global_array(local_data, spec=None, mesh=None, global_shape=None):
+    """Assemble a process-spanning ``jax.Array`` from this process's local
+    shard — the input half of the multi-process compiled plane.
+
+    Under ``hvdrun --jax-distributed`` every process holds only its slice of
+    the batch (the reference's per-rank DataLoader shard,
+    examples/pytorch_imagenet_resnet50.py DistributedSampler), but a jitted
+    step over the global mesh needs globally-shaped arrays. ``spec`` defaults
+    to row-sharding along the ``'hvd'`` axis; pass ``P()`` for replicated
+    leaves (parameters, optimizer state). Single-process worlds return the
+    committed array unchanged in shape, so training loops are written once.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if mesh is None:
+        from ..common import basics
+
+        mesh = basics.default_mesh()
+    if spec is None:
+        spec = PartitionSpec(HVD_AXIS)
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), local_data, global_shape)
+
+
+def replicate(pytree, mesh=None):
+    """Replicate every leaf of ``pytree`` across the global mesh (params /
+    optimizer state on the multi-process compiled plane)."""
+    from jax.sharding import PartitionSpec
+
+    return jax.tree_util.tree_map(
+        lambda t: global_array(t, spec=PartitionSpec(), mesh=mesh), pytree)
+
+
 def metric_average(value, axis_name: str = HVD_AXIS):
     """Average a scalar metric across ranks (reference MetricAverageCallback,
     _keras/callbacks.py:33-67)."""

@@ -90,7 +90,8 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         timeout: float = 600.0, hosts: Union[str, Sequence, None] = None,
         agent_port: Optional[int] = None,
         agent_secret: Optional[bytes] = None,
-        python: Optional[str] = None) -> list:
+        python: Optional[str] = None,
+        jax_distributed: bool = False) -> list:
     """Run ``fn`` on ``num_proc`` processes; returns [result_rank0, ...]
     (reference horovod.spark.run returns per-rank results ordered by rank,
     spark/__init__.py:195-196).
@@ -98,8 +99,15 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     With ``hosts`` (``"host1:4,host2:4"``; ``@port`` overrides the agent
     port per host), workers are spawned through each host's resident
     hvd-agent daemon instead of locally; ``num_proc`` defaults to the total
-    slot count and must match it if given."""
+    slot count and must match it if given.
+
+    ``jax_distributed=True`` makes each worker's ``hvd.init()`` join the JAX
+    distributed runtime (jax.distributed.initialize against the
+    launcher-negotiated coordinator), so jitted collectives span the workers'
+    combined device mesh — the N-process x M-local-chips pod shape."""
     secret = make_secret()
+    if jax_distributed:
+        env = {**(env or {}), "HOROVOD_JAX_DISTRIBUTED": "1"}
     if hosts is not None:
         spawner = _remote_spawner(hosts, agent_port, agent_secret)
         if num_proc is not None and num_proc != spawner.num_proc:
@@ -157,10 +165,14 @@ def run_command(command: Sequence[str], num_proc: Optional[int] = None,
                 hosts: Union[str, Sequence, None] = None,
                 agent_port: Optional[int] = None,
                 agent_secret: Optional[bytes] = None,
-                python: Optional[str] = None) -> int:
+                python: Optional[str] = None,
+                jax_distributed: bool = False) -> int:
     """Launch ``command`` on worker processes (CLI path); returns the max
     exit code. With ``hosts``, workers are spawned through each host's
-    resident hvd-agent daemon (supervised, so they die with the agent)."""
+    resident hvd-agent daemon (supervised, so they die with the agent).
+    ``jax_distributed`` as in :func:`run`."""
+    if jax_distributed:
+        env = {**(env or {}), "HOROVOD_JAX_DISTRIBUTED": "1"}
     if hosts is not None:
         import time
 

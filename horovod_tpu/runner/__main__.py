@@ -71,6 +71,12 @@ def main(argv=None) -> int:
                              "(hex or raw; default: HOROVOD_AGENT_SECRET env)")
     parser.add_argument("--env", action="append", default=[],
                         metavar="K=V", help="extra env var for workers")
+    parser.add_argument("--jax-distributed", action="store_true",
+                        help="federate workers into one JAX distributed "
+                             "runtime: hvd.init() in each worker joins the "
+                             "launcher-negotiated coordination service, so "
+                             "jitted collectives span all workers' chips "
+                             "(the N-process pod execution shape)")
     parser.add_argument("--check-build", action="store_true",
                         help="print what this installation can do (native "
                              "engine, frameworks, devices) and exit — the "
@@ -102,7 +108,8 @@ def main(argv=None) -> int:
 
     return run_command(command, num_proc=args.num_proc, env=extra_env,
                        hosts=args.hosts, agent_port=args.agent_port,
-                       agent_secret=agent_secret)
+                       agent_secret=agent_secret,
+                       jax_distributed=args.jax_distributed)
 
 
 if __name__ == "__main__":

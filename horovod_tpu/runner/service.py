@@ -39,6 +39,7 @@ class DriverService(BasicService):
         self._ranks: Optional[dict[int, int]] = None  # index -> rank
         self._results: dict[int, Any] = {}
         self.coord_addr: Optional[str] = None
+        self.jax_coord_addr: Optional[str] = None
 
     # -- protocol
 
@@ -50,6 +51,7 @@ class DriverService(BasicService):
                     "host_hash": req["host_hash"],
                     "addresses": req["addresses"],
                     "coord_port": req.get("coord_port", 0),
+                    "jax_coord_port": req.get("jax_coord_port", 0),
                 }
                 if len(self._registrations) == self.num_proc:
                     self._assign_ranks()
@@ -65,7 +67,8 @@ class DriverService(BasicService):
                 rank = self._ranks[req["index"]]
                 topo = self._topology(req["index"], rank)
                 return {"ok": True, "rank": rank, "topology": topo,
-                        "coord_addr": self.coord_addr}
+                        "coord_addr": self.coord_addr,
+                        "jax_coord_addr": self.jax_coord_addr}
         if kind == "get_fn":
             # Function shipping by value (reference CodeRequest +
             # horovod/spark/codec.py, which also uses cloudpickle).
@@ -108,6 +111,14 @@ class DriverService(BasicService):
             if multi_host else next((a for a in addrs if a.startswith("127.")), addrs[0])
         port = reg["coord_port"] or _free_port()
         self.coord_addr = f"{host}:{port}"
+        # Second rendezvous on the same host: the JAX distributed runtime's
+        # coordination service (bound by process 0 inside
+        # jax.distributed.initialize, the analog of the reference's
+        # MPI_COMM_WORLD formation at operations.cc:1728-1797). A separate
+        # port because the eager engine's TCP coordinator and the jitted
+        # plane's gRPC service are independent control planes.
+        jax_port = reg["jax_coord_port"] or _free_port()
+        self.jax_coord_addr = f"{host}:{jax_port}"
 
     def _topology(self, index: int, rank: int) -> dict:
         host = self._registrations[index]["host_hash"]
@@ -214,10 +225,11 @@ class TaskAgent:
             "index": self.index,
             "host_hash": host_hash(),
             "addresses": self._my_addresses(),
-            # Port probed free on THIS host: if this task becomes rank 0 the
+            # Ports probed free on THIS host: if this task becomes rank 0 the
             # driver advertises host:port as the coordinator address (the
             # driver's own host can't probe ports for another machine).
             "coord_port": _free_port(),
+            "jax_coord_port": _free_port(),
         })
         assignment = self.client.request({"kind": "wait_assignment",
                                           "index": self.index})
@@ -231,6 +243,8 @@ class TaskAgent:
         os.environ["HOROVOD_CROSS_RANK"] = str(topo["cross_rank"])
         os.environ["HOROVOD_CROSS_SIZE"] = str(topo["cross_size"])
         os.environ["HOROVOD_COORD_ADDR"] = assignment["coord_addr"]
+        if assignment.get("jax_coord_addr"):
+            os.environ["HOROVOD_JAX_COORDINATOR"] = assignment["jax_coord_addr"]
         return assignment
 
     def run(self) -> Any:

@@ -1,0 +1,133 @@
+"""Worker program for the multi-process compiled-plane tests.
+
+Launched as ``hvdrun -np 2 --jax-distributed -- python mp_train_script.py
+<mode> <out>`` with 4 virtual CPU devices per process: ``hvd.init()`` joins
+the JAX distributed runtime, so the default mesh spans both processes'
+devices — the N-process x M-local-chips pod execution shape the reference
+exercises with ``mpirun -np 2`` in CI (.travis.yml:100-113).
+
+Modes:
+- ``trajectory``: run fused-DistributedOptimizer steps over the combined
+  8-device mesh; write the final params (must match the single-process
+  8-device run bit-for-bit across ranks, and numerically across the
+  process-count change).
+- ``hier``: hierarchical fused allreduce on a ('dcn','ici') mesh whose dcn
+  axis crosses the process boundary; write flat-vs-ladder agreement.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+from jax import shard_map  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+STEPS = 3
+
+
+def loss_fn(params, x, y):
+    pred = x @ params["w"] + params["b"]
+    return ((pred - y) ** 2).mean()
+
+
+def make_problem(n_dev):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n_dev * 4, 6).astype(np.float32)
+    y = rng.randn(n_dev * 4, 2).astype(np.float32)
+    params = {"w": (rng.randn(6, 2) * 0.1).astype(np.float32),
+              "b": np.zeros((2,), np.float32)}
+    return x, y, params
+
+
+def trajectory(out_path):
+    mesh = hvd.default_mesh()
+    n_dev = jax.device_count()
+    x, y, params = make_problem(n_dev)
+    opt = hvd.jax.DistributedOptimizer(optax.adam(1e-2))
+    state = jax.tree_util.tree_map(np.asarray, opt.init(params))
+
+    # Each process holds only its slice of the global batch; global_array
+    # reassembles the process-spanning input (P('hvd') row sharding).
+    rows = x.shape[0] // jax.process_count()
+    lo = jax.process_index() * rows
+    xg = hvd.jax.global_array(x[lo:lo + rows], mesh=mesh)
+    yg = hvd.jax.global_array(y[lo:lo + rows], mesh=mesh)
+    params = hvd.jax.replicate(params, mesh=mesh)
+    state = hvd.jax.replicate(state, mesh=mesh)
+
+    def step(params, state, x, y):
+        grads = jax.grad(loss_fn)(params, x, y)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state
+
+    sstep = jax.jit(shard_map(step, mesh=mesh,
+                              in_specs=(P(), P(), P("hvd"), P("hvd")),
+                              out_specs=(P(), P()), check_vma=False))
+    for _ in range(STEPS):
+        params, state = sstep(params, state, xg, yg)
+    return {"w": np.asarray(params["w"]).tolist(),
+            "b": np.asarray(params["b"]).tolist()}
+
+
+def hier(out_path):
+    from horovod_tpu.parallel import fusion
+    from horovod_tpu.parallel.mesh import hierarchical_mesh
+
+    n_dev = jax.device_count()
+    local = jax.local_device_count()
+    # dcn axis = process boundary, ici axis = this process's local devices:
+    # the two-level ladder's cross-host stage really crosses processes here.
+    mesh = hierarchical_mesh(ici_size=local)
+    rng = np.random.RandomState(1)
+    data = rng.randn(n_dev, 64).astype(np.float32)
+    rows = n_dev // jax.process_count()
+    xg = hvd.jax.global_array(
+        data[jax.process_index() * rows:][:rows],
+        spec=P(("dcn", "ici")), mesh=mesh)
+
+    def flat(v):
+        return jax.lax.psum(v, ("dcn", "ici"))
+
+    def ladder(v):
+        (out,) = fusion.fused_allreduce([v], hierarchical=True,
+                                        op=hvd.ReduceOp.SUM)
+        return out
+
+    runs = {}
+    for name, body in (("flat", flat), ("ladder", ladder)):
+        f = jax.jit(shard_map(body, mesh=mesh,
+                              in_specs=P(("dcn", "ici")),
+                              out_specs=P(("dcn", "ici")),
+                              check_vma=False))
+        runs[name] = np.asarray(
+            jax.device_get(f(xg).addressable_shards[0].data))
+    expect = data.sum(axis=0)
+    return {
+        "agree": bool(np.allclose(runs["flat"], runs["ladder"], rtol=1e-5)),
+        "correct": bool(np.allclose(runs["flat"][0], expect, rtol=1e-4)),
+    }
+
+
+def main():
+    mode, out_path = sys.argv[1], sys.argv[2]
+    hvd.init()
+    assert jax.distributed.is_initialized(), "hvd.init() did not federate JAX"
+    result = {"rank": hvd.rank(), "nproc": jax.process_count(),
+              "ndev": jax.device_count(), "local": jax.local_device_count()}
+    result.update({"trajectory": trajectory, "hier": hier}[mode](out_path))
+    with open(f"{out_path}.{hvd.rank()}", "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -299,3 +299,28 @@ def test_peer_death_mid_hierarchical_fails_cleanly():
         assert out is not None, f"survivor printed nothing:\n{r['stderr'][-2000:]}"
         assert out["warm"] is True
         assert all(x != "ok" for x in out["results"]), out["results"]
+
+
+def test_compiled_ladder_across_process_boundary(tmp_path):
+    """The compiled plane's ('dcn','ici') ladder with the dcn axis crossing a
+    REAL process boundary: 2 processes x 4 virtual CPU devices, jitted
+    hierarchical fused_allreduce == flat psum == numpy oracle (VERDICT r4
+    item 8 — the ladder exercised beyond the single-process mesh)."""
+    import json
+    from horovod_tpu.runner import run_command
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "mp_train_script.py")
+    out = tmp_path / "hier"
+    rc = run_command(
+        [sys.executable, script, "hier", str(out)],
+        num_proc=2,
+        env={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
+        timeout=300.0, jax_distributed=True)
+    assert rc == 0
+    for rank in range(2):
+        with open(f"{out}.{rank}") as f:
+            r = json.load(f)
+        assert r["nproc"] == 2 and r["ndev"] == 8
+        assert r["agree"] is True, "ladder != flat psum across processes"
+        assert r["correct"] is True, "ladder != numpy oracle"
