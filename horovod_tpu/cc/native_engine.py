@@ -111,6 +111,16 @@ class NativeEngine:
                 )
             host, p = addr.rsplit(":", 1)
             port = int(p)
+        # The shm knobs cross into C++ via the env (shm_enabled() /
+        # shm_ring_capacity() read getenv at link-establish time, and are
+        # deliberately uncached so this works on re-init too): export the
+        # Config values so Config(shm=..., shm_bytes=...) behaves like every
+        # other field instead of silently deferring to the ambient env.
+        from ..common.config import clamp_shm_bytes
+
+        os.environ["HOROVOD_SHM"] = "1" if getattr(config, "shm", True) else "0"
+        os.environ["HOROVOD_SHM_BYTES"] = str(
+            clamp_shm_bytes(getattr(config, "shm_bytes", 16 << 20)))
         err = ctypes.create_string_buffer(1024)
         timeline = config.timeline if topo.rank == 0 else ""
         pinned = getattr(config, "pinned", set())
@@ -200,6 +210,7 @@ class NativeEngine:
             "hier_allreduce": int(self._lib.hvd_hier_allreduce_on()),
             "hier_allgather": int(self._lib.hvd_hier_allgather_on()),
             "hier_capable": int(self._lib.hvd_hier_capable()),
+            "shm_links": int(self._lib.hvd_shm_links()),
         }
 
     def timeline_start(self, path: str, mark_cycles: bool = False) -> int:

@@ -194,6 +194,12 @@ int hvd_hier_capable() {
   auto eng = engine();
   return eng ? (eng->hierarchical_capable() ? 1 : 0) : -1;
 }
+// Same-host links upgraded to the shared-memory plane (shm_ring.h); -1 = no
+// engine. The scaling harness and tests read this to prove the upgrade.
+int hvd_shm_links() {
+  auto eng = engine();
+  return eng ? eng->shm_links() : -1;
+}
 
 // Scoped timeline attach (hvd.timeline.trace): returns 1 when this call
 // opened the timeline (caller owns the stop), 0 when one was already

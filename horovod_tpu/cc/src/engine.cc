@@ -138,7 +138,16 @@ Engine::Engine(const Topology& topo, const EngineConfig& cfg)
     std::vector<std::pair<std::string, int>> flat;
     flat.reserve(peers.size());
     for (auto& p : peers) flat.emplace_back(p.host, p.port);
-    ring_.establish(topo_.rank, topo_.size, flat, secret);
+    // Same-host links are OFFERED the shared-memory plane (shm_ring.h); the
+    // nonce handshake inside establish() verifies the peer really shares
+    // /dev/shm before any payload moves. Gating on the coordinator-reported
+    // cross_rank keeps simulated multi-host tests on TCP for their
+    // "cross-host" links, so their byte accounting stays meaningful.
+    int nxt = (topo_.rank + 1) % topo_.size;
+    int prv = (topo_.rank - 1 + topo_.size) % topo_.size;
+    ring_.establish(topo_.rank, topo_.size, flat, secret, 60.0, "hvd-ring",
+                    peers[(size_t)nxt].cross_rank == topo_.cross_rank,
+                    peers[(size_t)prv].cross_rank == topo_.cross_rank);
     hier_ = analyze_hier(peers, topo_.rank);
     if (hier_.capable) {
       // Intra-host ring: position = local_rank among my host's ranks; the
@@ -150,8 +159,9 @@ Engine::Engine(const Topology& topo, const EngineConfig& cfg)
         lp.emplace_back(peers[(size_t)r].host, peers[(size_t)r].local_port);
       for (int r : hier_.cross_group)
         xp.emplace_back(peers[(size_t)r].host, peers[(size_t)r].cross_port);
+      // The local ring is same-host by construction: all links shm-eligible.
       local_ring_.establish(topo_.local_rank, topo_.local_size, lp, secret,
-                            60.0, "hvd-ring-local");
+                            60.0, "hvd-ring-local", true, true);
       cross_ring_.establish(topo_.cross_rank, topo_.cross_size, xp, secret,
                             60.0, "hvd-ring-cross");
       // Every cross-ring send crosses hosts by construction.

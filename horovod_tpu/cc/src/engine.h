@@ -153,6 +153,16 @@ class Engine {
   bool hierarchical_allreduce_on() const { return hier_allreduce_.load(); }
   bool hierarchical_allgather_on() const { return hier_allgather_.load(); }
   bool hierarchical_capable() const { return hier_.capable; }
+  // Links riding the shared-memory plane (0..6: next/prev on each of the
+  // flat/local/cross rings). Tests assert same-host links really upgraded.
+  int shm_links() const {
+    int n = 0;
+    for (const RingLinks* r : {&ring_, &local_ring_, &cross_ring_}) {
+      n += r->shm_next_active() ? 1 : 0;
+      n += r->shm_prev_active() ? 1 : 0;
+    }
+    return n;
+  }
 
   // Scoped timeline attach for hvd.timeline.trace(): start a timeline at
   // runtime when none was configured via HOROVOD_TIMELINE. Returns 1 if

@@ -35,6 +35,7 @@
 
 #include "hvd_common.h"
 #include "net.h"
+#include "shm_ring.h"
 
 namespace hvd {
 
@@ -85,10 +86,18 @@ class RingLinks {
   // `purpose` namespaces the HMAC handshake per ring (flat/local/cross), so
   // a connection that reaches the wrong ring's listener fails auth instead
   // of wiring in a neighbour with mismatched transfer sizes.
+  //
+  // `try_shm_next` / `try_shm_prev`: offer to upgrade that link to the
+  // shared-memory data plane (shm_ring.h). The engine sets these only when
+  // the coordinator-reported topology says the neighbour shares this host;
+  // the nonce handshake inside the negotiation then PROVES it (two machines
+  // with cosplaying cross_ranks fall back to TCP), and HOROVOD_SHM=0
+  // disables the whole path.
   void establish(int rank, int world,
                  const std::vector<std::pair<std::string, int>>& peers,
                  const std::string& secret, double timeout_s = 60.0,
-                 const std::string& purpose = "hvd-ring") {
+                 const std::string& purpose = "hvd-ring",
+                 bool try_shm_next = false, bool try_shm_prev = false) {
     if (world <= 1) return;
     int next = (rank + 1) % world;
     int prev = (rank - 1 + world) % world;
@@ -100,6 +109,35 @@ class RingLinks {
         auth_connect(fd, secret, purpose);
         int32_t my_rank = rank;
         send_all(fd, &my_rank, 4);
+        // --- shm upgrade negotiation (this side produces) ---
+        uint8_t propose = (try_shm_next && shm_enabled()) ? 1 : 0;
+        send_all(fd, &propose, 1);
+        if (propose) {
+          auto nonce = fresh_nonce();
+          std::string name = "/hvd-" + std::to_string(::getpid());
+          for (uint8_t b : fresh_nonce()) {
+            char hex[3];
+            std::snprintf(hex, sizeof(hex), "%02x", b);
+            name += hex;
+          }
+          name = name.substr(0, 32);
+          bool created = false;
+          try {
+            shm_next_.create(name, nonce.data());
+            created = true;
+          } catch (const std::exception&) {
+            // /dev/shm unavailable: withdraw the offer with an empty name.
+            name.clear();
+          }
+          uint8_t len = (uint8_t)name.size();
+          send_all(fd, &len, 1);
+          if (len) send_all(fd, name.data(), len);
+          send_all(fd, nonce.data(), 16);
+          uint8_t ack = 0;
+          recv_all(fd, &ack, 1);
+          if (created) shm_next_.unlink();  // mapped by both (or dead): no leak
+          if (!(created && ack == 1)) shm_next_.close();
+        }
         next_fd_ = fd;
       } catch (const std::exception& ex) {
         conn_error = ex.what();
@@ -141,6 +179,28 @@ class RingLinks {
         }
         int one = 1;
         ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        // --- shm upgrade negotiation (this side consumes) ---
+        try {
+          uint8_t propose = 0;
+          recv_all(fd, &propose, 1);
+          if (propose) {
+            uint8_t len = 0;
+            recv_all(fd, &len, 1);
+            std::string name((size_t)len, '\0');
+            if (len) recv_all(fd, &name[0], len);
+            uint8_t nonce[16];
+            recv_all(fd, nonce, 16);
+            uint8_t ack = 0;
+            if (len && try_shm_prev && shm_enabled() &&
+                shm_prev_.open(name, nonce))
+              ack = 1;
+            send_all(fd, &ack, 1);
+          }
+        } catch (const std::exception&) {
+          shm_prev_.close();
+          ::close(fd);
+          continue;
+        }
         // Handshake done: drop the short deadline; ring transfers use
         // poll-based timeouts of their own (duplex).
         timeval none{0, 0};
@@ -159,6 +219,8 @@ class RingLinks {
   }
 
   void close() {
+    shm_next_.close();
+    shm_prev_.close();
     for (int* fd : {&prev_fd_, &next_fd_, &listen_fd_}) {
       if (*fd >= 0) {
         ::close(*fd);
@@ -168,6 +230,8 @@ class RingLinks {
   }
 
   bool active() const { return next_fd_ >= 0 && prev_fd_ >= 0; }
+  bool shm_next_active() const { return shm_next_.active(); }
+  bool shm_prev_active() const { return shm_prev_.active(); }
 
   // Bill sends on this link to `s` as inter-host traffic (set when the
   // outgoing neighbour has a different cross_rank, or for every link of the
@@ -176,23 +240,134 @@ class RingLinks {
 
   void transfer(const uint8_t* out, size_t n, uint8_t* in, size_t m,
                 RingStats* stats) {
-    duplex(next_fd_, out, n, prev_fd_, in, m);
+    if (!shm_next_.active() && !shm_prev_.active()) {
+      duplex(next_fd_, out, n, prev_fd_, in, m);
+    } else {
+      mixed_duplex(out, n, in, m);
+    }
     if (stats) stats->bytes_sent += n;
     if (cross_stats_) cross_stats_->bytes_sent += n;
   }
   void send(const uint8_t* p, size_t n, RingStats* stats) {
-    send_all(next_fd_, p, n);
+    if (shm_next_.active()) {
+      mixed_duplex(p, n, nullptr, 0);
+    } else {
+      send_all(next_fd_, p, n);
+    }
     if (stats) stats->bytes_sent += n;
     if (cross_stats_) cross_stats_->bytes_sent += n;
   }
-  void recv(uint8_t* p, size_t n) { recv_all(prev_fd_, p, n); }
+  void recv(uint8_t* p, size_t n) {
+    if (shm_prev_.active()) {
+      mixed_duplex(nullptr, 0, p, n);
+    } else {
+      recv_all(prev_fd_, p, n);
+    }
+  }
 
  private:
+  // Bidirectional progress loop over any mix of shm and TCP links. Matches
+  // duplex()'s contract (both neighbours push and pull concurrently, so
+  // serialized blocking would deadlock past the buffering capacity), with
+  // futex parking on the shm side and poll() on the TCP side — no spinning
+  // in either transport, which matters when every rank shares one core.
+  void mixed_duplex(const uint8_t* out, size_t n, uint8_t* in, size_t m) {
+    size_t sent = 0, got = 0;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::seconds(300);
+    while (sent < n || got < m) {
+      bool prog = false;
+      uint32_t prod_seq = 0, cons_seq = 0;
+      if (sent < n) {
+        if (shm_next_.active()) {
+          prod_seq = shm_next_.seq(ShmLink::Side::producer);
+          size_t w = shm_next_.try_produce(out + sent, n - sent);
+          if (w) { sent += w; prog = true; }
+          if (shm_next_.peer_gone())
+            throw std::runtime_error("shm ring peer closed");
+        } else {
+          ssize_t w = ::send(next_fd_, out + sent, n - sent,
+                             MSG_NOSIGNAL | MSG_DONTWAIT);
+          if (w > 0) { sent += (size_t)w; prog = true; }
+          else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                   errno != EINTR)
+            throw std::runtime_error("ring send failed");
+        }
+      }
+      if (got < m) {
+        if (shm_prev_.active()) {
+          cons_seq = shm_prev_.seq(ShmLink::Side::consumer);
+          size_t r = shm_prev_.try_consume(in + got, m - got);
+          if (r) { got += r; prog = true; }
+          if (!r && shm_prev_.peer_gone())
+            throw std::runtime_error("shm ring peer closed");
+        } else {
+          ssize_t r = ::recv(prev_fd_, in + got, m - got, MSG_DONTWAIT);
+          if (r == 0) throw std::runtime_error("ring peer closed");
+          if (r > 0) { got += (size_t)r; prog = true; }
+          else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+            throw std::runtime_error("ring recv failed");
+        }
+      }
+      if (prog) {
+        // Idle timer, not a transfer budget: duplex()'s poll timeout only
+        // fires after 300 s with NO progress, and a slow-but-moving link
+        // must behave the same here.
+        deadline = std::chrono::steady_clock::now() +
+                   std::chrono::seconds(300);
+        continue;
+      }
+      if (std::chrono::steady_clock::now() > deadline)
+        throw std::runtime_error("ring transfer timed out (300s idle)");
+      // Both directions blocked: park on whichever transport is pending.
+      // TCP pending -> poll (also covers the mixed case: 5 ms cap keeps the
+      // shm direction responsive); pure shm -> futex with 100 ms timeout.
+      bool tcp_send = sent < n && !shm_next_.active();
+      bool tcp_recv = got < m && !shm_prev_.active();
+      if (tcp_send || tcp_recv) {
+        pollfd fds[2];
+        int nfds = 0;
+        if (tcp_send) fds[nfds++] = {next_fd_, POLLOUT, 0};
+        if (tcp_recv) fds[nfds++] = {prev_fd_, POLLIN, 0};
+        bool shm_pending = (sent < n && shm_next_.active()) ||
+                           (got < m && shm_prev_.active());
+        if (::poll(fds, (nfds_t)nfds, shm_pending ? 5 : 300) < 0 &&
+            errno != EINTR)
+          throw std::runtime_error("poll failed in ring transfer");
+      } else if (got < m && shm_prev_.active()) {
+        shm_prev_.wait(ShmLink::Side::consumer, cons_seq);
+      } else if (sent < n && shm_next_.active()) {
+        shm_next_.wait(ShmLink::Side::producer, prod_seq);
+      }
+      // Liveness probe of TCP sockets idling under shm-upgraded links: a
+      // SIGKILLed peer never sets peer_gone, but the kernel closes its fds
+      // — without this, death mid-transfer surfaces only at the 300 s idle
+      // deadline (plain-TCP links get ECONNRESET for free). The sockets
+      // carry no payload after the upgrade, so POLLIN here is EOF or a
+      // protocol violation; either way the peer is unusable.
+      pollfd probe[2];
+      int np = 0;
+      if (shm_next_.active() && next_fd_ >= 0)
+        probe[np++] = {next_fd_, 0, 0};  // events=0: HUP/ERR still reported
+      if (shm_prev_.active() && prev_fd_ >= 0)
+        probe[np++] = {prev_fd_, POLLIN, 0};
+      if (np > 0 && ::poll(probe, (nfds_t)np, 0) > 0) {
+        for (int i = 0; i < np; i++) {
+          if (probe[i].revents & (POLLHUP | POLLERR | POLLIN))
+            throw std::runtime_error(
+                "ring peer died (socket closed during shm transfer)");
+        }
+      }
+    }
+  }
+
   int listen_fd_ = -1;
   int prev_fd_ = -1;
   int next_fd_ = -1;
   int port_ = 0;
   RingStats* cross_stats_ = nullptr;
+  ShmLink shm_next_;
+  ShmLink shm_prev_;
 };
 
 // ------------------------------------------------------------ typed arithmetic

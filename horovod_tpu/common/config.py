@@ -54,6 +54,16 @@ DEFAULT_CYCLE_TIME_MS = 5.0
 STALL_WARNING_TIME_S = 60.0
 
 
+def clamp_shm_bytes(v: int) -> int:
+    """Mirror of the native clamp (shm_ring.h shm_ring_capacity): power of
+    two in [64 KiB, 1 GiB], so config() reports the EFFECTIVE capacity."""
+    v = max(1 << 16, min(int(v), 1 << 30))
+    p = 1
+    while p * 2 <= v:
+        p *= 2
+    return p
+
+
 @dataclass
 class Config:
     """Knobs parsed from the environment, one field per reference env var."""
@@ -68,6 +78,20 @@ class Config:
     stall_warning_s: float = STALL_WARNING_TIME_S         # HOROVOD_STALL_WARNING_TIME
     hierarchical_allreduce: bool = False                  # HOROVOD_HIERARCHICAL_ALLREDUCE
     hierarchical_allgather: bool = False                  # HOROVOD_HIERARCHICAL_ALLGATHER
+    # Shared-memory data plane for same-host ring links (cc/src/shm_ring.h;
+    # the reference's NCCL-shm / MPI shared-window intra-host paths,
+    # operations.cc:929-1034). The native binding exports these into the
+    # env right before engine init, so Config(shm=...) works like every
+    # other field whether or not the env var was set.
+    # Env-aware defaults (field factories, unlike the static defaults
+    # above): a directly-constructed Config(cycle_time_ms=...) — the test
+    # idiom — must still honor HOROVOD_SHM=0 from the launcher env, because
+    # the binding UNCONDITIONALLY exports these two back into the env.
+    shm: bool = field(                                    # HOROVOD_SHM (0 disables)
+        default_factory=lambda: os.environ.get("HOROVOD_SHM", "") != "0")
+    shm_bytes: int = field(                               # HOROVOD_SHM_BYTES
+        default_factory=lambda: clamp_shm_bytes(
+            _env_int("HOROVOD_SHM_BYTES", 16 << 20)))
     log_level: str = "warning"                            # HOROVOD_LOG_LEVEL
     log_hide_time: bool = False                           # HOROVOD_LOG_HIDE_TIME
     # Which env vars were explicitly pinned (autotuner must not override,
@@ -87,6 +111,9 @@ class Config:
             stall_warning_s=_env_float("HOROVOD_STALL_WARNING_TIME", STALL_WARNING_TIME_S),
             hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
+            # shm / shm_bytes: omitted — their default_factory already reads
+            # the env, and duplicating the parse here would give two places
+            # for the semantics to drift apart.
             log_level=os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(),
             log_hide_time=_env_bool("HOROVOD_LOG_HIDE_TIME"),
         )

@@ -330,3 +330,48 @@ def test_bf16_native_wire_width():
         assert 0.4 <= ratio <= 0.6, (
             f"bf16 moved {out['bf16_bytes']} vs f32 {out['f32_bytes']} "
             f"(ratio {ratio:.2f}): 16-bit payloads are not at native width")
+
+
+def test_shm_plane_upgrades_same_host_links():
+    """Same-host ring links ride the shared-memory plane (cc/src/shm_ring.h
+    — the reference's NCCL-shm / MPI shared-window intra-host role,
+    operations.cc:929-1034): world 2 on one host upgrades both links, and
+    the payload is correct through the SPSC rings across sizes that
+    exercise wrap-around (segment is 1 MiB here, payloads 4 B..4 MB)."""
+    script = PRELUDE + textwrap.dedent("""
+        eng = NativeEngine(topo, Config(cycle_time_ms=1.0))
+        outs = []
+        for i, n in enumerate((1, 1000, 1_000_001)):
+            out = eng.run("allreduce", np.full(n, float(rank + 1), np.float32),
+                          f"t{i}", average=False)
+            outs.append([float(out[0]), float(out[-1]), int(out.size)])
+        ag = eng.run("allgather", np.array([rank], np.int32), "ag")
+        st = eng.stats()
+        eng.shutdown()
+        print(json.dumps({"outs": outs, "ag": ag.tolist(),
+                          "shm": st["shm_links"]}))
+    """)
+    res = launch_world(2, script, extra_env={"HOROVOD_SHM_BYTES": str(1 << 20)})
+    for r in res:
+        out = r["out"]
+        assert out["shm"] == 2, "same-host links did not upgrade to shm"
+        assert out["outs"] == [[3.0, 3.0, 1], [3.0, 3.0, 1000],
+                               [3.0, 3.0, 1_000_001]]
+        assert out["ag"] == [0, 1]
+
+
+def test_shm_disabled_falls_back_to_tcp():
+    """HOROVOD_SHM=0 keeps every link on TCP (the knob, config.py), with
+    identical results — the fallback path stays exercised."""
+    script = PRELUDE + textwrap.dedent("""
+        eng = NativeEngine(topo, Config(cycle_time_ms=1.0))
+        out = eng.run("allreduce", np.full(5, float(rank + 1), np.float32),
+                      "t0", average=False)
+        st = eng.stats()
+        eng.shutdown()
+        print(json.dumps({"out": out.tolist(), "shm": st["shm_links"]}))
+    """)
+    res = launch_world(2, script, extra_env={"HOROVOD_SHM": "0"})
+    for r in res:
+        assert r["out"]["shm"] == 0
+        assert r["out"]["out"] == [3.0] * 5
