@@ -143,11 +143,11 @@ Engine::Engine(const Topology& topo, const EngineConfig& cfg)
     // /dev/shm before any payload moves. Gating on the coordinator-reported
     // cross_rank keeps simulated multi-host tests on TCP for their
     // "cross-host" links, so their byte accounting stays meaningful.
-    int nxt = (topo_.rank + 1) % topo_.size;
-    int prv = (topo_.rank - 1 + topo_.size) % topo_.size;
+    int next = (topo_.rank + 1) % topo_.size;
+    int prev = (topo_.rank - 1 + topo_.size) % topo_.size;
     ring_.establish(topo_.rank, topo_.size, flat, secret, 60.0, "hvd-ring",
-                    peers[(size_t)nxt].cross_rank == topo_.cross_rank,
-                    peers[(size_t)prv].cross_rank == topo_.cross_rank);
+                    peers[(size_t)next].cross_rank == topo_.cross_rank,
+                    peers[(size_t)prev].cross_rank == topo_.cross_rank);
     hier_ = analyze_hier(peers, topo_.rank);
     if (hier_.capable) {
       // Intra-host ring: position = local_rank among my host's ranks; the
@@ -175,7 +175,6 @@ Engine::Engine(const Topology& topo, const EngineConfig& cfg)
     // ones that fail analyze_hier) the outgoing link crosses hosts iff the
     // next rank reported a different cross_rank — the scaling harness needs
     // the flat baseline's cross bytes to be real there too.
-    int next = (topo_.rank + 1) % topo_.size;
     if (peers[(size_t)next].cross_rank != topo_.cross_rank)
       ring_.set_cross_stats(&cross_stats_);
     hier_allreduce_ = cfg_.hierarchical_allreduce && hier_.capable;
@@ -556,7 +555,7 @@ void Engine::allreduce_buffer(uint8_t* buf, size_t count, size_t esize,
                               DataType d, bool average) {
   if (!(hier_allreduce_.load() && hier_.capable)) {
     ring_allreduce(ring_, topo_.rank, topo_.size, buf, count, esize, d,
-                   average, &stats_);
+                   average, &stats_, &ring_scratch_);
     return;
   }
   int L = topo_.local_size, C = topo_.cross_size;
@@ -564,13 +563,13 @@ void Engine::allreduce_buffer(uint8_t* buf, size_t count, size_t esize,
   auto offs = offsets_of(counts);
   stats_.passes++;
   ring_reduce_scatter(local_ring_, topo_.local_rank, L, buf, counts, offs,
-                      esize, d, &stats_);
+                      esize, d, &stats_, &ring_scratch_);
   uint8_t* mine = buf + offs[(size_t)topo_.local_rank] * esize;
   size_t mine_n = counts[(size_t)topo_.local_rank];
   // average=false here: the division is by the full world size, applied once
   // below (the cross ring's own world is only cross_size).
   ring_allreduce(cross_ring_, topo_.cross_rank, C, mine, mine_n, esize, d,
-                 false, &stats_);
+                 false, &stats_, &ring_scratch_);
   stats_.passes--;  // the cross pass is a stage of this allreduce, not its own
   if (average) scale_chunk(d, mine, mine_n, topo_.size);
   ring_allgather(local_ring_, topo_.local_rank, L, buf, counts, offs, esize,
@@ -745,7 +744,7 @@ void Engine::execute_reducescatter(const ResponseEntry& re, Entry& ent) {
   // Reduce in place over the entry's own buffer (native width, ring.h).
   stats_.passes++;
   ring_reduce_scatter(ring_, topo_.rank, topo_.size, ent.data.data(), counts,
-                      offs, wes, d, &stats_);
+                      offs, wes, d, &stats_, &ring_scratch_);
   size_t mine = counts[(size_t)topo_.rank];
   uint8_t* my_chunk = ent.data.data() + offs[(size_t)topo_.rank] * wes;
   if (re.average) scale_chunk(d, my_chunk, mine, topo_.size);

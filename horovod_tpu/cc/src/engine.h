@@ -237,6 +237,10 @@ class Engine {
   RingStats stats_;
   RingStats cross_stats_;  // bytes whose next hop crosses a host boundary
   FusionBuffer fusion_buf_;
+  // Persistent receive-bounce arena for ring reduce-scatter (single
+  // background executor thread => no locking; grown on demand, reused
+  // across collectives so the hot path never re-faults a fresh scratch).
+  std::vector<uint8_t> ring_scratch_;
   std::unique_ptr<ParameterManager> pm_;  // single-process tuning only
   std::atomic<double> cycle_time_ms_{5.0};
   std::atomic<int64_t> fusion_threshold_{64 << 20};

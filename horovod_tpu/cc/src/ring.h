@@ -454,10 +454,16 @@ inline void scale_chunk(DataType t, uint8_t* p, size_t count, int world) {
 inline void ring_reduce_scatter(RingLinks& links, int rank, int world,
                                 uint8_t* buf, const std::vector<size_t>& counts,
                                 const std::vector<size_t>& offs, size_t esize,
-                                DataType work, RingStats* stats) {
+                                DataType work, RingStats* stats,
+                                std::vector<uint8_t>* scratch_arena = nullptr) {
   size_t max_chunk = 0;
   for (auto c : counts) max_chunk = std::max(max_chunk, c);
-  std::vector<uint8_t> scratch(max_chunk * esize);
+  // The receive bounce buffer: callers on the hot path (the engine) pass a
+  // persistent arena so a 100 MB allreduce doesn't allocate — and re-fault —
+  // a fresh 50 MB scratch every collective.
+  std::vector<uint8_t> local;
+  std::vector<uint8_t>& scratch = scratch_arena ? *scratch_arena : local;
+  if (scratch.size() < max_chunk * esize) scratch.resize(max_chunk * esize);
   auto mod = [&](int v) { return ((v % world) + world) % world; };
   for (int s = 0; s < world - 1; s++) {
     int send_idx = mod(rank - 1 - s);
@@ -491,11 +497,13 @@ inline void ring_allgather(RingLinks& links, int rank, int world, uint8_t* buf,
 // Full ring allreduce: reduce-scatter, scale own chunk (average), allgather.
 inline void ring_allreduce(RingLinks& links, int rank, int world, uint8_t* buf,
                            size_t count, size_t esize, DataType work,
-                           bool average, RingStats* stats) {
+                           bool average, RingStats* stats,
+                           std::vector<uint8_t>* scratch_arena = nullptr) {
   if (stats) stats->passes++;
   auto counts = split_counts(count, world);
   auto offs = offsets_of(counts);
-  ring_reduce_scatter(links, rank, world, buf, counts, offs, esize, work, stats);
+  ring_reduce_scatter(links, rank, world, buf, counts, offs, esize, work, stats,
+                      scratch_arena);
   if (average) {
     scale_chunk(work, buf + offs[(size_t)rank] * esize, counts[(size_t)rank],
                 world);

@@ -83,8 +83,13 @@ inline uint32_t shm_ring_capacity() {
 }
 
 inline bool shm_enabled() {
+  // On unless explicitly disabled; accept the same falsy spellings the
+  // Python boolean knobs do (common/config.py _env_bool).
   const char* env = std::getenv("HOROVOD_SHM");
-  return !(env && std::string(env) == "0");
+  if (!env) return true;
+  std::string v(env);
+  for (auto& c : v) c = (char)std::tolower(c);
+  return !(v == "0" || v == "false" || v == "no");
 }
 
 // One direction of payload between two same-host ranks. The connector of
@@ -174,8 +179,12 @@ class ShmLink {
     std::memcpy(data_ + at, p, first);
     if (take > first) std::memcpy(data_, p + first, take - first);
     hdr_->head.store(head + take, std::memory_order_release);
-    hdr_->head_seq.fetch_add(1, std::memory_order_release);
-    if (hdr_->cons_waiters.load(std::memory_order_acquire) > 0)
+    // seq_cst on the seq bump and the waiters load: with weaker orders the
+    // waiters load could be hoisted above the seq store's visibility and a
+    // consumer that just registered would miss its wake (100 ms stall per
+    // occurrence on weakly-ordered CPUs; x86's LOCK prefix masks it).
+    hdr_->head_seq.fetch_add(1, std::memory_order_seq_cst);
+    if (hdr_->cons_waiters.load(std::memory_order_seq_cst) > 0)
       futex_call(&hdr_->head_seq, FUTEX_WAKE, 1, nullptr);
     return take;
   }
@@ -192,8 +201,8 @@ class ShmLink {
     std::memcpy(p, data_ + at, first);
     if (take > first) std::memcpy(p + first, data_, take - first);
     hdr_->tail.store(tail + take, std::memory_order_release);
-    hdr_->tail_seq.fetch_add(1, std::memory_order_release);
-    if (hdr_->prod_waiters.load(std::memory_order_acquire) > 0)
+    hdr_->tail_seq.fetch_add(1, std::memory_order_seq_cst);  // see try_produce
+    if (hdr_->prod_waiters.load(std::memory_order_seq_cst) > 0)
       futex_call(&hdr_->tail_seq, FUTEX_WAKE, 1, nullptr);
     return take;
   }
@@ -207,8 +216,8 @@ class ShmLink {
         side == Side::producer ? hdr_->tail_seq : hdr_->head_seq;
     std::atomic<uint32_t>& waiters =
         side == Side::producer ? hdr_->prod_waiters : hdr_->cons_waiters;
-    waiters.fetch_add(1, std::memory_order_acq_rel);
-    if (seq.load(std::memory_order_acquire) == observed_seq &&
+    waiters.fetch_add(1, std::memory_order_seq_cst);
+    if (seq.load(std::memory_order_seq_cst) == observed_seq &&
         !hdr_->peer_gone.load(std::memory_order_acquire)) {
       timespec ts{0, 100 * 1000 * 1000};
       futex_call(&seq, FUTEX_WAIT, observed_seq, &ts);

@@ -88,7 +88,8 @@ class Config:
     # idiom — must still honor HOROVOD_SHM=0 from the launcher env, because
     # the binding UNCONDITIONALLY exports these two back into the env.
     shm: bool = field(                                    # HOROVOD_SHM (0 disables)
-        default_factory=lambda: os.environ.get("HOROVOD_SHM", "") != "0")
+        default_factory=lambda: os.environ.get(
+            "HOROVOD_SHM", "").lower() not in ("0", "false", "no"))
     shm_bytes: int = field(                               # HOROVOD_SHM_BYTES
         default_factory=lambda: clamp_shm_bytes(
             _env_int("HOROVOD_SHM_BYTES", 16 << 20)))
