@@ -83,10 +83,11 @@ inline uint32_t shm_ring_capacity() {
 }
 
 inline bool shm_enabled() {
-  // On unless explicitly disabled; accept the same falsy spellings the
-  // Python boolean knobs do (common/config.py _env_bool).
+  // On unless explicitly disabled; same semantics as the Python boolean
+  // knobs (common/config.py _env_bool): unset/empty = default (on), and
+  // "0"/"false"/"no" in any case disable.
   const char* env = std::getenv("HOROVOD_SHM");
-  if (!env) return true;
+  if (!env || !*env) return true;
   std::string v(env);
   for (auto& c : v) c = (char)std::tolower(c);
   return !(v == "0" || v == "false" || v == "no");

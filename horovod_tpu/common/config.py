@@ -41,9 +41,9 @@ def _env_float(name: str, default: float) -> float:
 
 def _env_bool(name: str, default: bool = False) -> bool:
     v = os.environ.get(name)
-    if v is None:
+    if v is None or v == "":  # unset and empty both mean "use the default"
         return default
-    return v.lower() not in ("0", "false", "no", "")
+    return v.lower() not in ("0", "false", "no")
 
 
 # Default tensor fusion threshold: 64 MiB (reference operations.cc:1838).
@@ -88,8 +88,7 @@ class Config:
     # idiom — must still honor HOROVOD_SHM=0 from the launcher env, because
     # the binding UNCONDITIONALLY exports these two back into the env.
     shm: bool = field(                                    # HOROVOD_SHM (0 disables)
-        default_factory=lambda: os.environ.get(
-            "HOROVOD_SHM", "").lower() not in ("0", "false", "no"))
+        default_factory=lambda: _env_bool("HOROVOD_SHM", True))
     shm_bytes: int = field(                               # HOROVOD_SHM_BYTES
         default_factory=lambda: clamp_shm_bytes(
             _env_int("HOROVOD_SHM_BYTES", 16 << 20)))

@@ -105,6 +105,93 @@ class MemmapArrayDataset:
             np.ascontiguousarray(self.labels[idx])
 
 
+class DeviceCache:
+    """Device-resident dataset shard with an in-jit DistributedSampler.
+
+    The TPU-native input pipeline for datasets whose per-rank shard fits
+    HBM (ImageNet's 192 GB decoded-uint8 train split is 750 MB/chip on a
+    v5e-256 pod): upload this rank's shard ONCE, then draw every training
+    batch inside the jitted step — seeded per-epoch reshuffle, on-device
+    gather, on-device uint8->f32 cast. Zero host->device bytes at step
+    time, so the input pipeline cannot become the bottleneck; the
+    reference's real-data recipe (docs/benchmarks.md:40-63) streams per
+    step and relies on loader-worker overlap instead. Measured comparison:
+    docs/benchmarks.md "Real-data input pipeline".
+
+    Shuffle contract — WEAKER than :class:`DistributedSampler`, on
+    purpose: the rank's shard is FIXED at upload, and each epoch reshuffles
+    within it. DistributedSampler reshuffles globally, so a rank's subset
+    changes every epoch (cross-rank example mixing). With many epochs and
+    i.i.d.-sharded data the gradient noise difference is usually
+    negligible — static sharding is the standard trade in device-resident
+    pipelines — but it is a real distribution change: if your training is
+    sensitive to global shuffling (curriculum effects, highly correlated
+    shard contents), re-upload a freshly drawn shard every few epochs or
+    use the streaming path.
+
+    Usage::
+
+        cache = DeviceCache(images_u8, labels, batch_size=128)
+        def train_step(params, opt_state, ctr):
+            x, y, ctr = cache.sample(ctr)          # traced: runs on device
+            ...
+            return params, opt_state, ctr           # carry ctr (donated)
+        ctr = cache.counter()                       # jnp scalar, step 0
+    """
+
+    def __init__(self, images, labels, batch_size: int, seed: int = 0,
+                 normalize: bool = True) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if len(images) != len(labels):
+            raise ValueError(
+                f"images ({len(images)}) / labels ({len(labels)}) mismatch")
+        if len(images) < batch_size:
+            raise ValueError(
+                f"shard of {len(images)} rows cannot fill a batch of "
+                f"{batch_size}")
+        self.data = jnp.asarray(images)  # lands on the default device
+        self.labels = jnp.asarray(np.asarray(labels).astype(np.int32))
+        self.n = int(len(images))
+        self.batch = int(batch_size)
+        self.steps_per_epoch = self.n // self.batch
+        self.key0 = jax.random.PRNGKey(seed)
+        self.normalize = normalize
+
+    def counter(self):
+        """Step counter to thread through (and donate in) the train step."""
+        import jax.numpy as jnp
+
+        return jnp.zeros((), jnp.int32)
+
+    def sample(self, ctr, data=None, labels=None):
+        """Traced batch draw: (x, y, ctr + 1). Epoch e's order is the seeded
+        permutation fold_in(key, e) — every row exactly once per epoch, the
+        reshuffle contract of DistributedSampler.set_epoch.
+
+        For non-toy shards, pass ``cache.data`` / ``cache.labels`` THROUGH
+        your jit boundary as arguments and hand them to this call: a traced
+        function that merely closes over them embeds the whole shard as a
+        compile-time constant (minutes of extra compile and a duplicated
+        copy in HBM for a multi-hundred-MB shard). The closure form (no
+        arguments) is fine for small arrays and tests."""
+        import jax
+        import jax.numpy as jnp
+
+        data = self.data if data is None else data
+        labels = self.labels if labels is None else labels
+        epoch = ctr // self.steps_per_epoch
+        i = ctr % self.steps_per_epoch
+        perm = jax.random.permutation(jax.random.fold_in(self.key0, epoch),
+                                      self.n)
+        idx = jax.lax.dynamic_slice(perm, (i * self.batch,), (self.batch,))
+        x = jnp.take(data, idx, axis=0)
+        if self.normalize and x.dtype == jnp.uint8:
+            x = x.astype(jnp.float32) / 127.5 - 1.0
+        return x, jnp.take(labels, idx, axis=0), ctr + 1
+
+
 def write_synthetic_shards(data_dir: str, n: int, image_shape: Sequence[int],
                            num_classes: int, seed: int = 0,
                            chunk: int = 1024) -> str:

@@ -88,3 +88,50 @@ def test_imagenet_example_trains_from_files(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert os.path.exists(os.path.join(data_dir, "images.npy"))
     assert '"epoch": 2' in proc.stdout
+
+
+def test_device_cache_epoch_contract():
+    """DeviceCache.sample visits every shard row exactly once per epoch in a
+    seeded order that changes across epochs — the in-jit realization of
+    DistributedSampler.set_epoch's reshuffle contract (the device-resident
+    pipeline of docs/benchmarks.md 'Real-data input pipeline')."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.data import DeviceCache
+
+    n, batch = 32, 8
+    images = np.arange(n, dtype=np.uint8).reshape(n, 1, 1, 1)
+    labels = np.arange(n, dtype=np.int64)
+    cache = DeviceCache(images, labels, batch_size=batch, seed=3)
+
+    @jax.jit
+    def draw(ctr):
+        x, y, ctr = cache.sample(ctr)
+        return x, y, ctr
+
+    ctr = cache.counter()
+    epochs = []
+    for _ in range(2):
+        seen = []
+        for _ in range(n // batch):
+            x, y, ctr = draw(ctr)
+            rows = np.asarray(y)
+            # x (uint8, normalize) is the same row id scaled: check pairing
+            np.testing.assert_allclose(
+                np.asarray(x).reshape(batch),
+                rows.astype(np.float32) / 127.5 - 1.0, rtol=1e-6)
+            seen.extend(rows.tolist())
+        assert sorted(seen) == list(range(n))  # exactly once per epoch
+        epochs.append(seen)
+    assert epochs[0] != epochs[1]  # reshuffled across epochs
+    assert int(ctr) == 2 * (n // batch)
+
+
+def test_device_cache_validation():
+    from horovod_tpu.data import DeviceCache
+
+    with pytest.raises(ValueError, match="mismatch"):
+        DeviceCache(np.zeros((4, 1)), np.zeros(3), batch_size=2)
+    with pytest.raises(ValueError, match="cannot fill"):
+        DeviceCache(np.zeros((2, 1)), np.zeros(2), batch_size=4)
