@@ -2,8 +2,8 @@
 # CI entry point (the reference's .travis.yml test step, SURVEY.md §2.7):
 # fast tier + one real launcher end-to-end, then the slow tier if SLOW=1.
 #
-#   ./ci.sh            # fast tests + launcher smoke (~3 min)
-#   SLOW=1 ./ci.sh     # everything
+#   ./ci.sh            # fast tests + launcher smoke (~4 min on a 1-core box)
+#   SLOW=1 ./ci.sh     # everything (adds the re-tiered multi-process e2e set)
 set -euo pipefail
 cd "$(dirname "$0")"
 

@@ -35,6 +35,13 @@ def main():
     parser.add_argument("--num-iters", type=int, default=10)
     parser.add_argument("--num-batches-per-iter", type=int, default=10)
     parser.add_argument("--num-warmup-batches", type=int, default=10)
+    parser.add_argument("--roofline", action="store_true",
+                        help="after the throughput loop, profile the step "
+                             "with the XLA device profiler and print the "
+                             "per-category roofline (bytes/flops/duration "
+                             "aggregation, horovod_tpu/utils/roofline.py — "
+                             "the bench.py --roofline method for any model "
+                             "in the zoo)")
     args = parser.parse_args()
 
     hvd.init()
@@ -100,6 +107,17 @@ def main():
         print(f"Img/sec per device: {img_sec_mean:.1f} +- {img_sec_conf:.1f}")
         print(f"Total img/sec on {n_dev} device(s): "
               f"{n_dev * img_sec_mean:.1f} +- {n_dev * img_sec_conf:.1f}")
+
+    if args.roofline:
+        # EVERY rank must run the collective steps (rank-0-only would
+        # deadlock a multi-process --jax-distributed world); only rank 0
+        # prints its device's report.
+        from horovod_tpu.utils.roofline import format_report, profile_device_ops
+
+        rep = profile_device_ops(lambda: run_batches(1), steps=5)
+        if hvd.rank() == 0:
+            print(format_report(rep))
+
     hvd.shutdown()
 
 

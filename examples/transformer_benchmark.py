@@ -76,7 +76,26 @@ def main():
                         help="comma-separated block_k grid for --sweep-blocks")
     parser.add_argument("--json", action="store_true",
                         help="also print a machine-readable JSON line")
+    parser.add_argument("--bf16-logits", action="store_true",
+                        help="store logits in bf16 (f32 upcast fused into "
+                             "the CE): halves the logits pipeline's HBM "
+                             "traffic — see TransformerLM.logits_dtype for "
+                             "the numerics note")
+    parser.add_argument("--scan-steps", type=int, default=1,
+                        help=">1: run this many optimizer steps per "
+                             "dispatch via lax.scan (no host round-trip "
+                             "between steps; the DeviceCache training-loop "
+                             "shape)")
+    parser.add_argument("--profile", action="store_true",
+                        help="after measuring, profile the step with the XLA "
+                             "device profiler and print the per-op roofline "
+                             "(horovod_tpu/utils/roofline.py) — names where "
+                             "the non-attention time goes")
     args = parser.parse_args()
+    if args.bf16_logits and args.loss_chunk:
+        parser.error("--bf16-logits does not reach the --loss-chunk path "
+                     "(chunked_lm_loss does its own f32 head matmul); "
+                     "drop one of the two flags")
 
     hvd.init()
     mesh = hvd.default_mesh()
@@ -199,7 +218,10 @@ def measure(args, mesh, n_dev, block_q, block_k):
     model = TransformerLM(vocab=args.vocab, dim=args.dim, heads=args.heads,
                           kv_heads=args.kv_heads, layers=args.layers,
                           attention=args.attention, remat=args.remat,
-                          block_q=block_q, block_k=block_k)
+                          block_q=block_q, block_k=block_k,
+                          logits_dtype=(jnp.bfloat16
+                                        if getattr(args, "bf16_logits", False)
+                                        else jnp.float32))
     batch = args.batch_size * n_dev
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, args.vocab,
@@ -220,8 +242,10 @@ def measure(args, mesh, n_dev, block_q, block_k):
             return chunked_lm_loss(hidden, params["lm_head"]["kernel"],
                                    targets, args.loss_chunk)
         logits = model.apply({"params": params}, tokens)
+        # Upcast BEFORE the CE: with bf16 logits the convert fuses into the
+        # CE fusion's read (no extra HBM pass); with f32 it is a no-op.
         return optax.softmax_cross_entropy_with_integer_labels(
-            logits, targets).mean()
+            logits.astype(jnp.float32), targets).mean()
 
     def train_step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
@@ -229,12 +253,45 @@ def measure(args, mesh, n_dev, block_q, block_k):
         params = optax.apply_updates(params, updates)
         return params, opt_state, jax.lax.pmean(loss, hvd.HVD_AXIS)
 
-    step = jax.jit(shard_map(
-        train_step, mesh=mesh,
-        in_specs=(P(), P(), P(hvd.HVD_AXIS)),
-        out_specs=(P(), P(), P()),
-        check_vma=False,
-    ), donate_argnums=(0, 1))
+    scan_steps = int(getattr(args, "scan_steps", 1) or 1)
+    if scan_steps > 1:
+        # K optimizer steps per dispatch via lax.scan: one executable, zero
+        # host round-trips between steps — the shape a DeviceCache-fed
+        # training loop takes, and the measurement that separates device
+        # time from the tunnel's per-dispatch latency. A PRNG key rides the
+        # donated carry (chained ACROSS dispatches, seeded per rank), so
+        # every scan step of every dispatch draws genuinely fresh random
+        # tokens — the loss sits at the no-signal plateau instead of
+        # memorizing reused data.
+        inner = train_step
+
+        def train_step(params, opt_state, key, tokens):  # noqa: F811
+            def body(carry, _):
+                p, o, k = carry
+                k, sub = jax.random.split(k)
+                toks = jax.random.randint(sub, tokens.shape, 0, args.vocab,
+                                          dtype=tokens.dtype)
+                p, o, loss = inner(p, o, toks)
+                return (p, o, k), loss
+
+            (params, opt_state, key), losses = jax.lax.scan(
+                body, (params, opt_state, key), None, length=scan_steps)
+            return params, opt_state, key, losses.mean()
+
+    if scan_steps > 1:
+        step = jax.jit(shard_map(
+            train_step, mesh=mesh,
+            in_specs=(P(), P(), P(), P(hvd.HVD_AXIS)),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        ), donate_argnums=(0, 1, 2))
+    else:
+        step = jax.jit(shard_map(
+            train_step, mesh=mesh,
+            in_specs=(P(), P(), P(hvd.HVD_AXIS)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        ), donate_argnums=(0, 1))
 
     # Median-window methodology shared with bench.py/the autotuner
     # (measure_steps_per_s): chained dispatches per window, one hard sync at
@@ -244,10 +301,14 @@ def measure(args, mesh, n_dev, block_q, block_k):
     from horovod_tpu.jax.autotune import measure_steps_per_s
 
     state = [params, opt_state]
+    if scan_steps > 1:
+        state.append(jax.random.PRNGKey(1000 * hvd.rank() + 17))
     loss_box = [None]
 
     def run():
-        state[0], state[1], loss_box[0] = step(state[0], state[1], tokens)
+        out = step(*state, tokens)
+        state[:] = out[:-1]
+        loss_box[0] = out[-1]
 
     def sync():
         if loss_box[0] is not None:  # --num-warmup 0: nothing to fence yet
@@ -255,6 +316,16 @@ def measure(args, mesh, n_dev, block_q, block_k):
 
     rate = measure_steps_per_s(run, warmup=args.num_warmup,
                                iters=args.num_iters, reps=3, sync=sync)
+    rate *= scan_steps  # a dispatch carries scan_steps optimizer steps
+    if getattr(args, "profile", False):
+        # All ranks run the collective steps (rank-0-only would deadlock a
+        # multi-process world); rank 0 prints.
+        from horovod_tpu.utils.roofline import (format_report,
+                                                profile_device_ops)
+
+        rep = profile_device_ops(run, steps=3, sync=sync)
+        if hvd.rank() == 0:
+            print(format_report(rep))
     return batch * args.seq_len * rate, loss_box[0]
 
 

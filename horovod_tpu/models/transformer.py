@@ -168,6 +168,15 @@ class TransformerLM(nn.Module):
     # sweep per sequence length with transformer_benchmark --sweep-blocks)
     block_q: Optional[int] = None
     block_k: Optional[int] = None
+    # dtype of the lm_head matmul AND the stored logits. f32 (default) is
+    # the conservative choice; bf16 halves the logits pipeline's HBM
+    # traffic (B*T*vocab bytes through head matmul epilogue, reshape,
+    # softmax-CE and its backward — measured ~10% of the 4k batch-1 step,
+    # docs/benchmarks.md r5 rows). With bf16, upcast to f32 BEFORE the
+    # cross entropy (the convert fuses into the CE read, costing no HBM):
+    # the remaining numerics change is the one-time bf16 rounding of the
+    # logit values themselves. Kernel params stay f32 either way.
+    logits_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, tokens, positions=None, return_hidden: bool = False):
@@ -192,7 +201,7 @@ class TransformerLM(nn.Module):
                 name=f"block_{i}",
             )(x, positions)
         x = nn.RMSNorm(dtype=self.dtype)(x)
-        head = nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
+        head = nn.Dense(self.vocab, use_bias=False, dtype=self.logits_dtype,
                         name="lm_head")
         if return_hidden:
             # Long-sequence loss path: the (B, T, vocab) f32 logits dwarf

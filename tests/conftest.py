@@ -16,6 +16,17 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compilation cache: the fast tier is dominated by CPU
+# compiles of the same jitted steps every run; warm runs skip them. Set via
+# env (not jax.config) so SPAWNED WORKER processes (launch_util, runner
+# tests, mp_train_script) inherit it too. First run pays full compiles and
+# populates the cache under .pytest_cache/.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                 ".pytest_cache", "jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -72,6 +72,7 @@ print(json.dumps({"ok": diverged_caught}))
 
 
 @pytest.mark.engine
+@pytest.mark.slow  # re-tiered r5: multi-process spawn cost; core coverage stays fast
 def test_digest_verify_two_ranks():
     """Cross-rank digest check: identical restored state passes, divergent
     state raises on every rank (the docstring-promised guarantee,

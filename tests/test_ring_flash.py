@@ -68,6 +68,7 @@ def test_ring_flash_zigzag_matches_oracle(sp_mesh):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow  # re-tiered r5: multi-process spawn cost; core coverage stays fast
 def test_ring_flash_multiblock_matches_oracle(sp_mesh):
     """Explicit small blocks: t_local=16 with block_q=8/block_k=4 gives a
     2x4 grid per ring step — exercises the scratch carry across k-blocks

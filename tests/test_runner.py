@@ -184,6 +184,7 @@ def test_cli_requires_command():
     assert "no command given" in proc.stderr
 
 
+@pytest.mark.slow  # re-tiered r5: multi-process spawn cost; core coverage stays fast
 def test_run_surfaces_worker_exception():
     """A failing rank must surface its traceback quickly, not a bare
     10-minute TimeoutError (reference spark timeout test, test_spark.py:71)."""

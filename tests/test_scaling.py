@@ -28,11 +28,11 @@ def build_native():
 
 
 def test_eager_sweep_structure_and_sanity():
-    out = sb.eager_scaling(worlds=(2, 4), payload_mb=4.0, iters=2)
+    out = sb.eager_scaling(worlds=(2, 3), payload_mb=4.0, iters=1)
     rows = out["worlds"]
-    assert [r["world"] for r in rows] == [2, 4]
+    assert [r["world"] for r in rows] == [2, 3]
     assert rows[0]["software_efficiency"] == 1.0
-    # Aggregate throughput must not collapse from a world-2 to a world-4
+    # Aggregate throughput must not collapse from a world-2 to a world-3
     # coordinator: anything under half the baseline would mean superlinear
     # software overhead (generous bound — a shared single-core host is noisy).
     assert rows[1]["software_efficiency"] > 0.4, rows
@@ -41,7 +41,7 @@ def test_eager_sweep_structure_and_sanity():
 
 
 def test_eager_hierarchical_grid_cuts_cross_bytes():
-    out = sb.eager_hierarchical(world=4, local=2, payload_mb=4.0, iters=2)
+    out = sb.eager_hierarchical(world=4, local=2, payload_mb=4.0, iters=1)
     assert out["cross_byte_ratio"] <= 1.0 / out["ranks_per_host"] * 1.15, out
 
 

@@ -227,6 +227,7 @@ SPARSE_SCRIPT = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow  # re-tiered r5: multi-process spawn cost; core coverage stays fast
 def test_sparse_embedding_grad_matches_dense_oracle():
     """VERDICT r3 item 5: a torch.nn.Embedding(sparse=True) gradient must
     round-trip the eager ring as (values, indices) — no densification — and
