@@ -332,3 +332,33 @@ def test_compiled_ladder_across_process_boundary(tmp_path):
         assert r["nproc"] == 2 and r["ndev"] == 8
         assert r["agree"] is True, "ladder != flat psum across processes"
         assert r["correct"] is True, "ladder != numpy oracle"
+
+
+def test_flat_ring_mixed_shm_tcp_links():
+    """On a simulated 2x2 grid with hierarchical OFF, the FLAT ring gives
+    boundary ranks one shm link (same-host neighbour) and one TCP link
+    (cross-host neighbour) in the same transfer — the mixed_duplex path of
+    ring.h. Correctness plus the expected per-rank link census: one flat
+    same-host link plus the grid's two intra-host sub-ring links = 3
+    everywhere (the sub-rings are established for the ladder even while
+    the knob is off)."""
+    script = GRID_PRELUDE + textwrap.dedent("""
+        eng = NativeEngine(topo, cfg)
+        out = eng.run("allreduce", np.full(300_000, float(rank + 1),
+                      dtype=np.float32), "g", average=False)
+        expect = float(sum(r + 1 for r in range(world)))
+        st = eng.stats()
+        eng.shutdown()
+        print(json.dumps({"ok": bool(np.allclose(out, expect)),
+                          "shm": st["shm_links"],
+                          "cross": st["ring_cross_bytes_sent"]}))
+    """)
+    res = [r["out"] for r in launch_world(4, script)]
+    assert all(o["ok"] for o in res)
+    # ranks 0,1 share host A; 2,3 share host B. Census per rank: the flat
+    # ring contributes exactly ONE shm link (one of next/prev is same-host
+    # on 0->1->2->3->0) and the grid's intra-host sub-ring contributes two
+    # more (established for the ladder even while the knob is off) = 3.
+    assert [o["shm"] for o in res] == [3, 3, 3, 3], res
+    # and the cross-host hops (1->2, 3->0) still bill inter-host bytes
+    assert sum(o["cross"] > 0 for o in res) == 2, res
