@@ -69,6 +69,9 @@ def test_multiprocess_roundtrip_fresh_process_same_logits(tmp_path):
     ckpt = str(tmp_path / "serve")
 
     def train_fn(ckpt):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")  # no tunneled-TPU init in workers
         import numpy as np
 
         import horovod_tpu as hvd
@@ -101,6 +104,7 @@ def test_multiprocess_roundtrip_fresh_process_same_logits(tmp_path):
     # must be the cross-rank average (mean 0.5, var 1.5), not rank 0's.
     server = (
         "import sys, json; sys.path.insert(0, %r)\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
         "import numpy as np\n"
         "from horovod_tpu.checkpoint import load_for_inference\n"
         "state = load_for_inference(%r)\n"

@@ -294,6 +294,11 @@ def test_bf16_native_wire_width():
     the whole ring, doubling traffic), with f32-per-add precision and NaN
     propagation intact."""
     script = PRELUDE + textwrap.dedent("""
+        # Workers must NOT initialize the tunneled TPU backend: N
+        # concurrent axon inits wedge/time out (environment property —
+        # the same reason conftest forces CPU in-process).
+        import jax
+        jax.config.update("jax_platforms", "cpu")
         import jax.numpy as jnp
         eng = NativeEngine(topo, Config(cycle_time_ms=5.0))
         n = 2_000_000
