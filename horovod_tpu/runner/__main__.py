@@ -39,15 +39,27 @@ def check_build() -> int:
                       ("torch (eager binding)", "torch")):
         print(f"  {label}: {'yes' if has(mod) else 'NO'}")
     if has("jax"):
-        try:
-            import jax
+        # Probe devices in a CHILD with a hard timeout: a wedged accelerator
+        # runtime (dead TPU tunnel, driver hang) blocks jax.devices()
+        # forever, and a diagnostics command must report that, not hang.
+        import subprocess
+        import sys as _sys
 
-            devs = jax.devices()
-            kinds = sorted({d.platform for d in devs})
-            print(f"  devices: {len(devs)} x {'/'.join(kinds)} "
-                  f"({devs[0].device_kind})")
-        except Exception as e:  # noqa: BLE001
-            print(f"  devices: backend init failed ({e})")
+        probe = ("import jax; d = jax.devices(); "
+                 "print(len(d), sorted({x.platform for x in d}), "
+                 "d[0].device_kind)")
+        try:
+            out = subprocess.run([_sys.executable, "-c", probe],
+                                 capture_output=True, text=True, timeout=60)
+            if out.returncode == 0:
+                n, kinds, kind = out.stdout.strip().split(" ", 2)
+                print(f"  devices: {n} x {kinds} ({kind})")
+            else:
+                print(f"  devices: backend init failed "
+                      f"({out.stderr.strip().splitlines()[-1][:120] if out.stderr.strip() else 'no error output'})")
+        except subprocess.TimeoutExpired:
+            print("  devices: backend init HUNG (>60s) — accelerator "
+                  "runtime/tunnel unreachable; CPU-only work is unaffected")
     print("  collectives: allreduce allgather broadcast alltoall "
           "reducescatter (+ sparse, hierarchical)")
     return 0
