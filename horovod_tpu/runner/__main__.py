@@ -43,23 +43,29 @@ def check_build() -> int:
         # runtime (dead TPU tunnel, driver hang) blocks jax.devices()
         # forever, and a diagnostics command must report that, not hang.
         import subprocess
-        import sys as _sys
 
+        # One |-delimited line after a sentinel, so banner noise on stdout
+        # (libtpu/absl) can't confuse the parse.
         probe = ("import jax; d = jax.devices(); "
-                 "print(len(d), sorted({x.platform for x in d}), "
-                 "d[0].device_kind)")
+                 "print('HVDPROBE|%d|%s|%s' % (len(d), "
+                 "'/'.join(sorted({x.platform for x in d})), "
+                 "d[0].device_kind))")
         try:
-            out = subprocess.run([_sys.executable, "-c", probe],
+            out = subprocess.run([sys.executable, "-c", probe],
                                  capture_output=True, text=True, timeout=60)
-            if out.returncode == 0:
-                n, kinds, kind = out.stdout.strip().split(" ", 2)
+            line = next((ln for ln in out.stdout.splitlines()
+                         if ln.startswith("HVDPROBE|")), None)
+            if out.returncode == 0 and line is not None:
+                _, n, kinds, kind = line.split("|", 3)
                 print(f"  devices: {n} x {kinds} ({kind})")
             else:
-                print(f"  devices: backend init failed "
-                      f"({out.stderr.strip().splitlines()[-1][:120] if out.stderr.strip() else 'no error output'})")
+                err = (out.stderr.strip().splitlines() or ["no error output"])[-1]
+                print(f"  devices: backend init failed ({err[:120]})")
         except subprocess.TimeoutExpired:
             print("  devices: backend init HUNG (>60s) — accelerator "
                   "runtime/tunnel unreachable; CPU-only work is unaffected")
+        except Exception as e:  # noqa: BLE001 - report, don't crash
+            print(f"  devices: probe failed ({e})")
     print("  collectives: allreduce allgather broadcast alltoall "
           "reducescatter (+ sparse, hierarchical)")
     return 0
