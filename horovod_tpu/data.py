@@ -137,6 +137,21 @@ class DeviceCache:
             ...
             return params, opt_state, ctr           # carry ctr (donated)
         ctr = cache.counter()                       # jnp scalar, step 0
+
+    Or let :func:`horovod_tpu.jax.make_scan_train_loop` do the sampling
+    AND run K steps per dispatch — there the step takes the batch as
+    arguments instead of drawing it itself::
+
+        def train_step(params, opt_state, x, y):   # batch passed in
+            ...
+            return params, opt_state, loss
+        loop = hvd.jax.make_scan_train_loop(train_step, cache,
+                                            steps_per_dispatch=8)
+        params, opt_state, ctr, loss = loop(
+            params, opt_state, cache.counter(), cache.data, cache.labels)
+
+    Zero host involvement between optimizer steps (amortizes both the
+    per-dispatch and the per-transfer latency of remote-attached chips).
     """
 
     def __init__(self, images, labels, batch_size: int, seed: int = 0,

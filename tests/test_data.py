@@ -135,3 +135,73 @@ def test_device_cache_validation():
         DeviceCache(np.zeros((4, 1)), np.zeros(3), batch_size=2)
     with pytest.raises(ValueError, match="cannot fill"):
         DeviceCache(np.zeros((2, 1)), np.zeros(2), batch_size=4)
+
+
+def test_scan_train_loop_matches_stepwise():
+    """hvd.jax.make_scan_train_loop: K scanned steps per dispatch over a
+    DeviceCache must produce the EXACT trajectory of calling the same
+    train_step K times with the same cache draws — the scan is a dispatch
+    optimization, not a semantic change."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.data import DeviceCache
+
+    n, batch, K = 32, 4, 4
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (n, 3), dtype=np.uint8)
+    labels = (images.sum(axis=1) % 5).astype(np.int64)
+    cache = DeviceCache(images, labels, batch_size=batch, seed=7)
+
+    opt = optax.sgd(0.1)
+    params = {"w": jnp.zeros((3, 5)), "b": jnp.zeros((5,))}
+    state0 = opt.init(params)
+
+    def train_step(p, o, x, y):
+        def loss_fn(p):
+            logits = x @ p["w"] + p["b"]
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        up, o = opt.update(g, o, p)
+        return optax.apply_updates(p, up), o, loss
+
+    # stepwise oracle (no scan, no donation)
+    p_ref, o_ref, ctr = dict(params), state0, cache.counter()
+    losses_ref = []
+    for _ in range(K):
+        x, y, ctr = cache.sample(ctr, cache.data, cache.labels)
+        p_ref, o_ref, loss = train_step(p_ref, o_ref, x, y)
+        losses_ref.append(float(loss))
+
+    loop = hvd.jax.make_scan_train_loop(train_step, cache,
+                                        steps_per_dispatch=K, donate=False)
+    p_s, o_s, ctr_s, mean_loss = loop(dict(params), state0, cache.counter(),
+                                      cache.data, cache.labels)
+    assert int(ctr_s) == K
+    np.testing.assert_allclose(float(mean_loss), np.mean(losses_ref),
+                               rtol=1e-6)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_s[k]), np.asarray(p_ref[k]),
+                                   rtol=1e-6, atol=1e-7)
+
+    # Default donated path: params/opt_state/ctr update in place, and the
+    # cache shard must NOT be donated (a second call reuses it).
+    loop_d = hvd.jax.make_scan_train_loop(train_step, cache,
+                                          steps_per_dispatch=K)
+    p_d, o_d, ctr_d, _ = loop_d(
+        jax.tree_util.tree_map(lambda t: jnp.array(t, copy=True), dict(params)),
+        jax.tree_util.tree_map(lambda t: jnp.array(t, copy=True), state0),
+        cache.counter(), cache.data, cache.labels)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_d[k]), np.asarray(p_ref[k]),
+                                   rtol=1e-6, atol=1e-7)
+    # shard survives donation and a second dispatch continues the epoch
+    p_d, o_d, ctr_d, _ = loop_d(p_d, o_d, ctr_d, cache.data, cache.labels)
+    assert int(ctr_d) == 2 * K
+
+    with pytest.raises(ValueError, match="steps_per_dispatch"):
+        hvd.jax.make_scan_train_loop(train_step, cache, steps_per_dispatch=0)
