@@ -99,8 +99,10 @@ def test_jax_mnist_eager_2proc():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("extra", [[], ["--remat", "--loss-chunk", "16"]],
-                         ids=["full-logits", "remat-chunked"])
+@pytest.mark.parametrize("extra", [[], ["--remat", "--loss-chunk", "16"],
+                                   ["--scan-steps", "3", "--bf16-logits"]],
+                         ids=["full-logits", "remat-chunked",
+                              "scan-bf16-logits"])
 def test_transformer_benchmark_flash_gqa(extra):
     """The tokens/s harness runs end-to-end with flash attention + GQA on
     tiny shapes (interpret-mode kernels on CPU) — both the default
