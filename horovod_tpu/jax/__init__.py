@@ -18,6 +18,15 @@ Parity map to the reference bindings:
 - :func:`metric_average`            ↔ MetricAverageCallback
   (_keras/callbacks.py:33-67).
 
+Beyond the reference (round-5 additions for the multi-process compiled
+plane and device-resident input):
+
+- :func:`global_array` / :func:`replicate` — assemble process-spanning
+  inputs under ``hvdrun --jax-distributed`` (docs/running.md).
+- :func:`make_scan_train_loop` — K optimizer steps per dispatch drawing
+  batches from a :class:`horovod_tpu.data.DeviceCache`; amortizes
+  per-dispatch and per-transfer latency (docs/benchmarks.md r5).
+
 Everything here runs inside shard_map/pmap over a named mesh axis (default
 ``'hvd'``); use horovod_tpu.run_on_mesh / shard_map directly to enter SPMD.
 """
