@@ -32,16 +32,27 @@ import sys
 REFERENCE_PER_DEVICE_IMG_S = 1656.82 / 16.0
 
 
-def _build(fusion_threshold=None, compression=None, hierarchical=False):
+def _smoke_on() -> bool:
+    """HVD_BENCH_SMOKE=1: tiny model, few steps — the CI mode that makes a
+    hanging benchmark fail in seconds instead of eating the harness timeout
+    (BENCH_r05.json rc=124)."""
+    return os.environ.get("HVD_BENCH_SMOKE", "") not in ("", "0")
+
+
+def _build(fusion_threshold=None, compression=None, hierarchical=False,
+           num_buckets=None):
     """Model + jitted train step + fresh state. The knob arguments exist for
     --autotune, which re-builds (re-jits) per candidate config — trace-time
     knobs can only be tuned between traces. ``hierarchical`` runs the
     gradient allreduce as the RS(ici)→psum(dcn)→AG(ici) ladder over the
-    2-D ``('dcn','ici')`` mesh — only meaningful on multi-chip topologies."""
+    2-D ``('dcn','ici')`` mesh — only meaningful on multi-chip topologies.
+    ``num_buckets`` > 1 splits the gradient allreduce into that many
+    reverse-backward-order buckets (the overlap scheduler; None reads
+    HOROVOD_NUM_BUCKETS)."""
     import jax
     import jax.numpy as jnp
     import optax
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     import horovod_tpu as hvd
@@ -83,6 +94,7 @@ def _build(fusion_threshold=None, compression=None, hierarchical=False):
         fusion_threshold=fusion_threshold or tuned_default,
         compression=compression or hvd.Compression.none,
         hierarchical=hierarchical,
+        num_buckets=num_buckets,
     )
     opt_state = opt.init(params)
 
@@ -123,6 +135,137 @@ def _build(fusion_threshold=None, compression=None, hierarchical=False):
         donate_argnums=(0, 1, 2),
     )
     return step, (params, batch_stats, opt_state), (x, y), batch, n_dev
+
+
+def _build_smoke(fusion_threshold=None, num_buckets=None):
+    """Tiny-MLP train step for smoke/CI runs and the CPU --buckets-ab path:
+    same DistributedOptimizer hot path (fuse → psum-per-bucket → unfuse) as
+    the ResNet step, but compiles in seconds. 13 parameter leaves give the
+    bucket planner real material to split."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from horovod_tpu.compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import MLP
+
+    mesh = hvd.default_mesh()
+    n_dev = len(jax.devices())
+    per_dev_batch = int(os.environ.get("HVD_BENCH_BATCH", 8))
+    batch = per_dev_batch * n_dev
+    model = MLP(features=(256, 256, 256, 256, 256, 10))
+    x = jnp.ones((batch, 32 * 32), jnp.float32)
+    y = jnp.zeros((batch,), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x[:2])
+    opt = hvd.jax.DistributedOptimizer(
+        optax.sgd(0.01 * n_dev, momentum=0.9),
+        fusion_threshold=fusion_threshold,
+        num_buckets=num_buckets,
+    )
+    opt_state = opt.init(params)
+
+    def train_step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, jax.lax.pmean(loss, hvd.HVD_AXIS)
+
+    step = jax.jit(
+        shard_map(train_step, mesh=mesh,
+                  in_specs=(P(), P(), P(hvd.HVD_AXIS), P(hvd.HVD_AXIS)),
+                  out_specs=(P(), P(), P()),
+                  check_vma=False),
+        donate_argnums=(0, 1),
+    )
+    return step, (params, opt_state), (x, y), batch, n_dev
+
+
+def buckets_ab_main() -> None:
+    """bench.py --buckets-ab: measure single-bucket vs K-bucket (overlap
+    scheduler) throughput and report the jointly autotuned
+    (fusion_threshold, num_buckets) — the win is measured per platform, not
+    assumed (overlap depends on the XLA scheduler and the fabric; the
+    latency-hiding compile flag rides HOROVOD_LATENCY_HIDING, applied by
+    hvd.init() before the backend spins up).
+
+    Uses the ResNet-50 step on TPU; on CPU (or under HVD_BENCH_SMOKE=1) the
+    tiny-MLP smoke step, so the A/B finishes in well under the harness
+    timeout. Prints one JSON line with both img/s numbers and the winner."""
+    import jax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.jax.autotune import tune
+
+    hvd.init()
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    smoke = _smoke_on() or not on_tpu
+    if smoke:
+        thresholds = (1 << 20, 16 << 20)
+        bucket_grid = (1, 2, 4, 8)
+        warmup, iters, reps, gp_rounds = 2, 5, 2, 1
+    else:
+        thresholds = (64 << 20, 256 << 20)
+        bucket_grid = (1, 2, 4, 8)
+        warmup, iters, reps, gp_rounds = 3, 8, 3, 2
+    batch_box = [0]
+
+    def step_factory(fusion_threshold, num_buckets):
+        if smoke:
+            step, state, (x, y), batch, _ = _build_smoke(
+                fusion_threshold, num_buckets)
+            state = list(state)
+            loss_box = [None]
+
+            def run():
+                p, o, loss_box[0] = step(*state, x, y)
+                state[:] = (p, o)
+        else:
+            step, state, (x, y), batch, _ = _build(
+                fusion_threshold=fusion_threshold, num_buckets=num_buckets)
+            state = list(state)
+            loss_box = [None]
+
+            def run():
+                p, bs, os_, loss_box[0] = step(*state, x, y)
+                state[:] = (p, bs, os_)
+        batch_box[0] = batch
+        return run, lambda: float(loss_box[0])  # window-end hard sync
+
+    report = tune(
+        step_factory,
+        thresholds=thresholds,
+        num_buckets=bucket_grid,
+        warmup=warmup, iters=iters, reps=reps, gp_rounds=gp_rounds,
+        log_path=os.environ.get("HVD_AUTOTUNE_LOG", ""),
+        verbose=True,
+    )
+    print(report.knob_curve(), file=sys.stderr)
+    batch = batch_box[0]
+    singles = [m for m in report.table if m.num_buckets == 1]
+    multis = [m for m in report.table if m.num_buckets > 1]
+    best_single = max(singles, key=lambda m: m.steps_per_s)
+    best_multi = max(multis, key=lambda m: m.steps_per_s)
+    best = report.best
+    print(json.dumps({
+        "metric": "buckets_ab_images_per_sec",
+        "value": round(best.steps_per_s * batch, 2),
+        "unit": "img/s",
+        "smoke": smoke,
+        "single_bucket_img_s": round(best_single.steps_per_s * batch, 2),
+        "bucketed_img_s": round(best_multi.steps_per_s * batch, 2),
+        "bucketed_num_buckets": best_multi.num_buckets,
+        "bucketed_vs_single": round(
+            best_multi.steps_per_s / best_single.steps_per_s, 4),
+        "autotuned": {"fusion_threshold": best.fusion_threshold,
+                      "num_buckets": best.num_buckets},
+    }))
 
 
 def autotune_main() -> None:
@@ -225,6 +368,8 @@ def main() -> None:
 
     if "--autotune" in sys.argv:
         return autotune_main()
+    if "--buckets-ab" in sys.argv:
+        return buckets_ab_main()
     if "--roofline" in sys.argv:
         return roofline_main()
     if "--scaling" in sys.argv:
@@ -237,6 +382,31 @@ def main() -> None:
         return scaling_benchmark.main()
 
     hvd.init()
+    from horovod_tpu.jax.autotune import measure_steps_per_s as _measure
+
+    if _smoke_on():
+        # CI smoke: tiny MLP, a handful of steps, same JSON shape. A hung
+        # collective or compiler surfaces within ci.sh's short timeout
+        # instead of silently eating the harness budget (BENCH_r05 rc=124).
+        step, (params, opt_state), (x, y), batch, n_dev = _build_smoke()
+        state = [params, opt_state]
+        loss_box = [None]
+
+        def run_smoke():
+            p, o, loss_box[0] = step(*state, x, y)
+            state[:] = (p, o)
+
+        rate = _measure(run_smoke, warmup=2, iters=5, reps=2,
+                        sync=lambda: float(loss_box[0]))
+        print(json.dumps({
+            "metric": "resnet50_images_per_sec",
+            "value": round(batch * rate, 2),
+            "unit": "img/s",
+            "smoke": True,
+            "vs_baseline": 0.0,
+        }))
+        return
+
     # Apply tuned winners from --autotune: threshold via
     # HOROVOD_FUSION_THRESHOLD (read in _build) and the ladder via
     # HOROVOD_HIERARCHICAL_ALLREDUCE — the same env knobs the eager engine

@@ -23,6 +23,22 @@ print("wheel contents ok:", whl)
 PY
 rm -rf dist-ci/ build/
 
+echo "== native core builds and loads (regression guard for -lrt/shm_open) =="
+make -C horovod_tpu/cc
+python - <<'PY'
+import ctypes, os
+# A missing -lrt builds cleanly but dies at dlopen with "undefined symbol:
+# shm_open" — load the library here so the link line can't silently regress.
+lib = ctypes.CDLL(os.path.join("horovod_tpu", "cc", "libhvd_core.so"))
+for sym in ("hvd_init", "hvd_pm_create", "hvd_pm_set_num_buckets"):
+    assert hasattr(lib, sym), sym
+print("native core loads ok (shm_open resolved)")
+PY
+
+echo "== bench smoke (tiny model, hard timeout: a hang fails fast, not rc=124 at the harness) =="
+HVD_BENCH_SMOKE=1 timeout -k 10 240 env JAX_PLATFORMS=cpu \
+  python bench.py --buckets-ab
+
 echo "== fast tier (includes the launcher e2e: test_run_happy_path) =="
 python -m pytest tests/ -m fast -q
 

@@ -20,7 +20,7 @@ if os.environ.get("HVD_FORCE_CPU"):  # tests: deterministic off-chip runs
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import shard_map
+from horovod_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd

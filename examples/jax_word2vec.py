@@ -25,7 +25,7 @@ if os.environ.get("HVD_FORCE_CPU"):  # tests: small shapes, virtual devices
     jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from horovod_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd

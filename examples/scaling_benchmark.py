@@ -255,7 +255,7 @@ def compiled_scaling(worlds=(1, 2, 4, 8), global_batch: int = 64,
 
 
 def _timed_compiled_step(mesh, x, steps: int, reps: int,
-                         make_global=None) -> float:
+                         make_global=None, num_buckets=None) -> float:
     """Build the canonical 2-layer TransformerLM DistributedOptimizer step
     over ``mesh``, run it to convergence of timing windows, return the
     median ms/step. ONE implementation shared by the single-process curve
@@ -268,7 +268,7 @@ def _timed_compiled_step(mesh, x, steps: int, reps: int,
     import jax
     import jax.numpy as jnp
     import optax
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     import horovod_tpu as hvd
@@ -279,7 +279,7 @@ def _timed_compiled_step(mesh, x, steps: int, reps: int,
                           dtype=jnp.float32)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((2, x.shape[1]), jnp.int32))
-    opt = hvd.jax.DistributedOptimizer(optax.sgd(0.01))
+    opt = hvd.jax.DistributedOptimizer(optax.sgd(0.01), num_buckets=num_buckets)
     opt_state = opt.init(variables)
 
     def loss_fn(params, xb):
@@ -312,6 +312,43 @@ def _timed_compiled_step(mesh, x, steps: int, reps: int,
         windows.append(time.perf_counter() - t0)
     windows.sort()
     return round(windows[len(windows) // 2] / steps * 1e3, 1)
+
+
+def compiled_buckets_ab(global_batch: int = 64, steps: int = 8,
+                        reps: int = 3, bucket_grid=(2, 4, 8)) -> dict:
+    """Single-bucket vs K-bucket (reverse-order overlap scheduler) A/B of
+    the compiled DistributedOptimizer step on the full virtual mesh — the
+    scaling-harness view of ``bench.py --buckets-ab``: same step, same
+    timing methodology, num_buckets the only variable."""
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+        os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    mesh = Mesh(jax.devices(), ("hvd",))
+    x = jnp.zeros((global_batch, 128), jnp.int32)
+    single_ms = _timed_compiled_step(mesh, x, steps, reps, num_buckets=1)
+    rows = [{"num_buckets": 1, "step_ms": single_ms}]
+    for k in bucket_grid:
+        rows.append({"num_buckets": k,
+                     "step_ms": _timed_compiled_step(mesh, x, steps, reps,
+                                                     num_buckets=k)})
+    best = min(rows[1:], key=lambda r: r["step_ms"])
+    return {
+        "model": "TransformerLM(2L,128d)", "global_batch": global_batch,
+        "mode": "fixed-batch A/B: num_buckets the only variable; "
+                "speedup > 1 = the overlap scheduler pays on this platform",
+        "rows": rows,
+        "best_num_buckets": best["num_buckets"],
+        "bucketed_speedup": round(single_ms / best["step_ms"], 3),
+    }
 
 
 # ------------------------------------ (b2) compiled plane, MULTI-PROCESS
@@ -481,7 +518,7 @@ def main() -> None:
         return
     argv = set(sys.argv[1:])
     run_all = not (argv & {"--eager", "--compiled", "--project", "--hier",
-                           "--compiled-mp"})
+                           "--compiled-mp", "--buckets-ab"})
     out: dict = {}
     if run_all or "--eager" in argv:
         print("eager plane: native ring, worlds 2/4/8/16 ...", file=sys.stderr)
@@ -516,6 +553,19 @@ def main() -> None:
         print(f"  process-boundary overhead: "
               f"{out['compiled_multiprocess']['process_boundary_overhead']:+.1%}",
               file=sys.stderr)
+    if "--buckets-ab" in argv:
+        # A/B only on request (not in run_all): the overlap win is platform
+        # dependent and bench.py --buckets-ab is the canonical surface; this
+        # entry measures the same knob on the scaling harness's step.
+        print("compiled plane: single vs K-bucket overlap A/B ...",
+              file=sys.stderr)
+        out["compiled_buckets_ab"] = compiled_buckets_ab()
+        ab = out["compiled_buckets_ab"]
+        for r in ab["rows"]:
+            print(f"  num_buckets {r['num_buckets']:>2}: "
+                  f"{r['step_ms']:>7.1f} ms/step", file=sys.stderr)
+        print(f"  best K={ab['best_num_buckets']} speedup "
+              f"{ab['bucketed_speedup']:.3f}x", file=sys.stderr)
     if run_all or "--project" in argv:
         out["projection"] = project_pod_efficiency()
         for r in out["projection"]["rows"]:

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import shard_map
+from horovod_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 import horovod_tpu as hvd
@@ -46,7 +46,9 @@ def main():
 
     if args.virtual_devices:
         try:
-            jax.config.update("jax_num_cpu_devices", args.virtual_devices)
+            from horovod_tpu.compat import set_num_cpu_devices
+
+            set_num_cpu_devices(args.virtual_devices)
             jax.config.update("jax_platforms", "cpu")
         except RuntimeError as e:
             raise SystemExit(f"--virtual-devices must be set before jax "

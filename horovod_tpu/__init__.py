@@ -221,7 +221,7 @@ def run_on_mesh(fn, mesh=None, axis_name: str = HVD_AXIS, in_specs=None, out_spe
     the axis by default; everything else replicated."""
     import jax as _jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     if mesh is None:
         mesh = default_mesh()

@@ -41,6 +41,10 @@ def _lib():
     lib.hvd_pm_hier_allreduce.argtypes = [ctypes.c_void_p]
     lib.hvd_pm_hier_allgather.restype = ctypes.c_int
     lib.hvd_pm_hier_allgather.argtypes = [ctypes.c_void_p]
+    lib.hvd_pm_set_num_buckets.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                           ctypes.c_int]
+    lib.hvd_pm_num_buckets.restype = ctypes.c_int
+    lib.hvd_pm_num_buckets.argtypes = [ctypes.c_void_p]
     lib.hvd_gp_fit_predict.restype = ctypes.c_int
     lib.hvd_gp_fit_predict.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_double),
@@ -72,16 +76,23 @@ def gp_fit_predict(X: Sequence[Sequence[float]], y: Sequence[float],
 
 
 class ParameterManager:
-    """Tunes (fusion_threshold, cycle_time_ms) from throughput samples."""
+    """Tunes (fusion_threshold, cycle_time_ms) from throughput samples;
+    pass ``num_buckets`` to open the overlap scheduler's bucket-count
+    dimension and search (fusion_threshold, num_buckets) jointly."""
 
     def __init__(self, fusion_threshold: int = 64 << 20,
                  cycle_time_ms: float = 5.0,
                  threshold_pinned: bool = False, cycle_pinned: bool = False,
+                 num_buckets: Optional[int] = None,
+                 num_buckets_pinned: bool = False,
                  log_path: Optional[str] = None) -> None:
         self._lib = _lib()
         self._h = self._lib.hvd_pm_create(
             fusion_threshold, cycle_time_ms, int(threshold_pinned),
             int(cycle_pinned))
+        if num_buckets is not None:
+            self._lib.hvd_pm_set_num_buckets(self._h, int(num_buckets),
+                                             int(num_buckets_pinned))
         if log_path:
             self._lib.hvd_pm_set_log(self._h, log_path.encode())
 
@@ -100,6 +111,16 @@ class ParameterManager:
     @property
     def cycle_time_ms(self) -> float:
         return float(self._lib.hvd_pm_cycle_time_ms(self._h))
+
+    @property
+    def num_buckets(self) -> int:
+        return int(self._lib.hvd_pm_num_buckets(self._h))
+
+    def set_num_buckets(self, num_buckets: int, pinned: bool = False) -> None:
+        """Seed the overlap scheduler's bucket count and open (default) or
+        pin its joint search dimension."""
+        self._lib.hvd_pm_set_num_buckets(self._h, int(num_buckets),
+                                         int(pinned))
 
     def set_hierarchy(self, allreduce_on: bool, allgather_on: bool,
                       allreduce_pinned: bool = False,

@@ -240,13 +240,18 @@ class ParameterManager {
     // parameters alongside the numeric chain).
     bool hier_allreduce = false;
     bool hier_allgather = false;
+    // Gradient bucket count for the overlap scheduler (HOROVOD_NUM_BUCKETS):
+    // tuned JOINTLY with the fusion threshold — more buckets buy overlap but
+    // pay per-collective launch overhead, and the trade moves with the
+    // threshold, so the two live in one acquisition space.
+    int num_buckets = 1;
   };
 
   ParameterManager(int64_t init_threshold, double init_cycle_ms,
                    bool threshold_pinned, bool cycle_pinned)
-      : bo_(4),
-        current_{init_threshold, init_cycle_ms, false, false},
-        best_{init_threshold, init_cycle_ms, false, false},
+      : bo_(5),
+        current_{init_threshold, init_cycle_ms, false, false, 1},
+        best_{init_threshold, init_cycle_ms, false, false, 1},
         threshold_pinned_(threshold_pinned),
         cycle_pinned_(cycle_pinned) {
     active_ = !(threshold_pinned_ && cycle_pinned_);
@@ -257,6 +262,7 @@ class ParameterManager {
     if (cycle_pinned_) bo_.fix_dim(1, u[1]);
     bo_.fix_dim(2, u[2]);  // categorical dims open via enable_hierarchy_tuning
     bo_.fix_dim(3, u[3]);
+    bo_.fix_dim(4, u[4]);  // bucket dim opens via set_num_buckets(pinned=false)
   }
 
   bool active() const { return active_; }
@@ -275,6 +281,23 @@ class ParameterManager {
     hier_ag_pinned_ = allgather_pinned;
     bo_.fix_dim(2, allreduce_on ? 1.0 : 0.0);
     bo_.fix_dim(3, allgather_on ? 1.0 : 0.0);
+  }
+
+  // Seed the bucket-count knob and open (or pin) its search dimension. The
+  // JAX-side tuner calls this with pinned=false to tune
+  // (fusion_threshold, num_buckets) jointly; callers that only replay a
+  // known-good config pass pinned=true.
+  void set_num_buckets(int v, bool pinned) {
+    if (v < 1) v = 1;
+    if (v > (int)kMaxBuckets) v = (int)kMaxBuckets;
+    current_.num_buckets = best_.num_buckets = v;
+    tune_buckets_ = !pinned;
+    if (pinned) {
+      bo_.fix_dim(4, to_unit(current_)[4]);
+    } else {
+      bo_.unfix_dim(4);
+      active_ = true;
+    }
   }
 
   // Open the categorical dimensions for exploration. Only meaningful on a
@@ -335,13 +358,16 @@ class ParameterManager {
   static constexpr int kMaxRounds = 30;
   static constexpr double kMinThresholdMB = 1.0, kMaxThresholdMB = 256.0;
   static constexpr double kMinCycleMs = 1.0, kMaxCycleMs = 50.0;
+  static constexpr double kMaxBuckets = 64.0;   // log2 span of the bucket dim
 
   std::vector<double> to_unit(const Knobs& k) const {
     double t = std::log2((double)k.fusion_threshold / (1 << 20));
     double lo = std::log2(kMinThresholdMB), hi = std::log2(kMaxThresholdMB);
     return {(t - lo) / (hi - lo),
             (k.cycle_time_ms - kMinCycleMs) / (kMaxCycleMs - kMinCycleMs),
-            k.hier_allreduce ? 1.0 : 0.0, k.hier_allgather ? 1.0 : 0.0};
+            k.hier_allreduce ? 1.0 : 0.0, k.hier_allgather ? 1.0 : 0.0,
+            std::log2((double)std::max(1, k.num_buckets)) /
+                std::log2(kMaxBuckets)};
   }
 
   Knobs from_unit(const std::vector<double>& x) const {
@@ -358,6 +384,14 @@ class ParameterManager {
     // (candidate search covers [0,1], so both branches get explored).
     if (tune_hier_ar_) k.hier_allreduce = x[2] >= 0.5;
     if (tune_hier_ag_) k.hier_allgather = x[3] >= 0.5;
+    if (tune_buckets_) {
+      // Log-spaced like the threshold: the interesting range is 1..8, not
+      // 33..64, and a linear map would spend most of the axis there.
+      k.num_buckets =
+          (int)std::lround(std::pow(2.0, x[4] * std::log2(kMaxBuckets)));
+      if (k.num_buckets < 1) k.num_buckets = 1;
+      if (k.num_buckets > (int)kMaxBuckets) k.num_buckets = (int)kMaxBuckets;
+    }
     return k;
   }
 
@@ -366,10 +400,10 @@ class ParameterManager {
     std::FILE* f = std::fopen(log_path_.c_str(), "a");
     if (!f) return;
     // CSV like the reference autotuner log (parameter_manager.cc:93-99)
-    std::fprintf(f, "%lld,%.3f,%d,%d,%.6f\n",
+    std::fprintf(f, "%lld,%.3f,%d,%d,%d,%.6f\n",
                  (long long)current_.fusion_threshold, current_.cycle_time_ms,
                  current_.hier_allreduce ? 1 : 0, current_.hier_allgather ? 1 : 0,
-                 score);
+                 current_.num_buckets, score);
     std::fclose(f);
   }
 
@@ -378,6 +412,7 @@ class ParameterManager {
   bool threshold_pinned_, cycle_pinned_;
   bool hier_ar_pinned_ = false, hier_ag_pinned_ = false;
   bool tune_hier_ar_ = false, tune_hier_ag_ = false;
+  bool tune_buckets_ = false;
   bool active_ = true;
   int updates_ = 0;
   int warmups_left_ = 3;  // reference: 3 warmup samples discarded

@@ -267,6 +267,14 @@ int hvd_pm_hier_allreduce(void* pm) {
 int hvd_pm_hier_allgather(void* pm) {
   return ((ParameterManager*)pm)->knobs().hier_allgather ? 1 : 0;
 }
+// Bucket-count knob of the overlap scheduler: seed + open (pinned=0) or pin
+// (pinned=1) the joint (threshold, num_buckets) search dimension.
+void hvd_pm_set_num_buckets(void* pm, int num_buckets, int pinned) {
+  ((ParameterManager*)pm)->set_num_buckets(num_buckets, pinned != 0);
+}
+int hvd_pm_num_buckets(void* pm) {
+  return ((ParameterManager*)pm)->knobs().num_buckets;
+}
 
 // One-shot GP fit/predict (n samples of dimension dims, row-major X).
 int hvd_gp_fit_predict(int n, int dims, const double* X, const double* y,

@@ -103,9 +103,10 @@ class RingLinks {
     int prev = (rank - 1 + world) % world;
     std::string conn_error;
     std::thread connector([&] {
+      int fd = -1;
       try {
-        int fd = connect_to(peers[(size_t)next].first, peers[(size_t)next].second,
-                            timeout_s);
+        fd = connect_to(peers[(size_t)next].first, peers[(size_t)next].second,
+                        timeout_s);
         auth_connect(fd, secret, purpose);
         int32_t my_rank = rank;
         send_all(fd, &my_rank, 4);
@@ -141,6 +142,13 @@ class RingLinks {
         next_fd_ = fd;
       } catch (const std::exception& ex) {
         conn_error = ex.what();
+        // The failure path may leave the socket open and a half-negotiated
+        // shm segment mapped AND still linked in /dev/shm (create succeeded,
+        // then send/recv of name/nonce/ack threw before the unlink). Tear
+        // both down here — close() unmaps and unlinks, and is a no-op on an
+        // inactive link — so nothing outlives the error.
+        if (fd >= 0) ::close(fd);
+        shm_next_.close();
       }
     });
     try {
@@ -334,6 +342,12 @@ class RingLinks {
         if (::poll(fds, (nfds_t)nfds, shm_pending ? 5 : 300) < 0 &&
             errno != EINTR)
           throw std::runtime_error("poll failed in ring transfer");
+      } else if (got < m && shm_prev_.active() &&
+                 sent < n && shm_next_.active()) {
+        // Both shm directions blocked: register on both seq words so the
+        // peer's consume of the full out ring also wakes us (ADVICE r5 —
+        // a single-side wait slept through that wake for up to 100 ms).
+        ShmLink::wait_both(shm_prev_, cons_seq, shm_next_, prod_seq);
       } else if (got < m && shm_prev_.active()) {
         shm_prev_.wait(ShmLink::Side::consumer, cons_seq);
       } else if (sent < n && shm_next_.active()) {

@@ -38,6 +38,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <cctype>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
@@ -89,7 +90,9 @@ inline bool shm_enabled() {
   const char* env = std::getenv("HOROVOD_SHM");
   if (!env || !*env) return true;
   std::string v(env);
-  for (auto& c : v) c = (char)std::tolower(c);
+  // unsigned char cast: std::tolower on a negative char (non-ASCII byte in
+  // the env var) is UB.
+  for (auto& c : v) c = (char)std::tolower((unsigned char)c);
   return !(v == "0" || v == "false" || v == "no");
 }
 
@@ -229,6 +232,29 @@ class ShmLink {
   uint32_t seq(Side side) const {
     return (side == Side::producer ? hdr_->tail_seq : hdr_->head_seq)
         .load(std::memory_order_acquire);
+  }
+
+  // Park when BOTH directions of a mixed transfer are blocked at once (out
+  // ring full AND in ring empty — distinct segments, so two futex words).
+  // Registers as a waiter on both words: each peer then issues its wake, and
+  // the pre-sleep re-check of BOTH seqs catches any progress made between
+  // the failed try_* and the park. FUTEX_WAIT is single-address, so the
+  // sleep itself parks on the consumer word with a 5 ms cap (matching the
+  // mixed shm+TCP poll cap in ring.h) — a producer-side wake that lands
+  // while parked costs at most the cap, not the 100 ms single-side timeout.
+  static void wait_both(ShmLink& cons, uint32_t cons_seq,
+                        ShmLink& prod, uint32_t prod_seq) {
+    cons.hdr_->cons_waiters.fetch_add(1, std::memory_order_seq_cst);
+    prod.hdr_->prod_waiters.fetch_add(1, std::memory_order_seq_cst);
+    if (cons.hdr_->head_seq.load(std::memory_order_seq_cst) == cons_seq &&
+        prod.hdr_->tail_seq.load(std::memory_order_seq_cst) == prod_seq &&
+        !cons.hdr_->peer_gone.load(std::memory_order_acquire) &&
+        !prod.hdr_->peer_gone.load(std::memory_order_acquire)) {
+      timespec ts{0, 5 * 1000 * 1000};
+      futex_call(&cons.hdr_->head_seq, FUTEX_WAIT, cons_seq, &ts);
+    }
+    prod.hdr_->prod_waiters.fetch_sub(1, std::memory_order_acq_rel);
+    cons.hdr_->cons_waiters.fetch_sub(1, std::memory_order_acq_rel);
   }
 
   bool peer_gone() const {

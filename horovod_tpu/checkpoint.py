@@ -41,10 +41,20 @@ def save(path: str, state: Any, step: Optional[int] = None, force: bool = True) 
     # Uninitialized == single-process (a plain post-training export script);
     # rank 0 writes, and only a multi-rank world needs the barrier.
     if not basics.is_initialized() or basics.rank() == 0:
+        import jax
+
         ocp = _ocp()
         ckptr = ocp.StandardCheckpointer()
         target = os.path.join(os.path.abspath(path), f"step_{step}") \
             if step is not None else os.path.abspath(path)
+        # numpy SCALARS (np.int64(7) epoch counters and friends) are not
+        # ndarrays, and orbax's StandardCheckpointHandler rejects them on
+        # some versions ("Unsupported type: <class 'numpy.int64'>") — lift
+        # them to 0-d arrays, which restore round-trips (int() on a 0-d
+        # array works) and every orbax accepts.
+        state = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
+            state)
         ckptr.save(target, state, force=force)
         ckptr.wait_until_finished()
     if basics.is_initialized() and basics.size() > 1:

@@ -23,7 +23,7 @@ import os
 import threading
 from typing import Optional, Sequence
 
-from .config import Config
+from .config import Config, _env_bool, enable_latency_hiding_scheduler
 from .topology import Topology, detect, num_devices, num_local_devices
 from ..utils.logging import log
 
@@ -81,7 +81,9 @@ def _maybe_init_jax_distributed() -> None:
             "exports them — a hand-rolled launch must too.")
     import jax
 
-    if jax.distributed.is_initialized():
+    from ..compat import distributed_is_initialized
+
+    if distributed_is_initialized():
         return  # re-init after shutdown(): the runtime outlives the hvd state
     try:  # diagnostics-only guard on a private API: skip if jax moved it
         from jax._src import xla_bridge
@@ -120,6 +122,10 @@ def init(comm: Optional[Sequence[int]] = None) -> None:
     with _state._lock:
         if _state.initialized:
             return
+        if _env_bool("HOROVOD_LATENCY_HIDING"):
+            # Must happen before anything touches the XLA backend (detect()
+            # below counts devices): jax snapshots XLA_FLAGS at first use.
+            enable_latency_hiding_scheduler()
         _maybe_init_jax_distributed()
         topo = detect()
         if comm is not None:

@@ -55,6 +55,14 @@ def _resolved_threshold(fusion_threshold):
     return Config.from_env().fusion_threshold
 
 
+def _resolved_num_buckets(num_buckets):
+    """None -> the HOROVOD_NUM_BUCKETS env knob (default 1 = single fused
+    buffer; K > 1 = reverse-backward-order overlap buckets)."""
+    if num_buckets is not None:
+        return max(1, int(num_buckets))
+    return Config.from_env().num_buckets
+
+
 def allreduce_gradients(
     grads,
     axis_name: str = HVD_AXIS,
@@ -62,11 +70,16 @@ def allreduce_gradients(
     compression: type[Compressor] = Compression.none,
     fusion_threshold: int | None = None,
     hierarchical: bool = False,
+    num_buckets: int | None = None,
 ):
     """Fused allreduce of a gradient pytree (the DistributedOptimizer hot
     path). ``fusion_threshold=None`` reads HOROVOD_FUSION_THRESHOLD (default
-    64 MiB) so the env knob tunes the compiled path like the reference's."""
+    64 MiB) so the env knob tunes the compiled path like the reference's;
+    ``num_buckets=None`` reads HOROVOD_NUM_BUCKETS the same way (K > 1
+    issues one collective per reverse-backward-order bucket so XLA can
+    overlap communication with the rest of the backward pass)."""
     fusion_threshold = _resolved_threshold(fusion_threshold)
+    num_buckets = _resolved_num_buckets(num_buckets)
     ctx_box = {}
 
     def compress(buf):
@@ -85,6 +98,7 @@ def allreduce_gradients(
         compress=compress if compression is not Compression.none else None,
         decompress=decompress if compression is not Compression.none else None,
         hierarchical=hierarchical,
+        num_buckets=num_buckets,
     )
 
 
@@ -96,6 +110,7 @@ def DistributedOptimizer(
     fusion_threshold: int | None = None,
     hierarchical: bool = False,
     backward_passes_per_step: int = 1,
+    num_buckets: int | None = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so that ``update()`` first averages gradients
     across the mesh axis, exactly where the reference wraps
@@ -104,6 +119,14 @@ def DistributedOptimizer(
     ``backward_passes_per_step > 1`` accumulates that many local microbatch
     gradients before one fused allreduce + inner update (reference
     torch/__init__.py:71-93), cutting collective frequency by the same factor.
+
+    ``num_buckets`` (or HOROVOD_NUM_BUCKETS) > 1 splits that allreduce into
+    K reverse-backward-order buckets so XLA can overlap early buckets'
+    communication with the remaining backward compute — composes with
+    ``backward_passes_per_step`` (buckets split the one post-accumulation
+    allreduce) and with ``hierarchical`` (each bucket rides the
+    RS→psum→AG ladder independently). Autotuned jointly with
+    ``fusion_threshold`` by ``bench.py --buckets-ab`` / jax.autotune.tune.
     """
 
     def update_fn(grads, state, params=None, **extra):
@@ -114,6 +137,7 @@ def DistributedOptimizer(
             compression=compression,
             fusion_threshold=fusion_threshold,
             hierarchical=hierarchical,
+            num_buckets=num_buckets,
         )
         return optimizer.update(reduced, state, params, **extra)
 

@@ -51,10 +51,12 @@ class Measurement:
     branch: dict
     fusion_threshold: int
     steps_per_s: float
+    num_buckets: int = 1
 
     @property
     def config(self) -> dict:
-        return {**self.branch, "fusion_threshold": self.fusion_threshold}
+        return {**self.branch, "fusion_threshold": self.fusion_threshold,
+                "num_buckets": self.num_buckets}
 
 
 @dataclass
@@ -64,12 +66,19 @@ class TuneReport:
 
     def knob_curve(self) -> str:
         """Human-readable measured knob curve for docs/logs."""
-        lines = ["branch | fusion_threshold | steps/s"]
+        with_buckets = any(m.num_buckets != 1 for m in self.table)
+        head = "branch | fusion_threshold | steps/s"
+        if with_buckets:
+            head = "branch | fusion_threshold | num_buckets | steps/s"
+        lines = [head]
         for m in sorted(self.table,
-                        key=lambda m: (str(m.branch), m.fusion_threshold)):
+                        key=lambda m: (str(m.branch), m.fusion_threshold,
+                                       m.num_buckets)):
             b = ",".join(f"{k}={v}" for k, v in sorted(m.branch.items())) or "-"
-            lines.append(f"{b} | {m.fusion_threshold >> 20} MiB | "
-                         f"{m.steps_per_s:.2f}")
+            mid = f"{m.fusion_threshold >> 20} MiB | "
+            if with_buckets:
+                mid += f"{m.num_buckets} | "
+            lines.append(f"{b} | {mid}{m.steps_per_s:.2f}")
         return "\n".join(lines)
 
 
@@ -140,9 +149,62 @@ def _ei_suggest(measured: dict[int, float], lo: int, hi: int) -> Optional[int]:
     return cand_best
 
 
+def _ei_suggest_joint(measured: dict[tuple[int, int], float],
+                      th_bounds: tuple[int, int],
+                      nb_bounds: tuple[int, int]) -> Optional[tuple[int, int]]:
+    """2-D EI over (fusion_threshold, num_buckets), keys (threshold, buckets).
+
+    Both knobs are log2-mapped and normalized to [0, 1] per dimension before
+    the GP fit — the native squared-exponential kernel has one fixed length
+    scale, so raw log2 coordinates (threshold spans ~8 octaves, buckets ~6)
+    would weight the dimensions arbitrarily. The suggestion is the argmax of
+    expected improvement over a candidate grid, skipping near-duplicates of
+    measured configs."""
+    from ..autotune import gp_fit_predict
+
+    if len(measured) < 3:            # a plane needs 3 points before EI helps
+        return None
+    t_lo, t_hi = math.log2(th_bounds[0]), math.log2(th_bounds[1])
+    b_lo, b_hi = math.log2(max(1, nb_bounds[0])), math.log2(max(1, nb_bounds[1]))
+    t_span = (t_hi - t_lo) or 1.0
+    b_span = (b_hi - b_lo) or 1.0
+
+    def unit(th, nb):
+        return [(math.log2(th) - t_lo) / t_span,
+                (math.log2(max(1, nb)) - b_lo) / b_span]
+
+    X = [unit(th, nb) for th, nb in measured]
+    ys = list(measured.values())
+    mean = sum(ys) / len(ys)
+    std = (sum((y - mean) ** 2 for y in ys) / len(ys)) ** 0.5 or 1.0
+    yn = [(y - mean) / std for y in ys]
+    best = max(yn)
+    cand_best, ei_best = None, 1e-6
+    t_steps, b_steps = 17, max(2, int(b_span) * 2 + 1)
+    for i in range(t_steps):
+        tx = i / (t_steps - 1)
+        th = int(round(2 ** (t_lo + tx * t_span)))
+        for j in range(b_steps):
+            bx = j / (b_steps - 1)
+            nb = int(round(2 ** (b_lo + bx * b_span)))
+            q = unit(th, nb)
+            if any(abs(q[0] - p[0]) < 0.05 and abs(q[1] - p[1]) < 0.05
+                   for p in X):
+                continue
+            try:
+                mu, sigma = gp_fit_predict(X, yn, q)
+            except RuntimeError:
+                return None
+            ei = _expected_improvement(mu, sigma, best)
+            if ei > ei_best:
+                cand_best, ei_best = (th, nb), ei
+    return cand_best
+
+
 def tune(step_factory: Callable[..., Callable[[], None]],
          thresholds: Sequence[int] = DEFAULT_THRESHOLDS,
          branches: Optional[Sequence[dict]] = None,
+         num_buckets: Optional[Sequence[int]] = None,
          warmup: int = 2, iters: int = 5, reps: int = 3,
          gp_rounds: int = 2, log_path: Optional[str] = None,
          verbose: bool = False) -> TuneReport:
@@ -155,40 +217,68 @@ def tune(step_factory: Callable[..., Callable[[], None]],
     ``(run, sync)`` pair where ``run`` dispatches asynchronously and
     ``sync`` fences at window ends (re-jitting inside the factory is
     expected — that IS the tuning mechanism for trace-time knobs).
+
+    ``num_buckets``: a seed grid of overlap bucket counts (e.g. ``(1, 4,
+    8)``) switches the search to the JOINT (fusion_threshold, num_buckets)
+    space — the seed measurements cover the cross product and the GP/EI
+    refinement runs in 2-D (mirroring the native ParameterManager's 5-dim
+    acquisition, autotuner.h). The factory is then called with an extra
+    ``num_buckets=`` kwarg; when the argument is None (default) the factory
+    signature and the log format stay exactly as before.
     """
     branches = list(branches) if branches is not None else [{}]
+    tune_buckets = num_buckets is not None
+    bucket_grid = tuple(num_buckets) if tune_buckets else (1,)
     table: list[Measurement] = []
     log_rows = []
 
-    def run(branch: dict, th: int) -> Measurement:
-        made = step_factory(fusion_threshold=th, **branch)
+    def run(branch: dict, th: int, nb: int = 1) -> Measurement:
+        kw = dict(branch)
+        if tune_buckets:
+            kw["num_buckets"] = nb
+        made = step_factory(fusion_threshold=th, **kw)
         step, sync = made if isinstance(made, tuple) else (made, None)
         rate = measure_steps_per_s(step, warmup, iters, reps, sync=sync)
-        m = Measurement(branch, th, rate)
+        m = Measurement(branch, th, rate, nb)
         table.append(m)
         token = ";".join(f"{k}={v}" for k, v in sorted(branch.items())) or "-"
-        log_rows.append(f"{token},{th},{rate:.4f}")
+        if tune_buckets:
+            log_rows.append(f"{token},{th},{nb},{rate:.4f}")
+        else:
+            log_rows.append(f"{token},{th},{rate:.4f}")
         if verbose:
             import sys
 
-            print(f"  autotune: {branch} threshold={th >> 20}MiB "
-                  f"-> {rate:.2f} steps/s", file=sys.stderr, flush=True)
+            buckets_txt = f" buckets={nb}" if tune_buckets else ""
+            print(f"  autotune: {branch} threshold={th >> 20}MiB"
+                  f"{buckets_txt} -> {rate:.2f} steps/s",
+                  file=sys.stderr, flush=True)
         return m
 
     for branch in branches:
-        measured: dict[int, float] = {}
+        measured: dict[tuple[int, int], float] = {}
         for th in thresholds:
-            measured[th] = run(branch, th).steps_per_s
+            for nb in bucket_grid:
+                measured[(th, nb)] = run(branch, th, nb).steps_per_s
         lo, hi = min(thresholds), max(thresholds)
         for _ in range(gp_rounds):
-            nxt = _ei_suggest(measured, lo, hi)
+            if tune_buckets:
+                nxt = _ei_suggest_joint(
+                    measured, (lo, hi), (min(bucket_grid), max(bucket_grid)))
+            else:
+                flat = {th: v for (th, _), v in measured.items()}
+                th_next = _ei_suggest(flat, lo, hi)
+                nxt = (th_next, 1) if th_next is not None else None
             if nxt is None or nxt in measured:
                 break
-            measured[nxt] = run(branch, nxt).steps_per_s
+            measured[nxt] = run(branch, *nxt).steps_per_s
 
     table.sort(key=lambda m: -m.steps_per_s)
     if log_path:
         with open(log_path, "w") as f:
-            f.write("branch,fusion_threshold,steps_per_s\n")
+            if tune_buckets:
+                f.write("branch,fusion_threshold,num_buckets,steps_per_s\n")
+            else:
+                f.write("branch,fusion_threshold,steps_per_s\n")
             f.write("\n".join(log_rows) + "\n")
     return TuneReport(best=table[0], table=table)

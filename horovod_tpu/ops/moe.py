@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..compat import axis_size
+
 EP_AXIS = "ep"
 
 
@@ -77,7 +79,7 @@ def moe_apply(params: MoEParams, x, capacity: int, axis_name: str = EP_AXIS):
     inside shard_map with tokens sharded and experts sharded over
     ``axis_name``. Differentiable end to end (all_to_all transposes to the
     reverse exchange)."""
-    ep = lax.axis_size(axis_name)
+    ep = axis_size(axis_name)
     e_local, d, _h = params.w_in.shape
     n_experts = ep * e_local
 

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..compat import axis_size
+
 
 def _block_update(q, k, v, o, m, l, q_pos, k_pos, scale):
     """One flash-attention accumulation step with global causal masking.
@@ -114,7 +116,7 @@ def ring_attention(q, k, v, axis_name: str, zigzag: bool = False):
     on the fly (the flash variant in ring_flash.py aliases the shared head
     in-kernel instead).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
     kvh = k.shape[2]
@@ -188,7 +190,7 @@ def ulysses_attention(q, k, v, axis_name: str, impl: str = "dense"):
     all-to-all each shard holds the FULL sequence, which is exactly the
     regime the fused kernel exists for (the dense schedule materializes
     the (T, T) logits and stops compiling around seq 8k)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     h = q.shape[2]
     kvh = k.shape[2]
     if h % n != 0:

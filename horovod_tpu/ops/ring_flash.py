@@ -43,6 +43,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..compat import axis_size
+
 from .flash_attention import (
     DEFAULT_BLOCK_K,
     DEFAULT_BLOCK_Q,
@@ -314,7 +316,7 @@ def ring_flash_attention(q, k, v, axis_name: str, zigzag: bool = False,
 
 
 def _rf_fwd(q, k, v, axis_name, zigzag, block_q, block_k, interpret):
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
     h, hkv, group = _gqa_group(q, k, v)
@@ -359,7 +361,7 @@ def _rf_fwd(q, k, v, axis_name, zigzag, block_q, block_k, interpret):
 
 def _rf_bwd(axis_name, zigzag, block_q, block_k, interpret, res, dout):
     q, k, v, out_r, lse = res
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
     h, hkv, group = _gqa_group(q, k, v)

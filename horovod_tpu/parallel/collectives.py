@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .mesh import HVD_AXIS, DCN_AXIS, ICI_AXIS
+from ..compat import axis_size
 
 
 class ReduceOp(Enum):
@@ -66,6 +67,22 @@ def allreduce(x, axis_name: str = HVD_AXIS, op: ReduceOp = ReduceOp.AVERAGE):
     raise ValueError(f"unknown op {op}")
 
 
+def bucketed_allreduce(buffers: Sequence, axis_name: str = HVD_AXIS,
+                       op: ReduceOp = ReduceOp.AVERAGE) -> list:
+    """One independent collective per flat bucket buffer, in ISSUE order.
+
+    The buffers come from fusion.build_plan's reverse-backward-order split:
+    bucket 0 holds the last layers' gradients, which the backward pass
+    produces first, so its psum's operand is ready while the rest of the
+    backward compute is still running. Each psum is emitted as its own op
+    (no jnp-level dependency between buckets), which is exactly the shape
+    XLA's latency-hiding scheduler (config.enable_latency_hiding_scheduler)
+    needs to overlap the ICI transfer of early buckets with the remaining
+    compute — the compiled-plane analog of Horovod's background thread
+    starting allreduces mid-backward (operations.cc PerformOperation)."""
+    return [allreduce(b, axis_name, op) for b in buffers]
+
+
 def grouped_allreduce(xs, axis_name: str = HVD_AXIS, op: ReduceOp = ReduceOp.AVERAGE):
     """Allreduce a pytree in one logical group — the collective-launch analog
     of the reference's tensor fusion (operations.cc:2154-2266). XLA merges the
@@ -97,7 +114,7 @@ def reducescatter(x, axis_name: str = HVD_AXIS, scatter_dim: int = 0, average: b
     (the reference uses ReduceScatter only internally, operations.cc:1350)."""
     out = lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dim, tiled=True)
     if average:
-        out = out / lax.axis_size(axis_name)
+        out = out / axis_size(axis_name)
     return out
 
 
@@ -114,7 +131,7 @@ def ppermute(x, perm: Sequence[tuple[int, int]], axis_name: str = HVD_AXIS):
 
 def ring_shift(x, axis_name: str = HVD_AXIS, shift: int = 1):
     """Shift values around the axis ring by ``shift`` positions."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm=perm)
 
@@ -136,7 +153,7 @@ def sparse_allreduce(values, indices, axis_name: str = HVD_AXIS,
     into the dense parameter. When ``average``, values are pre-divided by
     world size like the reference."""
     if average:
-        values = values / lax.axis_size(axis_name)
+        values = values / axis_size(axis_name)
     all_values = lax.all_gather(values, axis_name, axis=0, tiled=True)
     all_indices = lax.all_gather(indices, axis_name, axis=0, tiled=True)
     return all_values, all_indices
@@ -160,5 +177,5 @@ def hierarchical_allreduce(
     reduced = lax.psum(scattered, dcn_axis)
     out = lax.all_gather(reduced, ici_axis, axis=0, tiled=True)
     if average:
-        out = out / (lax.axis_size(ici_axis) * lax.axis_size(dcn_axis))
+        out = out / (axis_size(ici_axis) * axis_size(dcn_axis))
     return out

@@ -29,6 +29,7 @@ import numpy as np
 from . import collectives
 from .mesh import HVD_AXIS
 from ..common.config import DEFAULT_FUSION_THRESHOLD
+from ..compat import axis_size
 
 
 @dataclass(frozen=True)
@@ -44,24 +45,58 @@ class FusionPlan:
     """Static bucketing of a pytree's leaves: list of buckets, each a tuple of
     leaf descriptors with the same dtype, total bytes ≤ threshold (single
     oversize leaves get their own bucket, as in the reference where a tensor
-    larger than the threshold is sent unfused)."""
+    larger than the threshold is sent unfused).
+
+    Bucket order is ISSUE order: the collective for ``buckets[0]`` is
+    emitted first. With ``reverse_order`` (the K-bucket overlap plan) that
+    is reverse backward order — last-layer gradients, which the backward
+    pass produces first, ride the first collective, mirroring the order
+    Horovod's background thread naturally enqueues them in."""
 
     treedef: Any
     buckets: tuple[tuple[_Leaf, ...], ...]
     pad_to: int = 1     # pad each buffer length to a multiple (hierarchical RS)
+    reverse_order: bool = False
 
     @property
     def num_buckets(self) -> int:
         return len(self.buckets)
 
 
-def build_plan(tree, threshold: int = DEFAULT_FUSION_THRESHOLD, pad_to: int = 1) -> FusionPlan:
+def _leaf_descs(tree) -> tuple[list[_Leaf], Any]:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     descs = []
     for i, leaf in enumerate(leaves):
         shape = tuple(leaf.shape)
         dtype = jnp.asarray(leaf).dtype if not hasattr(leaf, "dtype") else leaf.dtype
         descs.append(_Leaf(i, shape, jnp.dtype(dtype), int(np.prod(shape)) if shape else 1))
+    return descs, treedef
+
+
+def build_plan(tree, threshold: int = DEFAULT_FUSION_THRESHOLD, pad_to: int = 1,
+               num_buckets: int = 1) -> FusionPlan:
+    """Plan the bucketing of ``tree``'s leaves.
+
+    ``num_buckets <= 1`` (default): the historical single-pass greedy
+    same-dtype merge up to ``threshold``, in forward tree_flatten order —
+    fewest, largest collectives (reference operations.cc:2154-2266).
+
+    ``num_buckets = K > 1``: the overlap plan. Leaves are walked in REVERSE
+    tree_flatten order (last-layer gradients first — the order the backward
+    pass produces them in) and packed into ~K byte-balanced same-dtype
+    buckets. Issuing one independent collective per bucket in this order
+    lets XLA's latency-hiding scheduler start allreducing early buckets
+    while the rest of the backward compute is still in flight — the
+    compiled-plane expression of Horovod's background-thread overlap
+    (PAPER.md L1; same design point as PyTorch DDP's reverse-order
+    gradient buckets). ``threshold`` remains a hard cap on bucket bytes,
+    so the two knobs compose: K sets the minimum split, the threshold
+    bounds each piece."""
+    descs, treedef = _leaf_descs(tree)
+    if num_buckets > 1:
+        buckets = _reverse_order_buckets(descs, num_buckets, threshold)
+        return FusionPlan(treedef, tuple(tuple(b) for b in buckets), pad_to,
+                          reverse_order=True)
 
     # Greedy same-dtype packing in deterministic order (reference merges only
     # matching dtype/device responses, operations.cc:2165-2207).
@@ -83,6 +118,53 @@ def build_plan(tree, threshold: int = DEFAULT_FUSION_THRESHOLD, pad_to: int = 1)
         buckets.append(cur[key])
     buckets.sort(key=lambda b: b[0].index)
     return FusionPlan(treedef, tuple(tuple(b) for b in buckets), pad_to)
+
+
+def _reverse_order_buckets(descs: Sequence[_Leaf], num_buckets: int,
+                           threshold: int) -> list[list[_Leaf]]:
+    """K-way byte-balanced split in reverse leaf order (overlap plan).
+
+    Greedy over leaves from last to first: a bucket closes when it reaches
+    the balanced target (total/K) while earlier buckets remain in budget, or
+    when the dtype changes (buffers are concatenated, so a bucket is
+    single-dtype), or when adding the leaf would blow the ``threshold`` cap.
+    The final bucket absorbs any remainder, so the plan yields exactly K
+    buckets for a single-dtype tree with >= K leaves and at most a few more
+    across dtype transitions — never a silent merge back to one."""
+    remaining = sum(d.size * d.dtype.itemsize for d in descs)
+    buckets: list[list[_Leaf]] = []
+    cur: list[_Leaf] = []
+    cur_bytes = 0
+
+    def target() -> int:
+        # Re-balance over what's left (current bucket included): a static
+        # total/K target lets a bucket that lands just under it swallow the
+        # next one's share and the plan quietly underfills K.
+        left = num_buckets - len(buckets)
+        return max(1, -(-(cur_bytes + remaining) // max(1, left)))   # ceil
+
+    for d in reversed(descs):
+        nbytes = d.size * d.dtype.itemsize
+        # Pre-add close: dtype change, threshold cap, or a leaf that would
+        # overshoot the balanced target by more than the bucket's current
+        # shortfall (2*cur + n > 2*target) — without the last rule a K much
+        # larger than the leaf count silently merges leaves that should
+        # each get their own bucket.
+        if cur and (cur[0].dtype != d.dtype
+                    or (threshold > 0 and cur_bytes + nbytes > threshold)
+                    or (2 * cur_bytes + nbytes > 2 * target()
+                        and len(buckets) < num_buckets - 1)):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(d)
+        cur_bytes += nbytes
+        remaining -= nbytes
+        if cur_bytes >= target() and len(buckets) < num_buckets - 1:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+    if cur:
+        buckets.append(cur)
+    return buckets
 
 
 def fuse(tree, plan: FusionPlan) -> list:
@@ -123,11 +205,16 @@ def fused_allreduce(
     hierarchical: bool = False,
     ici_axis: str = "ici",
     dcn_axis: str = "dcn",
+    num_buckets: int = 1,
 ):
     """The Horovod fast path: fuse → (compress) → one collective per bucket →
     (decompress) → unfuse. ``compress``/``decompress`` are dtype casts from
     horovod_tpu.compression (reference tensorflow/compression.py:FP16Compressor).
-    """
+
+    ``num_buckets > 1`` switches to the reverse-backward-order overlap plan
+    (build_plan): K independent collectives, issued last-layer-first, each
+    becoming schedulable as soon as its bucket's gradients exist — the knob
+    the A/B bench and the autotuner drive (HOROVOD_NUM_BUCKETS)."""
     pad_to = 1
     if hierarchical and op not in (collectives.ReduceOp.SUM,
                                    collectives.ReduceOp.AVERAGE):
@@ -146,24 +233,23 @@ def fused_allreduce(
             raise ValueError(
                 f"hierarchical fusion needs the size of axis {ici_axis!r}: "
                 f"call inside shard_map/pmap or under `with mesh:`")
-    plan = build_plan(tree, threshold, pad_to=pad_to)
+    plan = build_plan(tree, threshold, pad_to=pad_to, num_buckets=num_buckets)
     buffers = fuse(tree, plan)
-    out = []
-    for buf in buffers:
-        orig_dtype = buf.dtype
-        if compress is not None:
-            buf = compress(buf)
-        if hierarchical:
-            reduced = collectives.hierarchical_allreduce(
+    orig_dtypes = [buf.dtype for buf in buffers]
+    if compress is not None:
+        buffers = [compress(buf) for buf in buffers]
+    if hierarchical:
+        reduced = [
+            collectives.hierarchical_allreduce(
                 buf, ici_axis=ici_axis, dcn_axis=dcn_axis,
-                average=(op == collectives.ReduceOp.AVERAGE),
-            )
-        else:
-            reduced = collectives.allreduce(buf, axis_name, op)
-        if decompress is not None:
-            reduced = decompress(reduced, orig_dtype)
-        out.append(reduced)
-    return unfuse(out, plan)
+                average=(op == collectives.ReduceOp.AVERAGE))
+            for buf in buffers
+        ]
+    else:
+        reduced = collectives.bucketed_allreduce(buffers, axis_name, op)
+    if decompress is not None:
+        reduced = [decompress(r, dt) for r, dt in zip(reduced, orig_dtypes)]
+    return unfuse(reduced, plan)
 
 
 def _axis_size(axis_name: str):
@@ -176,7 +262,7 @@ def _axis_size(axis_name: str):
     raises its actionable "pass ici_axis_size=" ValueError instead of an
     ImportError at trace time."""
     try:
-        return int(jax.lax.axis_size(axis_name))
+        return int(axis_size(axis_name))
     except NameError:
         pass
     try:

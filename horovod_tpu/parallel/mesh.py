@@ -28,6 +28,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from ..compat import axis_size
+
 HVD_AXIS = "hvd"
 DCN_AXIS = "dcn"
 ICI_AXIS = "ici"
@@ -112,4 +114,4 @@ def mesh_size(mesh_or_axis, axis_name: str | None = None) -> int:
     ``jax.lax.axis_size``)."""
     if isinstance(mesh_or_axis, Mesh):
         return mesh_or_axis.shape[axis_name or HVD_AXIS]
-    return jax.lax.axis_size(mesh_or_axis)
+    return axis_size(mesh_or_axis)

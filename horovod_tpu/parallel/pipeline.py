@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..compat import axis_size
+
 PP_AXIS = "pp"
 
 
@@ -61,7 +63,7 @@ def pipeline_apply(
       stages hold garbage of the right shape; callers typically
       ``psum``/select the last stage's value or compute the loss there).
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     stage_idx = lax.axis_index(axis_name)
     n_micro = microbatches.shape[0]
     n_ticks = n_micro + n_stages - 1
@@ -110,7 +112,7 @@ def last_stage_value(x, axis_name: str = PP_AXIS):
     """Broadcast the last stage's value to every stage (e.g. the pipeline
     output or the loss): zero elsewhere + psum. For REPORTING only — to
     differentiate a pipeline loss, use :func:`masked_last_stage_loss`."""
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     is_last = lax.axis_index(axis_name) == n_stages - 1
     return lax.psum(jnp.where(is_last, x, jnp.zeros_like(x)), axis_name)
 
@@ -124,6 +126,6 @@ def masked_last_stage_loss(loss_value, axis_name: str = PP_AXIS):
     scaling gradients by the stage count. With the mask, the summed
     per-device losses equal the true loss exactly once, and the ppermute
     transposes route the cotangents back through the reverse pipeline."""
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     is_last = lax.axis_index(axis_name) == n_stages - 1
     return jnp.where(is_last, loss_value, jnp.zeros_like(loss_value))

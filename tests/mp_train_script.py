@@ -28,7 +28,7 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import optax  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
-from jax import shard_map  # noqa: E402
+from horovod_tpu.compat import shard_map  # noqa: E402
 
 import horovod_tpu as hvd  # noqa: E402
 
@@ -121,7 +121,9 @@ def hier(out_path):
 def main():
     mode, out_path = sys.argv[1], sys.argv[2]
     hvd.init()
-    assert jax.distributed.is_initialized(), "hvd.init() did not federate JAX"
+    from horovod_tpu.compat import distributed_is_initialized
+
+    assert distributed_is_initialized(), "hvd.init() did not federate JAX"
     result = {"rank": hvd.rank(), "nproc": jax.process_count(),
               "ndev": jax.device_count(), "local": jax.local_device_count()}
     result.update({"trajectory": trajectory, "hier": hier}[mode](out_path))

@@ -91,7 +91,7 @@ def test_compiled_path_tuner_measures_and_picks():
     import jax.numpy as jnp
     import numpy as np
     import optax
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     import horovod_tpu as hvd

@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from horovod_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from horovod_tpu.parallel import collectives, fusion
@@ -229,7 +229,7 @@ def test_hierarchical_allgather(mesh_2x4):
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from horovod_tpu.parallel import collectives
@@ -255,7 +255,7 @@ def test_sparse_allreduce(mesh8):
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from horovod_tpu.parallel import collectives

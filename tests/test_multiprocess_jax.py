@@ -62,7 +62,7 @@ def test_two_process_trajectory_matches_single_process(tmp_path, mesh8):
         import mp_train_script as mp
     finally:
         sys.path.pop(0)
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     import jax
     from jax.sharding import PartitionSpec as P
 

@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from horovod_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.ops.ring_attention import (
