@@ -361,6 +361,52 @@ def roofline_main() -> None:
     print(json.dumps(out))
 
 
+def _emit_metrics_snapshot(run, sync, steps_per_s=None) -> None:
+    """bench.py --metrics: exercise both data planes' telemetry and print
+    the pod-aggregated snapshot as one extra JSON line (ISSUE 2).
+
+    - compiled plane: the benchmarked step already recorded its fusion-plan
+      gauges at trace time (bucket count/bytes, occupancy, planned overlap
+      bound); a short profiled window adds the MEASURED overlap-efficiency
+      gauge on backends whose traces carry device spans (TPU).
+    - eager plane: a few engine allreduces (the per-epoch metric-averaging
+      pattern every training loop runs) populate the per-collective
+      count/bytes/latency histograms.
+    - aggregation: every rank's snapshot is allgathered over the engine and
+      rank 0 prints the merged pod view (single-process worlds merge their
+      own snapshot, same shape).
+    """
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import metrics as hvd_metrics
+
+    from horovod_tpu.common import basics
+
+    if steps_per_s is not None:
+        hvd_metrics.registry().gauge(
+            "horovod_steps_per_sec",
+            help="measured training steps per second").set(steps_per_s)
+    overlap = hvd_metrics.measure_overlap(run, steps=3, sync=sync)
+    eng = basics.engine()
+    for i in range(3):
+        eng.run("allreduce", np.array([float(i)], np.float64),
+                f"bench.metric.{i}")
+    snap = hvd_metrics.snapshot()
+    snaps = (hvd.allgather_object(snap, name="bench.metrics_snapshot")
+             if hvd.size() > 1 else [snap])
+    if hvd.rank() != 0:
+        return
+    pod = hvd_metrics.merge_snapshots(snaps)
+    print(json.dumps({
+        "metric": "metrics_pod_snapshot",
+        "value": pod["ranks_reporting"],
+        "unit": "ranks",
+        "overlap_measured": overlap.get("ok", False),
+        "snapshot": pod,
+    }))
+
+
 def main() -> None:
     import jax
 
@@ -405,6 +451,9 @@ def main() -> None:
             "smoke": True,
             "vs_baseline": 0.0,
         }))
+        if "--metrics" in sys.argv:
+            _emit_metrics_snapshot(run_smoke, lambda: float(loss_box[0]),
+                                   steps_per_s=rate)
         return
 
     # Apply tuned winners from --autotune: threshold via
@@ -448,6 +497,9 @@ def main() -> None:
         "unit": "img/s",
         "vs_baseline": round(per_chip / REFERENCE_PER_DEVICE_IMG_S, 3),
     }))
+    if "--metrics" in sys.argv:
+        _emit_metrics_snapshot(run, lambda: float(loss_box[0]),
+                               steps_per_s=rate)
 
 
 if __name__ == "__main__":

@@ -39,6 +39,9 @@ echo "== bench smoke (tiny model, hard timeout: a hang fails fast, not rc=124 at
 HVD_BENCH_SMOKE=1 timeout -k 10 240 env JAX_PLATFORMS=cpu \
   python bench.py --buckets-ab
 
+echo "== metrics smoke (2-proc train, stall check + exposition; snapshot vs docs/metrics_schema.json, timeline JSON shape) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_smoke.py
+
 echo "== fast tier (includes the launcher e2e: test_run_happy_path) =="
 python -m pytest tests/ -m fast -q
 

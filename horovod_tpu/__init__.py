@@ -50,6 +50,7 @@ from .parallel.mesh import (  # noqa: F401
 # horovod_tpu` cheap and framework-optional, like the reference's per-framework
 # packages (horovod.tensorflow vs horovod.torch import independently).
 from . import jax  # noqa: F401  (JAX is the required core framework)
+from . import metrics  # noqa: F401  (telemetry registry + stall watchdog)
 from .utils import timeline  # noqa: F401  (hvd.timeline.trace two-pane profile)
 
 

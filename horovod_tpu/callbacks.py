@@ -120,6 +120,68 @@ class MetricAverageCallback(Callback):
             logs.update(average_metrics(logs, name_prefix=f"ep{epoch}.metric."))
 
 
+class MetricsCallback(Callback):
+    """Surface the telemetry registry (horovod_tpu.metrics) through the
+    training loop — ISSUE 2's user-facing hook:
+
+    - per-epoch: a ``horovod_steps_per_sec`` gauge (from ``logs['steps']``
+      when the loop provides it, else epochs/sec) and an epoch counter;
+    - at train end: every rank's snapshot is allgathered over the eager
+      engine and rank 0 merges them into the pod-wide view
+      (:func:`horovod_tpu.metrics.merge_snapshots`), stored on
+      ``self.pod_snapshot`` and optionally written to ``snapshot_path``.
+
+    Pairs with ``HOROVOD_METRICS_PORT`` (live Prometheus scrape) — this
+    callback is the batch/off-pod path for the same data.
+    """
+
+    def __init__(self, snapshot_path: Optional[str] = None,
+                 aggregate: bool = True) -> None:
+        self.snapshot_path = snapshot_path
+        self.aggregate = aggregate
+        self.pod_snapshot: Optional[dict] = None
+        self._epoch_t0: Optional[float] = None
+        import time as _time
+
+        self._clock = _time.monotonic
+
+    def on_epoch_begin(self, epoch: int, logs: Optional[dict] = None) -> None:
+        self._epoch_t0 = self._clock()
+
+    def on_epoch_end(self, epoch: int, logs: Optional[dict] = None) -> None:
+        from . import metrics as hvd_metrics
+
+        reg = hvd_metrics.registry()
+        reg.counter("horovod_epochs_total",
+                    help="training epochs completed").inc()
+        if self._epoch_t0 is None:
+            return
+        dt = max(self._clock() - self._epoch_t0, 1e-9)
+        steps = (logs or {}).get("steps")
+        rate = (steps / dt) if steps else (1.0 / dt)
+        reg.gauge("horovod_steps_per_sec",
+                  help="training steps (or epochs, when the loop reports "
+                       "no step count) per second, latest epoch").set(rate)
+
+    def on_train_end(self, logs: Optional[dict] = None) -> None:
+        from . import metrics as hvd_metrics
+
+        snap = hvd_metrics.snapshot()
+        if self.aggregate and basics.size() > 1:
+            from . import allgather_object
+
+            snaps = allgather_object(snap, name="metrics.final_snapshot")
+        else:
+            snaps = [snap]
+        if basics.rank() == 0:
+            self.pod_snapshot = hvd_metrics.merge_snapshots(snaps)
+            if self.snapshot_path:
+                import json
+
+                with open(self.snapshot_path, "w") as f:
+                    json.dump(self.pod_snapshot, f, indent=2)
+
+
 class LearningRateScheduleCallback(Callback):
     """Multiply the optimizer lr by ``multiplier(epoch)`` within
     [start_epoch, end_epoch) (reference _keras/callbacks.py:70-127).

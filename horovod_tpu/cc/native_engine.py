@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import time
 from typing import Any, Optional
 
 import numpy as np
@@ -28,6 +29,16 @@ _STATUS_NAMES = {1: "UnknownError", 2: "PreconditionError", 3: "Aborted", 4: "In
 # c_api.cc copies result shapes into a fixed 64-slot buffer (numpy's own
 # maximum is 64 dims, NPY_MAXDIMS).
 MAX_NDIM = 64
+
+# Named counters the C++ engine exports through hvd_metric (c_api.cc); the
+# collector mirrors each into the Python metrics registry as
+# horovod_native_<name>.
+NATIVE_METRICS = (
+    "allreduce_count", "allgather_count", "broadcast_count",
+    "reducescatter_count", "alltoall_count", "collective_bytes",
+    "collective_errors", "negotiation_us", "execution_us",
+    "stall_warnings", "cycles", "timeline_dropped",
+)
 
 
 def _np_dtype_id(dt: np.dtype) -> int:
@@ -67,6 +78,10 @@ def _load():
         getattr(lib, fn).argtypes = []
     lib.hvd_cycle_time_ms.restype = ctypes.c_double
     lib.hvd_cycle_time_ms.argtypes = []
+    lib.hvd_metric.restype = ctypes.c_longlong
+    lib.hvd_metric.argtypes = [ctypes.c_char_p]
+    lib.hvd_last_stall.restype = ctypes.c_int
+    lib.hvd_last_stall.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.hvd_timeline_start.restype = ctypes.c_int
     lib.hvd_timeline_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.hvd_timeline_stop.restype = None
@@ -141,6 +156,21 @@ class NativeEngine:
         )
         if rc != 0:
             raise HorovodInternalError(f"native init failed: {err.value.decode()}")
+        # Pull-model telemetry: the C++ core keeps lock-free atomics
+        # (EngineMetrics, engine.h); this collector copies them into the
+        # process-wide registry right before every snapshot/render, so
+        # native and Python engines expose one metrics surface.
+        from ..metrics import registry as _metrics_registry
+
+        self._registry = _metrics_registry()
+        self._registry.register_collector(self._collect_metrics)
+        # handle -> (op, nbytes, enqueue time): feeds the SAME per-op
+        # count/bytes/latency series the Python engine emits
+        # (horovod_collective_*), so dashboards read one surface no matter
+        # which engine implementation is active. The C++ core's own
+        # counters (horovod_native_*) remain the background-thread view —
+        # this layer measures the caller-visible enqueue->synchronize time.
+        self._pending: dict[int, tuple] = {}
 
     def enqueue(self, op: str, array: np.ndarray, name: Optional[str] = None,
                 root_rank: int = 0, average: bool = True) -> int:
@@ -160,6 +190,10 @@ class NativeEngine:
         )
         if h < 0:
             raise HorovodInternalError(f"enqueue failed: {err.value.decode()}")
+        self._registry.counter(
+            "horovod_collectives_enqueued_total",
+            help="collectives submitted to the eager engine", op=op).inc()
+        self._pending[int(h)] = (op, int(arr.nbytes), time.monotonic())
         return int(h)
 
     def poll(self, handle: int) -> bool:
@@ -181,9 +215,11 @@ class NativeEngine:
             msg = err.value.decode() or _STATUS_NAMES.get(rc, f"status {rc}")
             if rc == 5:  # IN_PROGRESS: still in flight, handle stays valid
                 raise TimeoutError(msg)
+            self._observe_done(handle, ok=False)
             if rc == 2:
                 raise TensorShapeMismatchError(msg)
             raise HorovodInternalError(msg)
+        self._observe_done(handle, ok=True)
         shape = tuple(shape_out[i] for i in range(ndim_out.value))
         out = np.empty(shape, dtype=_dtype_from_id(dtype_out.value))
         assert out.nbytes == nbytes_out.value, (out.nbytes, nbytes_out.value)
@@ -196,6 +232,33 @@ class NativeEngine:
 
     def run(self, op: str, array: np.ndarray, name: str, **kw) -> Any:
         return self.synchronize(self.enqueue(op, array, name, **kw))
+
+    def _observe_done(self, handle: int, ok: bool) -> None:
+        rec = self._pending.pop(handle, None)
+        if rec is None:
+            return
+        op, nbytes, t0 = rec
+        if not ok:
+            self._registry.counter(
+                "horovod_collective_errors_total",
+                help="collectives finished with an error", op=op).inc()
+            return
+        from ..metrics.registry import DEFAULT_BYTE_BUCKETS
+
+        self._registry.counter(
+            "horovod_collectives_total",
+            help="collectives completed by the eager engine", op=op).inc()
+        self._registry.counter(
+            "horovod_collective_bytes_total",
+            help="tensor bytes processed by completed collectives",
+            op=op).inc(nbytes)
+        self._registry.histogram(
+            "horovod_collective_size_bytes", help="per-collective tensor sizes",
+            buckets=DEFAULT_BYTE_BUCKETS, op=op).observe(nbytes)
+        self._registry.histogram(
+            "horovod_collective_seconds",
+            help="enqueue-to-completion wall time (negotiation + "
+                 "execution + relay)", op=op).observe(time.monotonic() - t0)
 
     def stats(self) -> dict:
         """Live engine counters: ring passes executed, bytes sent to the
@@ -213,6 +276,31 @@ class NativeEngine:
             "shm_links": int(self._lib.hvd_shm_links()),
         }
 
+    def metrics(self) -> dict:
+        """Raw native telemetry counters (c_api hvd_metric)."""
+        return {name: int(self._lib.hvd_metric(name.encode()))
+                for name in NATIVE_METRICS}
+
+    def last_stall(self) -> str:
+        """Latest stall-warning text seen by this rank ('' when none)."""
+        buf = ctypes.create_string_buffer(4096)
+        n = self._lib.hvd_last_stall(buf, 4096)
+        return buf.value.decode(errors="replace") if n > 0 else ""
+
+    def _collect_metrics(self, reg) -> None:
+        vals = self.metrics()
+        if all(v < 0 for v in vals.values()):
+            return  # engine already shut down
+        for name, v in vals.items():
+            if v >= 0:
+                reg.gauge(f"horovod_native_{name}",
+                          help="native engine counter (cc/src/engine.h "
+                               "EngineMetrics)").set(v)
+        stall = self.last_stall()
+        if stall:
+            reg.set_info("stall_report", {
+                "rank": self.topo.rank, "source": "native", "text": stall})
+
     def timeline_start(self, path: str, mark_cycles: bool = False) -> int:
         """Scoped timeline attach (hvd.timeline.trace): 1 if this call
         opened it (caller owns the stop), 0 otherwise."""
@@ -223,4 +311,7 @@ class NativeEngine:
         self._lib.hvd_timeline_stop()
 
     def shutdown(self) -> None:
+        from ..metrics import registry as _metrics_registry
+
+        _metrics_registry().unregister_collector(self._collect_metrics)
         self._lib.hvd_shutdown()

@@ -217,6 +217,41 @@ int hvd_shm_links() {
   return eng ? eng->shm_links() : -1;
 }
 
+// ---- engine telemetry (ISSUE 2: exported to the metrics registry) ----
+//
+// One generic named getter keeps the ABI small as counters accrue; unknown
+// names and no-engine return -1 (valid counters are never negative).
+long long hvd_metric(const char* name) {
+  auto eng = engine();
+  if (!eng || !name) return -1;
+  const EngineMetrics& m = eng->op_metrics();
+  const std::string k(name);
+  if (k == "allreduce_count") return (long long)m.allreduce_count.load();
+  if (k == "allgather_count") return (long long)m.allgather_count.load();
+  if (k == "broadcast_count") return (long long)m.broadcast_count.load();
+  if (k == "reducescatter_count")
+    return (long long)m.reducescatter_count.load();
+  if (k == "alltoall_count") return (long long)m.alltoall_count.load();
+  if (k == "collective_bytes") return (long long)m.collective_bytes.load();
+  if (k == "collective_errors") return (long long)m.collective_errors.load();
+  if (k == "negotiation_us") return (long long)m.negotiation_us.load();
+  if (k == "execution_us") return (long long)m.execution_us.load();
+  if (k == "stall_warnings") return (long long)m.stall_warnings.load();
+  if (k == "cycles") return (long long)m.cycles.load();
+  if (k == "timeline_dropped") return (long long)eng->timeline_dropped();
+  return -1;
+}
+
+// Latest stall-warning text (empty when none). Returns the full text
+// length, so a short buffer is detectable; fills up to cap-1 bytes.
+int hvd_last_stall(char* buf, int cap) {
+  auto eng = engine();
+  if (!eng || !buf || cap <= 0) return 0;
+  std::string s = eng->last_stall();
+  std::snprintf(buf, (size_t)cap, "%s", s.c_str());
+  return (int)s.size();
+}
+
 // Scoped timeline attach (hvd.timeline.trace): returns 1 when this call
 // opened the timeline (caller owns the stop), 0 when one was already
 // configured (HOROVOD_TIMELINE) or this rank doesn't write.

@@ -259,6 +259,21 @@ void Engine::finish(Entry& e, Status st, Response res) {
     std::lock_guard<std::mutex> g(qmu_);
     inflight_.erase(e.req.name);
   }
+  // Central completion point = central count point: every path (local
+  // fast path, fused ring, error/abort sweeps) lands here exactly once.
+  if (st.ok()) {
+    switch (e.req.op) {
+      case OpType::ALLREDUCE: metrics_.allreduce_count++; break;
+      case OpType::ALLGATHER: metrics_.allgather_count++; break;
+      case OpType::BROADCAST: metrics_.broadcast_count++; break;
+      case OpType::REDUCESCATTER: metrics_.reducescatter_count++; break;
+      case OpType::ALLTOALL: metrics_.alltoall_count++; break;
+    }
+    metrics_.collective_bytes +=
+        (uint64_t)e.req.elements() * dtype_size(e.req.dtype);
+  } else {
+    metrics_.collective_errors++;
+  }
   handles_.mark_done(e.handle, std::move(st), std::move(res));
 }
 
@@ -348,6 +363,7 @@ void Engine::loop() {
       shutting = shutdown_.load();
     }
     timeline_.mark_cycle_start();
+    metrics_.cycles++;
     if (topo_.size == 1) {
       std::deque<Entry> batch;
       {
@@ -423,7 +439,14 @@ bool Engine::tick_multiprocess(bool shutting) {
               " hier_allgather=" + std::to_string((int)out.hier_allgather));
   }
   // Stall warnings: the coordinator process (us, when coord_ is set) already
-  // logged them at creation; only worker ranks log on receipt.
+  // logged them at creation; only worker ranks log on receipt. EVERY rank
+  // counts them and keeps the latest text for diagnostics (c_api
+  // hvd_last_stall -> the metrics registry's stall_report).
+  if (!out.stall_warnings.empty()) {
+    metrics_.stall_warnings += out.stall_warnings.size();
+    std::lock_guard<std::mutex> g(stall_mu_);
+    last_stall_ = out.stall_warnings.back();
+  }
   if (!coord_) {
     for (auto& w : out.stall_warnings) HVD_WARN(w);
   }
@@ -456,6 +479,9 @@ bool Engine::tick_multiprocess(bool shutting) {
 void Engine::complete_local(Entry& e) {
   // Single-process world: every collective is the identity (average of one,
   // gather of one, broadcast from self, scatter of the whole).
+  metrics_.negotiation_us += (uint64_t)std::chrono::duration_cast<
+      std::chrono::microseconds>(std::chrono::steady_clock::now() -
+                                 e.enqueued).count();
   if (timeline_.healthy()) {
     timeline_.negotiate_end(e.req.name);
     timeline_.start(e.req.name, op_name(e.req.op));
@@ -494,6 +520,11 @@ void Engine::execute_entry(const ResponseEntry& re) {
     table_.erase(it);
   }
   if (ents.empty()) return;
+  auto exec_start = std::chrono::steady_clock::now();
+  for (auto& e : ents) {
+    metrics_.negotiation_us += (uint64_t)std::chrono::duration_cast<
+        std::chrono::microseconds>(exec_start - e.enqueued).count();
+  }
   // Once a ring transport error happened, the peer byte streams may be
   // mid-message (ring.h carries no per-chunk framing by design): executing
   // anything further over those sockets could silently deliver one entry's
@@ -536,6 +567,9 @@ void Engine::execute_entry(const ResponseEntry& re) {
   if (timeline_.healthy()) {
     for (auto& e : ents) timeline_.end(e.req.name);
   }
+  metrics_.execution_us += (uint64_t)std::chrono::duration_cast<
+      std::chrono::microseconds>(std::chrono::steady_clock::now() -
+                                 exec_start).count();
 }
 
 // One allreduce pass over a contiguous buffer. Flat: ring reduce-scatter +

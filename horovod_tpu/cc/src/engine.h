@@ -55,6 +55,24 @@ struct Topology {
       cross_size = 1;
 };
 
+// Always-on engine telemetry (ISSUE 2): exported through the c_api
+// (hvd_metric) and mirrored into the Python metrics registry by
+// native_engine.py's collector. Atomics only — the increments sit on the
+// executor's hot path and must never take a lock.
+struct EngineMetrics {
+  std::atomic<uint64_t> allreduce_count{0};
+  std::atomic<uint64_t> allgather_count{0};
+  std::atomic<uint64_t> broadcast_count{0};
+  std::atomic<uint64_t> reducescatter_count{0};
+  std::atomic<uint64_t> alltoall_count{0};
+  std::atomic<uint64_t> collective_bytes{0};   // input tensor bytes completed
+  std::atomic<uint64_t> collective_errors{0};  // entries finished with error
+  std::atomic<uint64_t> negotiation_us{0};     // enqueue -> execution-start
+  std::atomic<uint64_t> execution_us{0};       // execution wall time
+  std::atomic<uint64_t> stall_warnings{0};     // coordinator stall reports seen
+  std::atomic<uint64_t> cycles{0};             // negotiation ticks
+};
+
 // One rank's registration record: ring endpoints plus its host coordinates.
 // The coordinator gathers these in hello and broadcasts the full map, which
 // is what lets every rank build the two-level (intra-host / cross-host)
@@ -175,6 +193,14 @@ class Engine {
   }
   void timeline_stop() { timeline_.shutdown(); }
 
+  // Engine telemetry counters (c_api hvd_metric / hvd_last_stall).
+  const EngineMetrics& op_metrics() const { return metrics_; }
+  uint64_t timeline_dropped() const { return timeline_.dropped(); }
+  std::string last_stall() const {
+    std::lock_guard<std::mutex> g(stall_mu_);
+    return last_stall_;
+  }
+
  private:
   struct Entry {
     Request req;
@@ -236,6 +262,9 @@ class Engine {
   std::atomic<bool> hier_allgather_{false};
   RingStats stats_;
   RingStats cross_stats_;  // bytes whose next hop crosses a host boundary
+  EngineMetrics metrics_;
+  mutable std::mutex stall_mu_;
+  std::string last_stall_;  // latest stall warning text (diagnostics)
   FusionBuffer fusion_buf_;
   // Persistent receive-bounce arena for ring reduce-scatter (single
   // background executor thread => no locking; grown on demand, reused

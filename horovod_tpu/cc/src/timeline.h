@@ -34,6 +34,7 @@ class Timeline {
     file_ = std::fopen(path.c_str(), "w");
     if (!file_) return;
     std::fputs("[\n", file_);
+    first_ = true;
     pids_.clear();  // fresh lane map per trace file
     mark_cycles_ = mark_cycles;
     start_ = now_us();
@@ -75,10 +76,17 @@ class Timeline {
     }
     if (writer_.joinable()) writer_.join();
     if (file_) {
-      std::fputs("]\n", file_);
+      std::fputs("\n]\n", file_);
       std::fclose(file_);
       file_ = nullptr;
     }
+  }
+
+  // Events dropped because the writer queue was full (exposed through the
+  // c_api as the `timeline_dropped` metric): the hot path NEVER blocks on
+  // file IO — under backpressure it sheds events and counts the shed.
+  uint64_t dropped() const {
+    return dropped_.load(std::memory_order_relaxed);
   }
 
  private:
@@ -104,7 +112,10 @@ class Timeline {
     // event enqueued after shutdown drained the queue would leak into the
     // NEXT trace file with a stale start_ baseline.
     if (!healthy_) return;
-    if (queue_.size() >= kCapacity) return;  // drop, like a full SPSC queue
+    if (queue_.size() >= kCapacity) {  // drop, like a full SPSC queue
+      dropped_.fetch_add(1, std::memory_order_relaxed);
+      return;
+    }
     queue_.push_back(Event{phase, tensor, name, now_us() - start_});
     cv_.notify_one();
   }
@@ -149,14 +160,24 @@ class Timeline {
     return out;
   }
 
+  // Comma BEFORE each record (except the first) keeps the file valid JSON
+  // at close — a trailing comma between the last event and "]" breaks
+  // strict parsers (ci.sh validates the shape), even though Chrome's own
+  // loader tolerates it.
+  void begin_record() {
+    if (!first_) std::fputs(",\n", file_);
+    first_ = false;
+  }
+
   void write_event(const Event& e) {
     int pid = pid_for(e.tensor);
+    begin_record();
     if (e.phase == 'E') {
-      std::fprintf(file_, "{\"ph\":\"E\",\"pid\":%d,\"ts\":%lld},\n", pid,
+      std::fprintf(file_, "{\"ph\":\"E\",\"pid\":%d,\"ts\":%lld}", pid,
                    (long long)e.ts_us);
     } else {
       std::fprintf(file_,
-                   "{\"ph\":\"%c\",\"pid\":%d,\"ts\":%lld,\"name\":\"%s\"%s},\n",
+                   "{\"ph\":\"%c\",\"pid\":%d,\"ts\":%lld,\"name\":\"%s\"%s}",
                    e.phase, pid, (long long)e.ts_us,
                    json_escape(e.name).c_str(),
                    e.phase == 'i' ? ",\"s\":\"p\"" : "");
@@ -170,15 +191,18 @@ class Timeline {
     int pid = (int)pids_.size() + 1;
     pids_[tensor] = pid;
     // metadata record naming the lane (reference timeline.cc WriteAtFileStart)
+    begin_record();
     std::fprintf(file_,
                  "{\"ph\":\"M\",\"pid\":%d,\"name\":\"process_name\","
-                 "\"args\":{\"name\":\"%s\"}},\n",
+                 "\"args\":{\"name\":\"%s\"}}",
                  pid, json_escape(tensor).c_str());
     return pid;
   }
 
   static constexpr size_t kCapacity = 1 << 20;  // reference timeline.h:66
   std::FILE* file_ = nullptr;
+  bool first_ = true;                 // writer thread only (after init)
+  std::atomic<uint64_t> dropped_{0};  // survives across trace files
   // atomics: read lock-free on the emit fast path, written by runtime
   // attach/detach (timeline_start/stop) from another thread
   std::atomic<bool> healthy_{false};

@@ -45,6 +45,7 @@ class _State:
         self.config: Optional[Config] = None
         self.engine = None          # native engine handle, attached lazily
         self.mesh = None            # default data-parallel mesh, created lazily
+        self.metrics_server = None  # HTTP exposition (HOROVOD_METRICS_PORT)
         self._atexit_registered = False
 
 
@@ -183,10 +184,36 @@ def init(comm: Optional[Sequence[int]] = None) -> None:
         _state.topology = topo
         _state.config = Config.from_env()
         _state.initialized = True
+        _start_metrics(topo, _state.config)
         if not _state._atexit_registered:
             atexit.register(shutdown)
             _state._atexit_registered = True
         log("debug", f"horovod_tpu initialized: {topo}", rank=topo.rank)
+
+
+def _start_metrics(topo: Topology, config: Config) -> None:
+    """Always-on registry identity gauges; HTTP exposition only when
+    HOROVOD_METRICS_PORT is set. Rank r on a host serves at
+    port + local_rank so co-located workers never collide (docs/metrics.md);
+    failure to bind is a warning, not an init failure — telemetry must
+    never take the job down."""
+    from ..metrics import registry, start_metrics_server
+
+    reg = registry()
+    reg.gauge("horovod_rank", help="this process's rank").set(topo.rank)
+    reg.gauge("horovod_size", help="world size").set(topo.size)
+    reg.gauge("horovod_local_rank").set(topo.local_rank)
+    port = getattr(config, "metrics_port", 0)
+    if port:
+        try:
+            _state.metrics_server = start_metrics_server(port + topo.local_rank)
+            log("debug",
+                f"metrics exposition at http://127.0.0.1:"
+                f"{_state.metrics_server.port}/metrics", rank=topo.rank)
+        except OSError as e:
+            log("warning",
+                f"HOROVOD_METRICS_PORT={port}: cannot bind metrics server "
+                f"({e}); exposition disabled for this rank", rank=topo.rank)
 
 
 def shutdown() -> None:
@@ -195,6 +222,12 @@ def shutdown() -> None:
     with _state._lock:
         if not _state.initialized:
             return
+        if _state.metrics_server is not None:
+            try:
+                _state.metrics_server.stop()
+            except Exception:  # pragma: no cover
+                pass
+            _state.metrics_server = None
         if _state.engine is not None:
             try:
                 _state.engine.shutdown()

@@ -57,6 +57,21 @@ DEFAULT_NUM_BUCKETS = 1
 DEFAULT_CYCLE_TIME_MS = 5.0
 # Stall-check warning period: 60 s (reference operations.cc:258 STALL_WARNING_TIME).
 STALL_WARNING_TIME_S = 60.0
+# Stall-shutdown escalation: 0 disables (reference STALL_SHUTDOWN_TIME is
+# likewise opt-in); > 0 makes the watchdog fail collectives stalled past it.
+STALL_SHUTDOWN_TIME_S = 0.0
+
+
+def _env_stall_check_time(default: float = STALL_WARNING_TIME_S) -> float:
+    """HOROVOD_STALL_CHECK_TIME (reference spelling) with the historical
+    HOROVOD_STALL_WARNING_TIME accepted as a fallback alias."""
+    v = os.environ.get("HOROVOD_STALL_CHECK_TIME")
+    if v not in (None, ""):
+        try:
+            return float(v)
+        except ValueError:
+            pass
+    return _env_float("HOROVOD_STALL_WARNING_TIME", default)
 
 # XLA compile options that let the scheduler hide collective latency behind
 # compute — the compiled-plane analog of the reference's background thread
@@ -113,7 +128,10 @@ class Config:
     autotune: bool = False                                # HOROVOD_AUTOTUNE
     autotune_log: str = ""                                # HOROVOD_AUTOTUNE_LOG
     stall_check_disable: bool = False                     # HOROVOD_STALL_CHECK_DISABLE
-    stall_warning_s: float = STALL_WARNING_TIME_S         # HOROVOD_STALL_WARNING_TIME
+    # HOROVOD_STALL_CHECK_TIME (alias: HOROVOD_STALL_WARNING_TIME)
+    stall_warning_s: float = STALL_WARNING_TIME_S
+    stall_shutdown_s: float = STALL_SHUTDOWN_TIME_S       # HOROVOD_STALL_SHUTDOWN_TIME
+    metrics_port: int = 0                                 # HOROVOD_METRICS_PORT (0 = off)
     hierarchical_allreduce: bool = False                  # HOROVOD_HIERARCHICAL_ALLREDUCE
     hierarchical_allgather: bool = False                  # HOROVOD_HIERARCHICAL_ALLGATHER
     # Shared-memory data plane for same-host ring links (cc/src/shm_ring.h;
@@ -148,7 +166,10 @@ class Config:
             autotune=_env_bool("HOROVOD_AUTOTUNE"),
             autotune_log=os.environ.get("HOROVOD_AUTOTUNE_LOG", ""),
             stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
-            stall_warning_s=_env_float("HOROVOD_STALL_WARNING_TIME", STALL_WARNING_TIME_S),
+            stall_warning_s=_env_stall_check_time(),
+            stall_shutdown_s=_env_float("HOROVOD_STALL_SHUTDOWN_TIME",
+                                        STALL_SHUTDOWN_TIME_S),
+            metrics_port=_env_int("HOROVOD_METRICS_PORT", 0),
             hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
             # shm / shm_bytes: omitted — their default_factory already reads

@@ -45,6 +45,8 @@ import numpy as np
 
 from .config import Config, STALL_WARNING_TIME_S
 from .topology import Topology
+from ..metrics import StallInfo, StallWatchdog, registry as _metrics_registry
+from ..metrics.registry import DEFAULT_BYTE_BUCKETS
 from ..utils.logging import log
 
 
@@ -194,6 +196,30 @@ class PyEngine:
                 self._coord = _Coordinator(topo.size, host, int(port), key=key)
                 self._coord.start()
             self._client = _Client(host, int(port), topo.rank, key=key)
+        # Telemetry (ISSUE 2): per-op collective counters + latency
+        # histograms in the process-wide registry, and the stall watchdog
+        # thread replacing the old inline loop check — it keeps reporting
+        # even when the loop is wedged inside a blocking exchange, names
+        # missing ranks on the coordinator rank, and can escalate
+        # (HOROVOD_STALL_SHUTDOWN_TIME) by failing the stalled collective.
+        self._metrics = _metrics_registry()
+        self._watchdog: Optional[StallWatchdog] = None
+        if not config.stall_check_disable:
+            stall_s = getattr(config, "stall_warning_s", STALL_WARNING_TIME_S)
+            self._watchdog = StallWatchdog(
+                check_time_s=stall_s,
+                shutdown_time_s=getattr(config, "stall_shutdown_s", 0.0),
+                rank=topo.rank,
+                on_abort=self._abort_stalled,
+            )
+            if self._coord is not None:
+                # The coordinator's pending table is strictly more
+                # informative than the local queue (it knows WHICH ranks are
+                # missing per tensor, and sees tensors this rank never
+                # submitted) — use it exclusively on rank 0.
+                self._watchdog.add_source(self._coord.stall_candidates)
+            else:
+                self._watchdog.add_source(self._stall_source)
         self._thread = threading.Thread(
             target=self._loop, name="horovod_tpu_engine", daemon=True
         )
@@ -232,6 +258,9 @@ class PyEngine:
                 )
             self._inflight.add(name)
             self._queue.append(entry)
+        self._metrics.counter(
+            "horovod_collectives_enqueued_total",
+            help="collectives submitted to the eager engine", op=op).inc()
         if self._timeline:
             self._timeline.negotiate_start(name, op.upper())
         return handle
@@ -263,6 +292,9 @@ class PyEngine:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
         self._thread.join(timeout=5)
         if self._client:
             self._client.close()
@@ -282,9 +314,15 @@ class PyEngine:
     # -- background loop (reference RunLoopOnce, operations.cc:2030-2380)
 
     def _loop(self) -> None:
-        last_stall_check = time.monotonic()
+        # Stall detection moved to the StallWatchdog thread (metrics/
+        # watchdog.py): it keeps scanning even while this loop is blocked
+        # inside an exchange, which the old inline check never could.
+        cycles = self._metrics.counter(
+            "horovod_engine_cycles_total",
+            help="eager-engine negotiation cycles")
         while not self._shutdown.is_set():
             time.sleep(self.config.cycle_time_ms / 1000.0)
+            cycles.inc()
             if self._timeline:
                 self._timeline.mark_cycle()
             with self._lock:
@@ -295,15 +333,33 @@ class PyEngine:
                     self._complete_local(e)
             else:
                 self._negotiate_and_execute(batch)
-            stall_s = getattr(self.config, "stall_warning_s", STALL_WARNING_TIME_S)
-            if (not self.config.stall_check_disable
-                    and time.monotonic() - last_stall_check > stall_s):
-                self._check_stalled()
-                last_stall_check = time.monotonic()
 
     def _finish(self, e: dict, error, result) -> None:
         with self._lock:
             self._inflight.discard(e["name"])
+        op = e["op"]
+        if error is None:
+            self._metrics.counter(
+                "horovod_collectives_total",
+                help="collectives completed by the eager engine", op=op).inc()
+            self._metrics.counter(
+                "horovod_collective_bytes_total",
+                help="tensor bytes processed by completed collectives",
+                op=op).inc(int(e["array"].nbytes))
+            self._metrics.histogram(
+                "horovod_collective_size_bytes",
+                help="per-collective tensor sizes",
+                buckets=DEFAULT_BYTE_BUCKETS, op=op,
+            ).observe(int(e["array"].nbytes))
+            self._metrics.histogram(
+                "horovod_collective_seconds",
+                help="enqueue-to-completion wall time (negotiation + "
+                     "execution + relay)", op=op,
+            ).observe(time.monotonic() - e["t"])
+        else:
+            self._metrics.counter(
+                "horovod_collective_errors_total",
+                help="collectives finished with an error", op=op).inc()
         self.handles.mark_done(e["handle"], error, result)
 
     def _complete_local(self, e: dict) -> None:
@@ -355,21 +411,38 @@ class PyEngine:
             else:
                 self._finish(e, None, value)
 
-    def _check_stalled(self) -> None:
-        """Reference CheckForStalledTensors (operations.cc:1625-1672)."""
+    def _stall_source(self) -> list:
+        """Watchdog view of this rank's in-flight queue (reference
+        CheckForStalledTensors, operations.cc:1625-1672; non-coordinator
+        ranks can't know WHICH ranks are missing — the coordinator source
+        fills that in on rank 0)."""
         now = time.monotonic()
-        stall_s = getattr(self.config, "stall_warning_s", STALL_WARNING_TIME_S)
         with self._lock:
-            stalled = [e["name"] for e in self._queue if now - e["t"] > stall_s]
-        if stalled:
-            log(
-                "warning",
-                "One or more tensors were submitted to be reduced, gathered or "
-                "broadcasted by subset of ranks and are waiting for remainder of "
-                f"ranks for more than {int(stall_s)} seconds. Stalled ops: "
-                + ", ".join(stalled),
-                rank=self.topo.rank,
-            )
+            return [StallInfo(name=e["name"], op=e["op"], age_s=now - e["t"])
+                    for e in self._queue]
+
+    def _abort_stalled(self, info: StallInfo) -> bool:
+        """HOROVOD_STALL_SHUTDOWN_TIME escalation: fail the stalled
+        collective with an error naming the missing ranks, so the training
+        loop raises instead of hanging forever. Returns False (retry next
+        scan) when the entry is momentarily checked out of the queue by an
+        in-flight exchange."""
+        with self._lock:
+            entry = next((e for e in self._queue if e["name"] == info.name),
+                         None)
+            if entry is not None:
+                self._queue.remove(entry)
+        if entry is None:
+            return info.name not in self._inflight
+        missing = (f" (missing ranks: "
+                   f"{', '.join(str(r) for r in info.missing_ranks)})"
+                   if info.missing_ranks else "")
+        self._finish(entry, HorovodInternalError(
+            f"collective {info.name} stalled for {info.age_s:.1f}s, past "
+            f"HOROVOD_STALL_SHUTDOWN_TIME="
+            f"{getattr(self.config, 'stall_shutdown_s', 0.0):g}s{missing}"),
+            None)
+        return True
 
 
 # ------------------------------------------------------- multi-process plumbing
@@ -394,6 +467,8 @@ class _Coordinator:
         self._cv = threading.Condition(self._lock)
         # name → {rank: (request, array)}; the message_table
         self._pending: dict[str, dict[int, tuple[dict, np.ndarray]]] = {}
+        # name → monotonic time of first contribution (stall-watchdog ages)
+        self._first_seen: dict[str, float] = {}
         self._results: dict[str, tuple[Optional[str], Any]] = {}
         self._claimed: dict[str, set[int]] = {}
 
@@ -449,6 +524,7 @@ class _Coordinator:
                 if name in self._results and rank not in self._claimed.get(name, set()):
                     continue
                 entry = self._pending.setdefault(name, {})
+                self._first_seen.setdefault(name, time.monotonic())
                 if name in arrays:
                     entry[rank] = (req, arrays[name])
                 # else: metadata-only re-poll — this rank's bytes are already
@@ -457,6 +533,7 @@ class _Coordinator:
                     ready.append(name)
             for name in ready:
                 self._results[name] = self._execute(name, self._pending.pop(name))
+                self._first_seen.pop(name, None)
                 self._claimed[name] = set()
             self._cv.notify_all()
             # Collective semantics: a tensor completes only when every rank
@@ -500,6 +577,23 @@ class _Coordinator:
                     if len(self._claimed[n]) == self.world:
                         del self._results[n]
                         del self._claimed[n]
+        return out
+
+    def stall_candidates(self) -> list:
+        """Watchdog source (reference CheckForStalledTensors with
+        missing-rank lists, operations.cc:1625-1672): every pending tensor's
+        age and the ranks that have NOT yet contributed it."""
+        now = time.monotonic()
+        out = []
+        all_ranks = set(range(self.world))
+        with self._lock:
+            for name, contribs in self._pending.items():
+                missing = sorted(all_ranks - set(contribs))
+                op = next(iter(contribs.values()))[0]["op"] if contribs else "?"
+                out.append(StallInfo(
+                    name=name, op=op,
+                    age_s=now - self._first_seen.get(name, now),
+                    missing_ranks=missing))
         return out
 
     def _execute(self, name: str, contributions: dict[int, tuple[dict, np.ndarray]]):

@@ -1,0 +1,48 @@
+"""Unified telemetry for horovod_tpu (ISSUE 2 tentpole).
+
+One always-on, process-local registry that every layer reports through:
+
+- ``hvd.metrics.registry()`` — counters / gauges / histograms
+  (registry.py). Fed by the eager engines (collective count/bytes/latency,
+  stall warnings), the fusion planner (bucket geometry, occupancy,
+  planned overlap), and the timeline (dropped events).
+- ``hvd.metrics.snapshot()`` — the JSON view; ``render_prometheus()`` the
+  scrape text; ``HOROVOD_METRICS_PORT`` serves both over local HTTP
+  (exposition.py, started by ``hvd.init()``).
+- :class:`StallWatchdog` — HOROVOD_STALL_CHECK_TIME straggler warnings
+  naming tensors + missing ranks, HOROVOD_STALL_SHUTDOWN_TIME escalation
+  (watchdog.py; the native engine's coordinator scan feeds the same
+  registry through the c_api collector).
+- ``measure_overlap`` / plan gauges — the compiled path's bucket
+  overlap-efficiency instruments (overlap.py).
+- ``merge_snapshots`` — pod-wide aggregation of per-rank snapshots
+  (aggregate.py; used by the runner's DriverService, MetricsCallback and
+  ``bench.py --metrics``).
+
+Full reference: docs/metrics.md.
+"""
+
+from __future__ import annotations
+
+from .aggregate import merge_snapshots  # noqa: F401
+from .exposition import MetricsServer, start_metrics_server  # noqa: F401
+from .overlap import last_plan, measure_overlap, record_plan  # noqa: F401
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    registry,
+)
+from .schema import validate_snapshot  # noqa: F401
+from .watchdog import StallInfo, StallReport, StallWatchdog  # noqa: F401
+
+
+def snapshot() -> dict:
+    """JSON-able snapshot of this process's registry."""
+    return registry().snapshot()
+
+
+def render_prometheus() -> str:
+    """Prometheus text exposition of this process's registry."""
+    return registry().render_prometheus()

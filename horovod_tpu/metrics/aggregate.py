@@ -1,0 +1,101 @@
+"""Pod-wide aggregation of per-rank metrics snapshots.
+
+A snapshot (registry.MetricsRegistry.snapshot) is process-local. The pod
+view merges one snapshot per rank — collected either by the launcher's
+DriverService (workers attach a snapshot to their result payload and may
+push mid-run ``metrics`` messages, runner/service.py) or in-band over the
+eager engine (`hvd.allgather_object`, used by callbacks.MetricsCallback and
+``bench.py --metrics``). Merge rules:
+
+- counters: summed (they are per-rank totals; the pod total is the sum);
+- gauges: min / max / mean across ranks (a pod has no single "the" value —
+  the spread IS the signal: a straggler shows up as max >> min);
+- histograms: bucket-wise sum (boundaries are identical by construction —
+  every rank runs the same build), percentiles re-estimated on the merged
+  distribution;
+- info: kept per rank (``stall_report`` from rank 0 names missing ranks).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def _merge_histograms(snaps: Sequence[dict], name: str) -> dict:
+    count = 0
+    total = 0.0
+    cums: dict = {}
+    order: list = []
+    for s in snaps:
+        h = s.get("histograms", {}).get(name)
+        if not h:
+            continue
+        count += h.get("count", 0)
+        total += h.get("sum", 0.0)
+        for le, cum in h.get("buckets", []):
+            key = str(le)
+            if key not in cums:
+                cums[key] = 0
+                order.append((le, key))
+            cums[key] += cum
+    buckets = [[le, cums[key]] for le, key in order]
+    out = {"count": count, "sum": total, "buckets": buckets}
+    # Re-estimate percentiles from the merged cumulative counts (upper-bound
+    # estimate: the boundary where the cumulative crosses the target).
+    for p, key in ((50, "p50"), (90, "p90"), (99, "p99")):
+        out[key] = _percentile_from_cum(buckets, count, p)
+    return out
+
+
+def _percentile_from_cum(buckets: list, count: int, p: float) -> float:
+    if count == 0:
+        return 0.0
+    target = count * p / 100.0
+    prev = 0.0
+    for le, cum in buckets:
+        if le == "+Inf":
+            return float(prev)
+        if cum >= target:
+            return float(le)
+        prev = le
+    return float(prev)
+
+
+def merge_snapshots(snaps: Sequence[Optional[dict]]) -> dict:
+    """Merge per-rank snapshots (index = rank; None entries are ranks that
+    reported nothing) into one pod-wide view."""
+    present = [(r, s) for r, s in enumerate(snaps) if s]
+    out = {
+        "schema": "horovod_tpu.metrics.pod.v1",
+        "ranks": len(snaps),
+        "ranks_reporting": len(present),
+        "time_unix_s": max((s.get("time_unix_s", 0.0) for _, s in present),
+                           default=0.0),
+        "counters": {},
+        "gauges": {},
+        "histograms": {},
+        "info": {},
+    }
+    names: dict[str, set] = {"counters": set(), "gauges": set(),
+                             "histograms": set()}
+    for _, s in present:
+        for kind in names:
+            names[kind].update(s.get(kind, {}).keys())
+    for name in sorted(names["counters"]):
+        out["counters"][name] = sum(
+            s.get("counters", {}).get(name, 0.0) for _, s in present)
+    for name in sorted(names["gauges"]):
+        vals = [s["gauges"][name] for _, s in present
+                if name in s.get("gauges", {})]
+        out["gauges"][name] = {
+            "min": min(vals), "max": max(vals),
+            "mean": sum(vals) / len(vals),
+        }
+    for name in sorted(names["histograms"]):
+        out["histograms"][name] = _merge_histograms(
+            [s for _, s in present], name)
+    for r, s in present:
+        info = s.get("info") or {}
+        if info:
+            out["info"][str(r)] = info
+    return out

@@ -1,0 +1,83 @@
+"""Metrics exposition: Prometheus text + JSON snapshots over local HTTP.
+
+Set ``HOROVOD_METRICS_PORT`` and ``hvd.init()`` starts one server per rank
+(rank *r* on a host listens at ``port + local_rank`` so co-located workers
+never collide; docs/metrics.md). Endpoints:
+
+- ``GET /metrics``       → Prometheus text format 0.0.4 (scrape target);
+- ``GET /metrics.json``  → the JSON snapshot (what the runner aggregates
+  pod-wide, aggregate.merge_snapshots);
+- ``GET /healthz``       → 200 ok (liveness probe for the stall watchdog:
+  a rank whose exposition stops answering is itself the straggler).
+
+The server binds 127.0.0.1 by default (HOROVOD_METRICS_HOST overrides for
+scrapers on another machine): metrics are unauthenticated by design — same
+posture as every Prometheus exporter — so the default exposes them to the
+local host only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .registry import MetricsRegistry, registry
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: MetricsRegistry = None  # type: ignore[assignment]
+
+    def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+        if self.path.split("?")[0] == "/metrics":
+            body = self.registry.render_prometheus().encode()
+            ctype = PROMETHEUS_CONTENT_TYPE
+        elif self.path.split("?")[0] == "/metrics.json":
+            body = json.dumps(self.registry.snapshot()).encode()
+            ctype = "application/json"
+        elif self.path.split("?")[0] == "/healthz":
+            body, ctype = b"ok\n", "text/plain"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # silence per-request stderr spam
+        pass
+
+
+class MetricsServer:
+    """Daemon-thread HTTP exposition server; ``port=0`` picks a free port
+    (read the bound one back from ``.port``)."""
+
+    def __init__(self, port: int, reg: Optional[MetricsRegistry] = None,
+                 host: Optional[str] = None) -> None:
+        reg = reg or registry()
+        host = host or os.environ.get("HOROVOD_METRICS_HOST", "127.0.0.1")
+        handler = type("BoundHandler", (_Handler,), {"registry": reg})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvd_metrics_http",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_metrics_server(port: int, reg: Optional[MetricsRegistry] = None,
+                         host: Optional[str] = None) -> MetricsServer:
+    return MetricsServer(port, reg, host)

@@ -1,0 +1,239 @@
+"""Compiled-path overlap telemetry: bucket plans + measured overlap efficiency.
+
+PR 1's headline feature — K reverse-backward-order gradient buckets issued
+as independent psums so XLA's latency-hiding scheduler overlaps their ICI
+transfer with the remaining backward compute — previously ran blind. Two
+complementary instruments fix that:
+
+1. **Plan gauges** (`record_plan`, fed from fusion.fused_allreduce at trace
+   time): bucket count, per-bucket bytes in issue order, fusion-buffer
+   occupancy vs the threshold, and a *planned* overlap-efficiency bound —
+   the byte fraction that CAN be hidden. Bucket i's collective can overlap
+   the compute that produces buckets i+1..K-1, so the hideable fraction is
+   ``1 - bytes(last bucket)/total``: a single fused buffer (K=1) can hide
+   nothing, and the bound rises monotonically as the tail bucket shrinks.
+
+2. **Measured efficiency** (`measure_overlap`): run the step under
+   ``jax.profiler.trace`` and parse the device trace the way
+   utils/roofline.py parses cost fields — collective op spans vs the union
+   of concurrent compute spans. ``overlap_efficiency`` = hidden collective
+   time / total collective time. Requires a backend whose profile carries
+   per-op device spans (TPU); on CPU hosts the parser reports
+   ``ok=False`` and only the plan gauges are populated.
+
+Both write the same registry, so `bench.py --metrics` snapshots carry
+`horovod_overlap_*` gauges either way.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import tempfile
+from typing import Callable, Optional
+
+from .registry import DEFAULT_BYTE_BUCKETS, registry
+
+# Substrings identifying collective device ops in XLA traces (op name or
+# hlo_category). Covers the psum/all-gather/reduce-scatter family the
+# compiled data plane emits (parallel/collectives.py).
+_COLLECTIVE_MARKERS = (
+    "all-reduce", "all_reduce", "allreduce",
+    "all-gather", "all_gather", "allgather",
+    "reduce-scatter", "reduce_scatter", "reducescatter",
+    "all-to-all", "all_to_all", "alltoall",
+    "collective-permute", "collective_permute",
+)
+
+# Latest recorded plan, for tests and snapshot annotations: list of
+# (issue_index, nbytes) in collective-issue order.
+_last_plan: Optional[list] = None
+
+
+def record_plan(plan, threshold: int) -> list:
+    """Record a FusionPlan's bucket geometry into the registry (called from
+    fusion.fused_allreduce at trace time — once per compile, not per step).
+
+    Returns the recorded [(issue_index, nbytes), ...] list."""
+    global _last_plan
+    reg = registry()
+    sizes = []
+    for i, bucket in enumerate(plan.buckets):
+        nbytes = sum(d.size * d.dtype.itemsize for d in bucket)
+        if plan.pad_to > 1:
+            elems = sum(d.size for d in bucket)
+            rem = elems % plan.pad_to
+            if rem:
+                nbytes += (plan.pad_to - rem) * bucket[0].dtype.itemsize
+        sizes.append((i, nbytes))
+    total = sum(n for _, n in sizes) or 1
+    reg.gauge("horovod_fusion_buckets",
+              help="buckets in the latest compiled fusion plan").set(len(sizes))
+    reg.gauge("horovod_fusion_planned_bytes",
+              help="total gradient bytes in the latest fusion plan").set(total)
+    occ = reg.gauge("horovod_fusion_buffer_occupancy",
+                    help="largest bucket bytes / fusion threshold")
+    occ.set(max(n for _, n in sizes) / max(1, threshold))
+    hist = reg.histogram("horovod_fusion_bucket_bytes",
+                         help="per-bucket byte sizes across recorded plans",
+                         buckets=DEFAULT_BYTE_BUCKETS)
+    for _, n in sizes:
+        hist.observe(n)
+    planned = 0.0
+    if plan.reverse_order and len(sizes) > 1:
+        planned = 1.0 - sizes[-1][1] / total
+    reg.gauge(
+        "horovod_overlap_efficiency_planned",
+        help="byte fraction of the bucketed allreduce that the plan allows "
+             "XLA to hide under backward compute (0 = single fused buffer)",
+    ).set(planned)
+    _last_plan = sizes
+    return sizes
+
+
+def last_plan() -> Optional[list]:
+    """[(issue_index, nbytes), ...] of the most recently recorded plan."""
+    return _last_plan
+
+
+# --------------------------------------------------------------- trace parse
+
+
+def _load_latest_trace(logdir: str) -> list:
+    paths = sorted(glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
+                             recursive=True))
+    if not paths:
+        raise FileNotFoundError(f"no trace.json.gz under {logdir}")
+    with gzip.open(paths[-1]) as f:
+        return json.load(f)["traceEvents"]
+
+
+def _is_collective(name: str, category: str) -> bool:
+    s = (name + " " + category).lower()
+    return any(m in s for m in _COLLECTIVE_MARKERS)
+
+
+def _union_len(intervals: list) -> float:
+    """Total length of the union of (start, end) intervals."""
+    if not intervals:
+        return 0.0
+    intervals.sort()
+    total, cur_s, cur_e = 0.0, intervals[0][0], intervals[0][1]
+    for s, e in intervals[1:]:
+        if s > cur_e:
+            total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    return total + (cur_e - cur_s)
+
+
+def _overlap_len(span: tuple, union: list) -> float:
+    """Length of `span`'s intersection with a sorted disjoint union."""
+    s0, e0 = span
+    out = 0.0
+    for s, e in union:
+        if e <= s0:
+            continue
+        if s >= e0:
+            break
+        out += min(e, e0) - max(s, s0)
+    return out
+
+
+def parse_overlap(events: list) -> dict:
+    """Compute collective/compute overlap from raw Chrome-trace events.
+
+    Uses host-clock spans (``ts``/``dur``, µs) of device ops — the fields
+    every XLA device track carries — grouping by track (pid) so overlap is
+    only counted within one device's own timeline (a collective on chip A
+    overlapping compute on chip B is parallelism, not latency hiding)."""
+    pids = {e["pid"]: e["args"].get("name", "")
+            for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+            and "args" in e}
+    per_dev: dict = collections.defaultdict(lambda: {"coll": [], "comp": []})
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e or "ts" not in e:
+            continue
+        a = e.get("args") or {}
+        if "device_duration_ps" not in a:
+            continue   # host/python frames — not device ops
+        track = pids.get(e["pid"], "")
+        if "TPU" not in track and "GPU" not in track:
+            continue
+        span = (float(e["ts"]), float(e["ts"]) + float(e["dur"]))
+        name = e.get("name", "")
+        cat = str(a.get("hlo_category", ""))
+        kind = "coll" if _is_collective(name, cat) else "comp"
+        per_dev[e["pid"]][kind].append((span, name))
+    coll_total = hidden = 0.0
+    n_coll = 0
+    buckets = []
+    for dev in per_dev.values():
+        comp_union = sorted(s for s, _ in dev["comp"])
+        # normalize to a disjoint union once per device
+        merged: list = []
+        for s, e in comp_union:
+            if merged and s <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], e))
+            else:
+                merged.append((s, e))
+        for span, name in dev["coll"]:
+            dur = span[1] - span[0]
+            ov = _overlap_len(span, merged)
+            coll_total += dur
+            hidden += ov
+            n_coll += 1
+            buckets.append({"name": name, "ms": dur / 1e3,
+                            "hidden_ms": ov / 1e3,
+                            "start_us": span[0], "end_us": span[1]})
+    if n_coll == 0:
+        return {"ok": False,
+                "reason": "no device collective spans in trace (CPU backend "
+                          "traces carry host frames only; run on TPU)"}
+    buckets.sort(key=lambda b: b["start_us"])
+    return {
+        "ok": True,
+        "collectives": n_coll,
+        "collective_ms": round(coll_total / 1e3, 3),
+        "hidden_ms": round(hidden / 1e3, 3),
+        "overlap_efficiency": round(hidden / coll_total, 4) if coll_total else 0.0,
+        "spans": buckets[:64],
+    }
+
+
+def measure_overlap(run_step: Callable[[], None], steps: int = 3,
+                    sync: Optional[Callable[[], None]] = None,
+                    logdir: Optional[str] = None) -> dict:
+    """Profile ``steps`` calls of a warmed ``run_step`` and publish the
+    measured overlap-efficiency gauge. Returns the parse report."""
+    import jax
+
+    fence = sync or (lambda: None)
+    logdir = logdir or tempfile.mkdtemp(prefix="hvd_overlap_")
+    with jax.profiler.trace(logdir):
+        for _ in range(steps):
+            run_step()
+        fence()
+    try:
+        rep = parse_overlap(_load_latest_trace(logdir))
+    except (FileNotFoundError, KeyError, ValueError) as e:
+        rep = {"ok": False, "reason": f"trace unreadable: {e}"}
+    rep["logdir"] = logdir
+    if rep.get("ok"):
+        reg = registry()
+        reg.gauge("horovod_overlap_efficiency_measured",
+                  help="fraction of compiled-path collective device time "
+                       "hidden under concurrent compute (profiler-derived)"
+                  ).set(rep["overlap_efficiency"])
+        reg.gauge("horovod_overlap_collective_ms",
+                  help="collective device ms in the profiled window"
+                  ).set(rep["collective_ms"])
+        reg.gauge("horovod_overlap_hidden_ms",
+                  help="collective device ms overlapped with compute"
+                  ).set(rep["hidden_ms"])
+    return rep

@@ -1,0 +1,182 @@
+"""Stall / straggler watchdog — reference ``HOROVOD_STALL_CHECK`` semantics.
+
+The reference's CheckForStalledTensors (operations.cc:1625-1672) warns when
+a tensor has been submitted by a subset of ranks for longer than
+``HOROVOD_STALL_CHECK_TIME``, naming the tensor AND the missing ranks, and
+``HOROVOD_STALL_SHUTDOWN_TIME`` escalates to aborting the job. Here that
+logic lives on its own thread so it keeps reporting even when the engine
+loop itself is wedged inside a blocking exchange:
+
+- **sources** are callbacks returning the current in-flight set
+  (:class:`StallInfo` per tensor). The Python engine registers its queue;
+  on the coordinator rank it registers the pending table instead, which
+  knows exactly which ranks are missing per tensor. The native engine does
+  its own coordinator-side scan (cc/src/engine.cc scan_stalls) — its
+  warnings reach the registry through the c_api collector, not this thread.
+- every poll, tensors older than ``check_time_s`` produce a warning (rate
+  limited to one per tensor per window) and refresh the structured
+  **report** published at ``registry().get_info("stall_report")`` — the
+  thing ``docs/troubleshooting.md`` tells a hung user to read.
+- past ``shutdown_time_s`` (0 disables, the default) the ``on_abort``
+  callback fires once per tensor: the engine fails that collective with an
+  error naming the missing ranks, so the training loop gets an exception
+  instead of an eternal hang (softer than the reference's process abort,
+  same escalation contract).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .registry import MetricsRegistry, registry
+from ..utils.logging import log
+
+
+@dataclass
+class StallInfo:
+    name: str
+    op: str
+    age_s: float
+    missing_ranks: Optional[list] = None   # None = unknown (non-coordinator)
+
+
+@dataclass
+class StallReport:
+    time_unix_s: float
+    rank: int
+    text: str
+    stalled: list = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "time_unix_s": self.time_unix_s,
+            "rank": self.rank,
+            "text": self.text,
+            "stalled": [
+                {"name": s.name, "op": s.op, "age_s": round(s.age_s, 3),
+                 "missing_ranks": s.missing_ranks}
+                for s in self.stalled
+            ],
+        }
+
+
+def format_report(stalled: list, check_time_s: float) -> str:
+    parts = []
+    for s in stalled:
+        missing = ("missing ranks: " +
+                   ", ".join(str(r) for r in s.missing_ranks)
+                   if s.missing_ranks else "missing ranks unknown on this rank")
+        parts.append(f"{s.name} ({s.op}, waiting {s.age_s:.1f}s, {missing})")
+    return (
+        "One or more tensors were submitted to be reduced, gathered or "
+        "broadcasted by subset of ranks and are waiting for the remainder "
+        f"for more than {check_time_s:g} seconds. Stalled ops: "
+        + "; ".join(parts)
+    )
+
+
+class StallWatchdog:
+    def __init__(self, check_time_s: float, shutdown_time_s: float = 0.0,
+                 rank: int = 0,
+                 on_abort: Optional[Callable[[StallInfo], None]] = None,
+                 reg: Optional[MetricsRegistry] = None,
+                 poll_interval_s: Optional[float] = None) -> None:
+        self.check_time_s = float(check_time_s)
+        self.shutdown_time_s = float(shutdown_time_s)
+        self.rank = rank
+        self.on_abort = on_abort
+        self.reg = reg or registry()
+        # Poll a few times per warning window so a stall is reported within
+        # ~1.25x of check_time even for sub-second test configurations.
+        self.poll_interval_s = poll_interval_s or max(
+            0.05, min(1.0, self.check_time_s / 4.0))
+        self._sources: list[Callable[[], list]] = []
+        self._last_warned: dict[str, float] = {}
+        self._aborted: set[str] = set()
+        self._stop = threading.Event()
+        self._warn_counter = self.reg.counter(
+            "horovod_stall_warnings_total",
+            help="stall-watchdog warning reports emitted")
+        self._abort_counter = self.reg.counter(
+            "horovod_stall_aborts_total",
+            help="collectives failed by the stall watchdog past "
+                 "HOROVOD_STALL_SHUTDOWN_TIME")
+        self._stalled_gauge = self.reg.gauge(
+            "horovod_stalled_tensors",
+            help="tensors currently past HOROVOD_STALL_CHECK_TIME")
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd_stall_watchdog", daemon=True)
+        self._thread.start()
+
+    def add_source(self, fn: Callable[[], list]) -> None:
+        """``fn() -> list[StallInfo]`` describing the caller's in-flight set
+        (any age; the watchdog applies the thresholds)."""
+        self._sources.append(fn)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def report(self) -> Optional[dict]:
+        """Latest structured stall report (None when healthy)."""
+        return self.reg.get_info("stall_report")
+
+    # -- internals -----------------------------------------------------------
+
+    def _collect(self) -> list:
+        infos: list = []
+        for fn in list(self._sources):
+            try:
+                infos.extend(fn() or [])
+            except Exception:   # a dying engine must not kill its watchdog
+                pass
+        return infos
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self._scan()
+
+    def _scan(self) -> None:
+        now = time.monotonic()
+        stalled = [s for s in self._collect() if s.age_s > self.check_time_s]
+        self._stalled_gauge.set(len(stalled))
+        if not stalled:
+            return
+        fresh = [s for s in stalled
+                 if now - self._last_warned.get(s.name, 0.0) > self.check_time_s]
+        if fresh:
+            for s in fresh:
+                self._last_warned[s.name] = now
+            text = format_report(stalled, self.check_time_s)
+            log("warning", text, rank=self.rank)
+            self._warn_counter.inc()
+        # Publish/refresh the structured report every scan while stalled, so
+        # a reader always sees current ages.
+        rep = StallReport(time_unix_s=time.time(), rank=self.rank,
+                          text=format_report(stalled, self.check_time_s),
+                          stalled=stalled)
+        self.reg.set_info("stall_report", rep.to_dict())
+        if self.shutdown_time_s > 0 and self.on_abort is not None:
+            for s in stalled:
+                if s.age_s > self.shutdown_time_s and s.name not in self._aborted:
+                    self._aborted.add(s.name)
+                    log("error",
+                        f"stall watchdog: aborting {s.name} after "
+                        f"{s.age_s:.1f}s (> HOROVOD_STALL_SHUTDOWN_TIME="
+                        f"{self.shutdown_time_s:g}s)", rank=self.rank)
+                    # An abort hook may return False to signal "not handled
+                    # yet" (e.g. the entry was momentarily checked out of
+                    # the engine queue by an in-flight exchange) — retry on
+                    # the next scan instead of marking the tensor dealt
+                    # with forever.
+                    try:
+                        handled = self.on_abort(s)
+                    except Exception:
+                        handled = False
+                    if handled is False:
+                        self._aborted.discard(s.name)
+                    else:
+                        self._abort_counter.inc()

@@ -234,6 +234,13 @@ def fused_allreduce(
                 f"hierarchical fusion needs the size of axis {ici_axis!r}: "
                 f"call inside shard_map/pmap or under `with mesh:`")
     plan = build_plan(tree, threshold, pad_to=pad_to, num_buckets=num_buckets)
+    # Telemetry (ISSUE 2): record the bucket geometry — count, per-bucket
+    # bytes in issue order, buffer occupancy, planned overlap bound — in
+    # the metrics registry. Runs at TRACE time (once per compile), so the
+    # compiled hot path carries zero instrumentation cost.
+    from ..metrics import record_plan
+
+    record_plan(plan, threshold)
     buffers = fuse(tree, plan)
     orig_dtypes = [buf.dtype for buf in buffers]
     if compress is not None:

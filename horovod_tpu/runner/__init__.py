@@ -127,6 +127,7 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
                 make_env=lambda i: _worker_env(i, driver.addresses(), None, env))
             results = driver.wait_results(timeout=timeout,
                                           liveness=spawner.liveness)
+            _emit_pod_metrics(driver)
             return [results[r] for r in sorted(results)]
         finally:
             spawner.kill()
@@ -154,10 +155,39 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         results = driver.wait_results(timeout=timeout, liveness=liveness)
         for p in procs:
             p.wait(timeout=30)
+        _emit_pod_metrics(driver)
         return [results[r] for r in sorted(results)]
     finally:
         terminate_trees(procs)
         driver.stop()
+
+
+def _emit_pod_metrics(driver: DriverService) -> None:
+    """Pod-wide telemetry at job end (ISSUE 2): every worker attached its
+    final metrics snapshot to its result payload; write the merged view to
+    HOROVOD_METRICS_SNAPSHOT when set (JSON file — the launcher-side analog
+    of bench.py --metrics) and log a one-line summary. Never fatal."""
+    path = os.environ.get("HOROVOD_METRICS_SNAPSHOT", "")
+    try:
+        pod = driver.pod_metrics()
+        if pod is None:
+            return
+        if path:
+            import json
+
+            with open(path, "w") as f:
+                json.dump(pod, f, indent=2)
+        from ..utils.logging import log
+
+        key = 'horovod_collectives_total{op="allreduce"}'
+        log("debug",
+            f"pod metrics: {pod['ranks_reporting']}/{pod['ranks']} ranks "
+            f"reporting, {pod['counters'].get(key, 0):.0f} allreduces"
+            + (f" -> {path}" if path else ""))
+    except Exception as e:  # pragma: no cover - telemetry must not kill jobs
+        from ..utils.logging import log
+
+        log("warning", f"pod metrics emission failed: {e}")
 
 
 def run_command(command: Sequence[str], num_proc: Optional[int] = None,

@@ -49,6 +49,9 @@ class HostAgent(BasicService):
     Protocol (request ``kind`` → response):
 
     - ``ping`` → ``{ok, host_hash, jobs}`` — health + identity probe.
+    - ``metrics`` → ``{ok, host_hash, jobs, workers_running,
+      workers_spawned_total, workers_exited_nonzero_total}`` — host-level
+      telemetry for the driver's pod view (docs/metrics.md).
     - ``spawn`` ``{job_id, workers: [{index, argv, env}], cwd?}`` →
       ``{ok, pids}`` — start one process per entry, each in its own session
       (so `proc_tree.terminate_trees` can reap whole trees).
@@ -61,6 +64,9 @@ class HostAgent(BasicService):
         self._jobs_lock = threading.Lock()
         # job_id -> {"procs": {index: Popen}, "owner": client_addr}
         self._jobs: dict[str, dict] = {}
+        self._spawned_total = 0
+        self._exited_nonzero_total = 0
+        self._exit_counted: set[int] = set()  # pids already tallied
 
     def handle(self, req: Any, client_addr) -> Any:
         kind = req.get("kind")
@@ -70,6 +76,17 @@ class HostAgent(BasicService):
             return {"ok": True, "host_hash": host_hash(), "jobs": njobs}
         if kind == "spawn":
             return self._spawn(req, client_addr)
+        if kind == "metrics":
+            with self._jobs_lock:
+                running = sum(
+                    1 for job in self._jobs.values()
+                    for p in job["procs"].values() if p.poll() is None)
+                return {"ok": True, "host_hash": host_hash(),
+                        "jobs": len(self._jobs),
+                        "workers_running": running,
+                        "workers_spawned_total": self._spawned_total,
+                        "workers_exited_nonzero_total":
+                            self._exited_nonzero_total}
         if kind == "poll":
             with self._jobs_lock:
                 job = self._jobs.get(req["job_id"])
@@ -77,6 +94,11 @@ class HostAgent(BasicService):
                     return {"ok": False, "error": f"unknown job {req['job_id']!r}"}
                 workers = [{"index": i, "pid": p.pid, "returncode": p.poll()}
                            for i, p in sorted(job["procs"].items())]
+                for w in workers:
+                    if w["returncode"] not in (None, 0) \
+                            and w["pid"] not in self._exit_counted:
+                        self._exit_counted.add(w["pid"])
+                        self._exited_nonzero_total += 1
             return {"ok": True, "workers": workers}
         if kind == "kill":
             self._kill_job(req["job_id"])
@@ -112,6 +134,7 @@ class HostAgent(BasicService):
                 terminate_trees(list(procs.values()))
                 return {"ok": False, "error": f"job {job_id!r} already exists"}
             self._jobs[job_id] = {"procs": procs, "owner": client_addr}
+            self._spawned_total += len(procs)
         return {"ok": True, "pids": [p.pid for p in procs.values()]}
 
     def _kill_job(self, job_id: str) -> None:

@@ -38,6 +38,10 @@ class DriverService(BasicService):
         self._registrations: dict[int, dict] = {}   # index -> {host_hash, addresses}
         self._ranks: Optional[dict[int, int]] = None  # index -> rank
         self._results: dict[int, Any] = {}
+        # rank -> latest metrics snapshot (pushed mid-run via the `metrics`
+        # request or attached to the final result payload); rank 0 of the
+        # control plane — this driver — merges them into the pod view.
+        self._metrics: dict[int, dict] = {}
         self.coord_addr: Optional[str] = None
         self.jax_coord_addr: Optional[str] = None
 
@@ -82,7 +86,16 @@ class DriverService(BasicService):
         if kind == "result":
             with self._cv:
                 self._results[req["rank"]] = req["value"]
+                value = req["value"]
+                if isinstance(value, dict) and isinstance(
+                        value.get("metrics"), dict):
+                    self._metrics[req["rank"]] = value["metrics"]
                 self._cv.notify_all()
+            return {"ok": True}
+        if kind == "metrics":
+            # Mid-run snapshot push (TaskAgent.report_metrics): latest wins.
+            with self._cv:
+                self._metrics[req["rank"]] = req["snapshot"]
             return {"ok": True}
         return {"ok": False, "error": f"unknown request {kind}"}
 
@@ -172,6 +185,21 @@ class DriverService(BasicService):
         return {r: (v["value"] if isinstance(v, dict) and "value" in v else v)
                 for r, v in results.items()}
 
+    def pod_metrics(self) -> Optional[dict]:
+        """Pod-wide merge of the per-rank metrics snapshots collected so far
+        (mid-run pushes and/or final result payloads); None when no rank has
+        reported telemetry."""
+        with self._lock:
+            if not self._metrics:
+                return None
+            snaps: list = [None] * self.num_proc
+            for r, s in self._metrics.items():
+                if 0 <= r < self.num_proc:
+                    snaps[r] = s
+        from ..metrics import merge_snapshots
+
+        return merge_snapshots(snaps)
+
 
 def host_hash() -> str:
     """Host identity for rank grouping (reference horovod/spark/host_hash.py:
@@ -247,6 +275,27 @@ class TaskAgent:
             os.environ["HOROVOD_JAX_COORDINATOR"] = assignment["jax_coord_addr"]
         return assignment
 
+    def report_metrics(self) -> None:
+        """Push this rank's current metrics snapshot to the driver (mid-run;
+        the final snapshot rides the result payload automatically)."""
+        from ..metrics import snapshot
+
+        self.client.request({"kind": "metrics",
+                             "rank": int(os.environ["HOROVOD_RANK"]),
+                             "snapshot": snapshot()})
+
+    @staticmethod
+    def _final_snapshot() -> Optional[dict]:
+        """This rank's metrics snapshot for the result payload. Collected
+        even on failure (the snapshot of a crashed rank is exactly the
+        interesting one); never lets telemetry break result delivery."""
+        try:
+            from ..metrics import snapshot
+
+            return snapshot()
+        except Exception:
+            return None
+
     def run(self) -> Any:
         self.register()  # registers, waits for assignment, exports HOROVOD_* env
         import pickle
@@ -259,6 +308,7 @@ class TaskAgent:
             payload = {"ok": True, "value": value}
         except BaseException:
             payload = {"ok": False, "error": traceback.format_exc()}
+        payload["metrics"] = self._final_snapshot()
         self.client.request({"kind": "result",
                              "rank": int(os.environ["HOROVOD_RANK"]),
                              "value": payload})

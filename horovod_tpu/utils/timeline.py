@@ -32,8 +32,21 @@ class Timeline:
         self._tensor_pids: dict[str, int] = {}
         self._next_pid = 1
         self._lock = threading.Lock()
+        # Backpressure policy: the hot path never blocks on file IO — a full
+        # queue sheds the event and COUNTS the shed (docs/timeline.md), so a
+        # gappy trace is diagnosable instead of silently incomplete.
+        from ..metrics import registry as _metrics_registry
+
+        self._dropped = _metrics_registry().counter(
+            "horovod_timeline_dropped_total",
+            help="timeline events dropped because the writer queue was "
+                 "full or the writer failed")
         self._thread = threading.Thread(target=self._writer_loop, name="hvd_timeline", daemon=True)
         self._thread.start()
+
+    @property
+    def dropped(self) -> int:
+        return int(self._dropped.value)
 
     # -- event emission (Timeline::NegotiateStart/Start/ActivityStart/End, timeline.h:83-93)
 
@@ -54,7 +67,7 @@ class Timeline:
         try:
             self._q.put_nowait(ev)
         except queue.Full:  # drop rather than block the hot path
-            pass
+            self._dropped.inc()
 
     def negotiate_start(self, name: str, op: str) -> None:
         pid = self._pid(name)
@@ -95,7 +108,20 @@ class Timeline:
     # -- writer thread
 
     def _writer_loop(self) -> None:
-        with open(self.path, "w") as f:
+        # An unwritable path (bad HOROVOD_TIMELINE, disk full) must not kill
+        # the thread silently: the trace degrades to counted drops and the
+        # engine keeps running — telemetry never takes the job down.
+        try:
+            f = open(self.path, "w")
+        except OSError:
+            while not (self._stop.is_set() and self._q.empty()):
+                try:
+                    self._q.get(timeout=0.1)
+                    self._dropped.inc()
+                except queue.Empty:
+                    continue
+            return
+        with f:
             f.write("[\n")
             first = True
             while not (self._stop.is_set() and self._q.empty()):
@@ -103,11 +129,14 @@ class Timeline:
                     ev = self._q.get(timeout=0.1)
                 except queue.Empty:
                     continue
-                if not first:
-                    f.write(",\n")
-                f.write(json.dumps(ev))
-                first = False
-                f.flush()
+                try:
+                    if not first:
+                        f.write(",\n")
+                    f.write(json.dumps(ev))
+                    first = False
+                    f.flush()
+                except OSError:  # disk full mid-trace: shed and count
+                    self._dropped.inc()
             f.write("\n]\n")
 
     def close(self) -> None:

@@ -27,24 +27,38 @@ def build_native():
     lib_path()
 
 
-def test_eager_sweep_structure_and_sanity():
-    # One bounded retry on the throughput sanity check: mid-suite the box
-    # carries the previous tests' process churn, and a single noisy window
-    # can land a world-3 sweep under the bound that it clears in isolation.
-    # The structural assertions are NOT retried.
-    for attempt in range(2):
+def test_eager_sweep_structure():
+    """Fast tier: structural invariants of the sweep output only. The
+    wall-clock throughput bound lives in the slow-tier test below (ISSUE 2
+    satellite): it was the lone tier-1 flake since PR 1 — mid-suite, the
+    shared single-core box carries every previous test's process churn and
+    even a best-of-3 world-3 window can land under a bound it clears in
+    isolation, so the bound is load-sensitive by construction and does not
+    belong in the fast tier."""
+    out = sb.eager_scaling(worlds=(2, 3), payload_mb=4.0, iters=1)
+    rows = out["worlds"]
+    assert [r["world"] for r in rows] == [2, 3]
+    assert rows[0]["software_efficiency"] == 1.0
+    # per-rank rate falls with world on a shared host — the documented
+    # shape (not load-sensitive in the failing direction)
+    assert rows[1]["MB_per_s_rank"] < rows[0]["MB_per_s_rank"] * 1.2
+
+
+@pytest.mark.slow
+def test_eager_sweep_throughput_bound():
+    """Aggregate throughput must not collapse from a world-2 to a world-3
+    coordinator: anything under half the baseline would mean superlinear
+    software overhead. Best-of-3 because a single noisy window on a shared
+    single-core host is load, not regression — a genuine regression fails
+    all three attempts."""
+    best = -1.0
+    for _ in range(3):
         out = sb.eager_scaling(worlds=(2, 3), payload_mb=4.0, iters=1)
         rows = out["worlds"]
-        assert [r["world"] for r in rows] == [2, 3]
-        assert rows[0]["software_efficiency"] == 1.0
-        if rows[1]["software_efficiency"] > 0.4 or attempt == 1:
+        best = max(best, rows[1]["software_efficiency"])
+        if best > 0.4:
             break
-    # Aggregate throughput must not collapse from a world-2 to a world-3
-    # coordinator: anything under half the baseline would mean superlinear
-    # software overhead (generous bound — a shared single-core host is noisy).
-    assert rows[1]["software_efficiency"] > 0.4, rows
-    # per-rank rate falls with world on a shared host — the documented shape
-    assert rows[1]["MB_per_s_rank"] < rows[0]["MB_per_s_rank"] * 1.2
+    assert best > 0.4, rows
 
 
 def test_eager_hierarchical_grid_cuts_cross_bytes():

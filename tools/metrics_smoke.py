@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""CI smoke for the telemetry layer (ISSUE 2 satellite; wired into ci.sh).
+
+Spawns a 2-process eager "train" with metrics exposition AND the stall
+check enabled, then verifies the full observability contract end to end:
+
+1. each rank serves /metrics.json (HOROVOD_METRICS_PORT) — the driver
+   scrapes BOTH ranks live and validates every snapshot against the
+   checked-in schema (docs/metrics_schema.json);
+2. an injected straggler (rank 1 delays one tensor past
+   HOROVOD_STALL_CHECK_TIME) must surface in the scraped telemetry:
+   non-zero stall-warning counters and a stall report naming the tensor;
+3. rank 0 merges the per-rank snapshots in-band (allgather_object) and the
+   pod aggregate validates against the pod schema with the expected
+   collective counts;
+4. the timeline written during the run parses as STRICT json with the
+   expected phases (the trailing-comma hardening).
+
+Exits non-zero with a reason on any violation. Wall-clock budget: ~15 s.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORLD = 2
+
+WORKER = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+from horovod_tpu import metrics
+
+hvd.init()
+eng = basics.engine()
+rank = hvd.rank()
+for i in range(10):
+    eng.run("allreduce", np.full(256, float(rank), np.float32), f"grad.{i}")
+# injected straggler: rank 1 sits out `late.tensor` past
+# HOROVOD_STALL_CHECK_TIME, so the watchdog/coordinator must warn
+if rank == 1:
+    time.sleep(2.2)
+eng.run("allreduce", np.ones(8), "late.tensor")
+snaps = hvd.allgather_object(metrics.snapshot(), name="smoke.metrics")
+if rank == 0:
+    print(json.dumps({"pod": metrics.merge_snapshots(snaps)}), flush=True)
+# hold the exposition server open until the driver has scraped both ranks
+smoke = os.environ["SMOKE_DIR"]
+with open(os.path.join(smoke, f"ready.{rank}"), "w") as f:
+    f.write("1")
+deadline = time.monotonic() + 30
+while not os.path.exists(os.path.join(smoke, "go")) \
+        and time.monotonic() < deadline:
+    time.sleep(0.05)
+hvd.shutdown()
+print(json.dumps({"rank": rank, "ok": True}))
+"""
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def fail(msg: str) -> None:
+    print(f"metrics smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def fetch_json(url: str):
+    return json.loads(urllib.request.urlopen(url, timeout=10).read())
+
+
+def main() -> int:
+    from horovod_tpu.metrics import validate_snapshot
+
+    tmp = tempfile.mkdtemp(prefix="hvd_metrics_smoke_")
+    timeline = os.path.join(tmp, "timeline.json")
+    coord_port = free_port()
+    metrics_base = free_port()
+    procs = []
+    for rank in range(WORLD):
+        env = dict(os.environ)
+        env.update({
+            "HVD_REPO": REPO,
+            "SMOKE_DIR": tmp,
+            "JAX_PLATFORMS": "cpu",
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(WORLD),
+            "HOROVOD_LOCAL_RANK": str(rank),
+            "HOROVOD_LOCAL_SIZE": str(WORLD),
+            "HOROVOD_COORD_ADDR": f"127.0.0.1:{coord_port}",
+            "HOROVOD_SECRET": env_secret,
+            "HOROVOD_METRICS_PORT": str(metrics_base),
+            "HOROVOD_STALL_CHECK_TIME": "1.0",
+            "HOROVOD_TIMELINE": timeline,
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(os.path.exists(os.path.join(tmp, f"ready.{r}"))
+                   for r in range(WORLD)):
+                break
+            for p in procs:
+                if p.poll() not in (None, 0):
+                    _, err = p.communicate()
+                    fail(f"worker died rc={p.returncode}:\n{err[-3000:]}")
+            time.sleep(0.1)
+        else:
+            fail("workers never reached the ready barrier")
+
+        # 1. live scrape of BOTH ranks (port + local_rank), schema-validated
+        warnings_seen = 0
+        for rank in range(WORLD):
+            base = f"http://127.0.0.1:{metrics_base + rank}"
+            snap = fetch_json(f"{base}/metrics.json")
+            errs = validate_snapshot(snap)
+            if errs:
+                fail(f"rank {rank} snapshot schema violations: {errs[:5]}")
+            text = urllib.request.urlopen(
+                f"{base}/metrics", timeout=10).read().decode()
+            if "horovod_collectives_total" not in text:
+                fail(f"rank {rank} Prometheus text lacks collective counters")
+            warnings_seen += int(snap["gauges"].get(
+                "horovod_native_stall_warnings", 0))
+        # 2. the injected straggle produced stall telemetry somewhere
+        if warnings_seen < 1:
+            fail("no stall warnings counted despite the injected straggler")
+    finally:
+        with open(os.path.join(tmp, "go"), "w") as f:
+            f.write("1")
+        outs = []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, err = p.communicate()
+            outs.append((p.returncode, out, err))
+    for rank, (rc, out, err) in enumerate(outs):
+        if rc != 0:
+            fail(f"rank {rank} exited rc={rc}:\n{err[-3000:]}")
+
+    # 3. pod aggregate printed by rank 0: schema + expected counts
+    pod_line = next((l for l in outs[0][1].splitlines() if '"pod"' in l), None)
+    if pod_line is None:
+        fail(f"rank 0 printed no pod snapshot:\n{outs[0][1][-2000:]}")
+    pod = json.loads(pod_line)["pod"]
+    errs = validate_snapshot(pod)
+    if errs:
+        fail(f"pod snapshot schema violations: {errs[:5]}")
+    key = 'horovod_collectives_total{op="allreduce"}'
+    count = pod["counters"].get(key, 0)
+    if count < WORLD * 11:   # 10 grads + late.tensor, per rank
+        fail(f"pod {key}={count}, expected >= {WORLD * 11}")
+    if 'horovod_collective_seconds{op="allreduce"}' not in pod["histograms"]:
+        fail("pod snapshot lacks the collective latency histogram")
+
+    # 4. timeline shape: strict JSON, expected phases
+    with open(timeline) as f:
+        events = json.load(f)
+    if not (isinstance(events, list) and events):
+        fail("timeline is not a non-empty JSON array")
+    blob = json.dumps(events)
+    for needle in ("NEGOTIATE_ALLREDUCE", "late.tensor"):
+        if needle not in blob:
+            fail(f"timeline lacks {needle!r}")
+
+    print(f"metrics smoke OK: {WORLD} ranks scraped + schema-validated, "
+          f"{count:.0f} pod allreduces, stall warnings surfaced, "
+          f"timeline valid ({len(events)} events)")
+    return 0
+
+
+env_secret = secrets.token_hex(16)
+
+if __name__ == "__main__":
+    sys.exit(main())
