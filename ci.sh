@@ -42,6 +42,9 @@ HVD_BENCH_SMOKE=1 timeout -k 10 240 env JAX_PLATFORMS=cpu \
 echo "== metrics smoke (2-proc train, stall check + exposition; snapshot vs docs/metrics_schema.json, timeline JSON shape) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_smoke.py
 
+echo "== elastic smoke (3-proc train, kill one worker at step 5: survivors resume from last commit, dead slot blacklisted, resets in pod metrics) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/elastic_smoke.py
+
 echo "== fast tier (includes the launcher e2e: test_run_happy_path) =="
 python -m pytest tests/ -m fast -q
 

@@ -51,7 +51,19 @@ from .parallel.mesh import (  # noqa: F401
 # packages (horovod.tensorflow vs horovod.torch import independently).
 from . import jax  # noqa: F401  (JAX is the required core framework)
 from . import metrics  # noqa: F401  (telemetry registry + stall watchdog)
+from . import elastic  # noqa: F401  (fault-tolerant re-scaling, ISSUE 3)
 from .utils import timeline  # noqa: F401  (hvd.timeline.trace two-pane profile)
+
+
+def __getattr__(name: str):
+    # The launcher package is heavyweight (spawning, agents, TCP services)
+    # and most library users never touch it — resolve `hvd.runner` lazily
+    # so `hvd.runner.run_elastic(...)` works without an eager import.
+    if name == "runner":
+        import importlib
+
+        return importlib.import_module(".runner", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def _is_tracer(x) -> bool:

@@ -1,0 +1,66 @@
+"""Elastic training — fault-tolerant re-scaling (ISSUE 3 tentpole).
+
+The capability the paper's §5.7 honest accounting names as missing and
+upstream Horovod later shipped as ``hvd.elastic``: a pod-scale job
+survives worker death and host loss without restarting from scratch, and
+absorbs new hosts mid-run.
+
+    import horovod_tpu as hvd
+
+    state = hvd.elastic.ElasticState(params=params, opt_state=opt_state,
+                                     step=0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.step < TOTAL_STEPS:
+            state.params, state.opt_state = train_step(
+                state.params, state.opt_state)
+            state.step += 1
+            state.commit()
+        return state.step
+
+    results = hvd.runner.run_elastic(train, args=(state,), num_proc=8)
+
+Pieces (docs/elastic.md for the full model):
+
+- :class:`ElasticState` (state.py) — commit/restore/sync training state;
+  optionally checkpoint-backed through ``horovod_tpu.checkpoint``.
+- :func:`run` (run.py) — the reset wrapper: catches collective failures
+  (including the stall watchdog's shutdown escalation), tears down the
+  communicator, re-rendezvouses, restores the last commit, re-enters.
+- ``runner.run_elastic`` / :mod:`~horovod_tpu.elastic.driver` — the
+  supervising launcher: rendezvous generations, respawn, blacklist,
+  host discovery.
+- :class:`HostDiscovery` / :class:`StaticDiscovery` /
+  :class:`ScriptDiscovery`, :class:`Blacklist` (discovery.py).
+- :mod:`~horovod_tpu.elastic.fault` — env-triggered fault injection for
+  tests and the ci.sh elastic smoke.
+"""
+
+from __future__ import annotations
+
+from . import fault  # noqa: F401
+from .discovery import (  # noqa: F401
+    Blacklist,
+    HostDiscovery,
+    ScriptDiscovery,
+    StaticDiscovery,
+    parse_discovery_output,
+)
+from .run import RESETTABLE, poll_host_updates, run  # noqa: F401
+from .state import ElasticState, HostsUpdatedInterrupt  # noqa: F401
+
+
+def __getattr__(name: str):
+    # Lazy: WorkerRemovedError lives with the runner services; importing the
+    # runner package here would pull the whole launcher into `import
+    # horovod_tpu`.
+    if name == "WorkerRemovedError":
+        from ..runner.service import WorkerRemovedError
+
+        return WorkerRemovedError
+    if name == "run_elastic":
+        from ..runner import run_elastic
+
+        return run_elastic
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,86 @@
+"""Env-triggered fault injection — the test/chaos hooks the elastic smoke
+and the reset tests drive (ISSUE 3 tentpole item 4).
+
+Everything here is opt-in via environment variables and free when unset;
+none of it belongs in a production config:
+
+- ``HOROVOD_FAULT_INJECT_STEP=N`` + ``HOROVOD_FAULT_INJECT_INDEX=i``:
+  the worker at task index ``i`` kills itself when :func:`maybe_die` is
+  called with ``step == N``. Training loops call ``maybe_die(step)`` once
+  per step (``ElasticState.commit`` calls it with the state's ``step``/
+  ``batch`` value when one exists, so elastic loops get the hook for
+  free). A worker resumed from a commit PAST step N never re-triggers —
+  which is exactly how the respawn-then-survive path is exercised — while
+  a commit cadence that replays step N re-kills the worker and exercises
+  the repeated-failure -> blacklist path.
+- ``HOROVOD_FAULT_INJECT_SIGNAL`` (default ``KILL``): how to die — a
+  signal name/number sent to self (``KILL`` models a hard crash: no
+  result report, no clean TCP shutdown) or ``exit:<code>`` for
+  ``os._exit``.
+- ``HOROVOD_FAULT_AGENT_EXIT_AFTER_S=S``: a resident hvd-agent hard-exits
+  ``S`` seconds after start (agent.py) — the host-loss scenario.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+
+def _target_index() -> str:
+    return os.environ.get("HOROVOD_FAULT_INJECT_INDEX", "")
+
+
+def armed() -> bool:
+    """True when this process is the fault target (cheap pre-check)."""
+    step = os.environ.get("HOROVOD_FAULT_INJECT_STEP", "")
+    if not step:
+        return False
+    target = _target_index()
+    return target == "" or target == os.environ.get("HOROVOD_TASK_INDEX", "")
+
+
+def maybe_die(step) -> None:
+    """Kill this worker if the injected fault matches ``(step, index)``."""
+    if not armed():
+        return
+    try:
+        if int(step) != int(os.environ["HOROVOD_FAULT_INJECT_STEP"]):
+            return
+    except (TypeError, ValueError):
+        return
+    die()
+
+
+def die() -> None:
+    """Die the configured way, now. Logs first so the event is attributable
+    in worker stderr."""
+    spec = os.environ.get("HOROVOD_FAULT_INJECT_SIGNAL", "KILL")
+    from ..utils.logging import log
+
+    log("warning", f"fault injection firing ({spec}) at task index "
+        f"{os.environ.get('HOROVOD_TASK_INDEX', '?')}")
+    if spec.startswith("exit:"):
+        os._exit(int(spec.split(":", 1)[1]))
+    try:
+        sig = int(spec)
+    except ValueError:
+        sig = getattr(signal, f"SIG{spec.upper()}", signal.SIGKILL)
+    os.kill(os.getpid(), sig)
+
+
+def start_agent_fault_timer() -> None:
+    """Arm HOROVOD_FAULT_AGENT_EXIT_AFTER_S on a resident agent: hard-exit
+    after the delay, modeling sudden host loss (the driver must notice via
+    the broken connection, not a goodbye)."""
+    delay = os.environ.get("HOROVOD_FAULT_AGENT_EXIT_AFTER_S", "")
+    if not delay:
+        return
+
+    def _boom() -> None:
+        os._exit(1)
+
+    t = threading.Timer(float(delay), _boom)
+    t.daemon = True
+    t.start()

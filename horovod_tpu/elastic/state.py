@@ -1,0 +1,175 @@
+"""Elastic training state — commit / restore / broadcast-on-reset.
+
+The contract upstream Horovod ships as ``hvd.elastic.State`` (state commit
++ rollback + sync after re-rendezvous), framework-free here:
+
+    state = hvd.elastic.ElasticState(params=params, opt_state=opt_state,
+                                     epoch=0, step=0)
+    ...
+    state.params, state.opt_state = train_step(...)
+    state.step += 1
+    state.commit()            # in-memory snapshot (+ optional checkpoint)
+
+- ``commit()`` deep-copies every value to host memory (jax arrays are
+  materialized to numpy, so a committed snapshot cannot alias device
+  buffers that a reset tears down). With ``checkpoint_dir`` set, every
+  ``checkpoint_every``-th commit also writes a rank-0 checkpoint through
+  ``horovod_tpu.checkpoint`` — the restart-from-disk story for full-job
+  loss, on top of the in-memory story for worker loss.
+- ``restore()`` rolls the live values back to the last commit (steps run
+  since are discarded — exactly the semantics the reset path needs: an
+  interrupted step may have updated a subset of ranks).
+- ``sync()`` makes the world consistent after a re-rendezvous: rank 0 (by
+  elastic rank assignment always a *survivor* holding the newest commit)
+  broadcasts its committed snapshot; every rank — including workers that
+  just joined and have no history — adopts it.
+
+``commit()`` doubles as the elastic heartbeat: it fires the env-triggered
+fault hooks (fault.py) and polls the driver for membership changes,
+raising :class:`HostsUpdatedInterrupt` so the training loop re-enters
+rendezvous at a step boundary instead of waiting for a failure.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from ..metrics import registry as _registry
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Membership changed (discovery added/removed hosts, or a reset is
+    already forming): re-rendezvous at the next step boundary. State is
+    already committed when this is raised — the reset path syncs without
+    rolling back."""
+
+
+def _copy_tree(tree: Any) -> Any:
+    """Deep copy a pytree with every array leaf materialized to numpy on
+    the host (a committed snapshot must survive engine/device teardown)."""
+    import copy as _copy
+
+    import jax
+    import numpy as np
+
+    def leaf(x):
+        if hasattr(x, "__array__"):
+            return np.array(x)
+        return _copy.deepcopy(x)
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+class ElasticState:
+    """Named training values with commit/restore/sync semantics. Values are
+    attributes (``state.params``), the names are the keys you passed to the
+    constructor; assignment replaces the live value, never the commit."""
+
+    def __init__(self, checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1, **values: Any) -> None:
+        object.__setattr__(self, "_checkpoint_dir", checkpoint_dir)
+        object.__setattr__(self, "_checkpoint_every", max(int(checkpoint_every), 1))
+        object.__setattr__(self, "_values", dict(values))
+        object.__setattr__(self, "_committed", None)
+        object.__setattr__(self, "_commits", 0)
+        # The construction-time values are the first commit: restore() and
+        # sync() are well-defined before the loop's first explicit commit.
+        self.commit(checkpoint=False, check_host_updates=False)
+
+    # -- attribute routing ---------------------------------------------------
+
+    def __getattr__(self, name: str) -> Any:
+        values = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]
+        raise AttributeError(f"ElasticState has no value {name!r}")
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            self._values[name] = value
+
+    def to_dict(self) -> dict:
+        """The live values (not copies)."""
+        return dict(self._values)
+
+    def committed_dict(self) -> dict:
+        """The last committed snapshot (not copies; treat as read-only)."""
+        return dict(self._committed or {})
+
+    # -- commit / restore ----------------------------------------------------
+
+    def commit(self, checkpoint: Optional[bool] = None,
+               check_host_updates: bool = True) -> None:
+        """Snapshot the live values as the new rollback point. Also runs the
+        fault-injection hook and (in an elastic worker) the membership poll
+        — see module docstring."""
+        object.__setattr__(self, "_committed", _copy_tree(self._values))
+        object.__setattr__(self, "_commits", self._commits + 1)
+        _registry().counter(
+            "horovod_elastic_commits_total",
+            help="ElasticState.commit() calls").inc()
+        if checkpoint is None:
+            checkpoint = (self._checkpoint_dir is not None
+                          and self._commits % self._checkpoint_every == 0)
+        if checkpoint and self._checkpoint_dir:
+            from .. import checkpoint as ckpt
+
+            ckpt.save(self._checkpoint_dir, self._committed)
+        from . import fault
+
+        if fault.armed():
+            step = self._values.get("step", self._values.get("batch"))
+            if step is not None:
+                fault.maybe_die(step)
+        if check_host_updates:
+            from .run import poll_host_updates
+
+            if poll_host_updates():
+                raise HostsUpdatedInterrupt(
+                    "elastic membership changed; re-rendezvous requested")
+
+    def restore(self) -> None:
+        """Roll the live values back to the last commit (uncommitted steps
+        are discarded)."""
+        if self._committed is None:  # pragma: no cover - commit() in __init__
+            raise RuntimeError("nothing committed yet")
+        object.__setattr__(self, "_values", _copy_tree(self._committed))
+        _registry().counter(
+            "horovod_elastic_restores_total",
+            help="rollbacks to the last committed elastic state").inc()
+
+    def load_checkpoint(self) -> bool:
+        """Cold-start restore from ``checkpoint_dir`` (full-job restart, not
+        the in-memory reset path). Returns False when no checkpoint exists.
+        Single-rank read (``verify=False``): callers sync() afterwards, and
+        the broadcast is the consistency guarantee."""
+        if not self._checkpoint_dir or not os.path.isdir(self._checkpoint_dir):
+            return False
+        from .. import checkpoint as ckpt
+
+        state = ckpt.restore(self._checkpoint_dir, template=self._values,
+                             verify=False)
+        object.__setattr__(self, "_values", state)
+        self.commit(checkpoint=False, check_host_updates=False)
+        return True
+
+    # -- reset-path consistency ---------------------------------------------
+
+    def sync(self, root_rank: int = 0) -> None:
+        """Adopt rank ``root_rank``'s committed snapshot everywhere (the
+        post-rendezvous broadcast; new workers join with whatever state they
+        constructed and leave with the survivors' commit)."""
+        from ..common import basics
+
+        if basics.is_initialized() and basics.size() > 1:
+            from .. import broadcast_object
+
+            gen = os.environ.get("HOROVOD_ELASTIC_GENERATION", "0")
+            committed = broadcast_object(
+                self._committed, root_rank=root_rank,
+                name=f"elastic.sync.g{gen}")
+            object.__setattr__(self, "_committed", committed)
+        object.__setattr__(self, "_values", _copy_tree(self._committed))

@@ -32,7 +32,37 @@ from typing import Any, Callable, Optional, Sequence, Union
 from .network import make_secret
 from .proc_tree import terminate_trees
 from .remote import HostSpec, RemoteSpawner, parse_hosts  # noqa: F401
-from .service import DriverService, TaskAgent, host_hash  # noqa: F401
+from .service import (  # noqa: F401
+    DriverService,
+    ElasticDriverService,
+    TaskAgent,
+    WorkerRemovedError,
+    host_hash,
+)
+
+
+def run_elastic(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+                num_proc: Optional[int] = None, min_np: int = 1,
+                max_np: Optional[int] = None, env: Optional[dict] = None,
+                timeout: float = 600.0, discovery=None,
+                python: Optional[str] = None,
+                hosts: Union[str, Sequence, None] = None,
+                agent_port: Optional[int] = None,
+                agent_secret: Optional[bytes] = None) -> list:
+    """Elastic launch (ISSUE 3): like :func:`run`, but the job survives
+    worker death — failed slots are respawned or blacklisted, survivors
+    re-rendezvous into a new generation, and ``discovery`` (an
+    ``elastic.HostDiscovery``) can add/remove slots mid-run. With ``hosts``
+    the workers materialize through resident hvd-agents, as in :func:`run`.
+    ``fn`` must build an ``ElasticState`` and call a training function
+    wrapped with ``hvd.elastic.run``. See docs/elastic.md."""
+    from ..elastic.driver import launch_elastic
+
+    return launch_elastic(fn, args=args, kwargs=kwargs, num_proc=num_proc,
+                          min_np=min_np, max_np=max_np, env=env,
+                          timeout=timeout, discovery=discovery, python=python,
+                          hosts=hosts, agent_port=agent_port,
+                          agent_secret=agent_secret)
 
 
 def _spawn_worker(index: int, driver_addrs, secret: bytes, argv: Sequence[str],
@@ -150,6 +180,14 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
                 rc = p.poll()
                 if rc not in (None, 0):
                     return f"worker {i} exited with code {rc} before reporting a result"
+                # A worker that exits CLEANLY without ever delivering a
+                # result is just as dead (sys.exit(0) in user code, a
+                # silently-dropped report): flagging only non-zero codes
+                # left the driver blocking for the full timeout.
+                if rc == 0 and driver.result_pending_index(i):
+                    return (f"worker {i} exited with code 0 before reporting "
+                            "a result (user code exited early, or the result "
+                            "report never reached the driver)")
             return None
 
         results = driver.wait_results(timeout=timeout, liveness=liveness)
@@ -224,6 +262,10 @@ def run_command(command: Sequence[str], num_proc: Optional[int] = None,
                     "HOROVOD_SUPERVISE": "1",
                 })
             deadline = time.monotonic() + timeout if timeout else None
+            # Exponential poll backoff capped at 2 s: short jobs get
+            # sub-100ms exit latency, long jobs don't hammer the agents
+            # with a fixed 2 Hz poll per host for hours.
+            delay = 0.05
             while True:
                 codes = spawner.poll_returncodes()
                 if codes is None:
@@ -236,7 +278,8 @@ def run_command(command: Sequence[str], num_proc: Optional[int] = None,
                     raise TimeoutError(
                         f"{sum(c is None for c in codes)} workers still "
                         f"running after {timeout}s")
-                time.sleep(0.5)
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
         finally:
             spawner.kill()
             spawner.close()

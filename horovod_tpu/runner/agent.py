@@ -52,9 +52,12 @@ class HostAgent(BasicService):
     - ``metrics`` → ``{ok, host_hash, jobs, workers_running,
       workers_spawned_total, workers_exited_nonzero_total}`` — host-level
       telemetry for the driver's pod view (docs/metrics.md).
-    - ``spawn`` ``{job_id, workers: [{index, argv, env}], cwd?}`` →
+    - ``spawn`` ``{job_id, workers: [{index, argv, env}], cwd?, extend?}`` →
       ``{ok, pids}`` — start one process per entry, each in its own session
-      (so `proc_tree.terminate_trees` can reap whole trees).
+      (so `proc_tree.terminate_trees` can reap whole trees). With
+      ``extend`` the workers are ADDED to an existing job (same owner and
+      derived secret) — how an elastic job grows a host's slot set
+      mid-run without re-keying the world.
     - ``poll`` ``{job_id}`` → ``{ok, workers: [{index, pid, returncode}]}``.
     - ``kill`` ``{job_id}`` → ``{ok}`` — terminate the job's worker trees.
     """
@@ -130,10 +133,26 @@ class HostAgent(BasicService):
             terminate_trees(list(procs.values()))
             return {"ok": False, "error": f"spawn failed on {host_hash()}: {e}"}
         with self._jobs_lock:
-            if job_id in self._jobs:
+            job = self._jobs.get(job_id)
+            if job is not None and not req.get("extend"):
                 terminate_trees(list(procs.values()))
                 return {"ok": False, "error": f"job {job_id!r} already exists"}
-            self._jobs[job_id] = {"procs": procs, "owner": client_addr}
+            if job is not None:
+                if job["owner"] != client_addr:
+                    # extend is same-driver only: a different connection
+                    # must not append workers to a job it doesn't own.
+                    terminate_trees(list(procs.values()))
+                    return {"ok": False,
+                            "error": f"job {job_id!r} owned by another driver"}
+                dup = set(job["procs"]) & set(procs)
+                if dup:
+                    terminate_trees(list(procs.values()))
+                    return {"ok": False,
+                            "error": f"job {job_id!r} already has worker "
+                                     f"indices {sorted(dup)}"}
+                job["procs"].update(procs)
+            else:
+                self._jobs[job_id] = {"procs": procs, "owner": client_addr}
             self._spawned_total += len(procs)
         return {"ok": True, "pids": [p.pid for p in procs.values()]}
 
@@ -190,6 +209,11 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     agent = HostAgent(_load_secret(args.secret_file), host=args.host, port=args.port)
+    # Fault injection (tests / elastic smoke): HOROVOD_FAULT_AGENT_EXIT_AFTER_S
+    # hard-exits this agent after a delay, modeling sudden host loss.
+    from ..elastic.fault import start_agent_fault_timer
+
+    start_agent_fault_timer()
     # Machine-readable readiness line: launch scripts / tests wait for it.
     print(json.dumps({"agent": "ready", "port": agent.port,
                       "host_hash": host_hash()}), flush=True)

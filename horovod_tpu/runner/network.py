@@ -25,10 +25,12 @@ import hashlib
 import hmac
 import os
 import pickle
+import random
 import secrets as _secrets
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Optional
 
 
@@ -200,29 +202,45 @@ class BasicService:
 
 
 class BasicClient:
-    """Blocking request/response client with retry-capable connect."""
+    """Blocking request/response client with retry-capable connect.
 
-    def __init__(self, addresses, key: bytes, timeout: float = 60.0) -> None:
+    ``connect_retry_s`` > 0 keeps re-trying the full address list with
+    exponential backoff (jittered, capped at 2 s per sleep) for up to that
+    many seconds before giving up — a cold-starting pod's workers register
+    while the driver service may still be a few hundred ms from listening,
+    and one refused connection must not kill the worker."""
+
+    def __init__(self, addresses, key: bytes, timeout: float = 60.0,
+                 connect_retry_s: float = 0.0) -> None:
         self.key = key
+        deadline = time.monotonic() + max(connect_retry_s, 0.0)
+        delay = 0.05
         last: Optional[Exception] = None
-        for host, port in addresses:
-            sock = None
-            try:
-                sock = socket.create_connection((host, port), timeout=timeout)
-                sock.settimeout(timeout)
-                # The handshake does I/O: a failure here (bad magic from a
-                # non-hvd peer, timeout) must close the already-connected
-                # socket before trying the next address, or it leaks.
-                self._ch = Channel(sock, key, server=False)
-                self.sock = sock
-                return
-            except OSError as e:
-                if sock is not None:
-                    try:
-                        sock.close()
-                    except OSError:
-                        pass
-                last = e
+        while True:
+            for host, port in addresses:
+                sock = None
+                try:
+                    sock = socket.create_connection((host, port), timeout=timeout)
+                    sock.settimeout(timeout)
+                    # The handshake does I/O: a failure here (bad magic from a
+                    # non-hvd peer, timeout) must close the already-connected
+                    # socket before trying the next address, or it leaks.
+                    self._ch = Channel(sock, key, server=False)
+                    self.sock = sock
+                    return
+                except OSError as e:
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                    last = e
+            if time.monotonic() >= deadline:
+                break
+            # Jittered backoff: a whole pod retrying in lockstep would keep
+            # hammering the driver at the same instants.
+            time.sleep(min(delay, 2.0) * (0.5 + random.random()))
+            delay *= 2
         raise ConnectionError(f"cannot reach service at {addresses}: {last}")
 
     def request(self, obj: Any) -> Any:

@@ -22,6 +22,12 @@ from typing import Any, Callable, Optional
 from .network import BasicClient, BasicService
 
 
+class WorkerRemovedError(RuntimeError):
+    """This worker's slot was dropped from the elastic membership (dead
+    slot replaced, host blacklisted, or scale-down): exit instead of
+    waiting for an assignment that will never come."""
+
+
 class DriverService(BasicService):
     """Rank-assignment + function-distribution service (reference
     driver_service.py:98-234)."""
@@ -33,7 +39,10 @@ class DriverService(BasicService):
         self.fn = fn
         self.args = args
         self.kwargs = kwargs or {}
-        self._lock = threading.Lock()
+        # Reentrant: wait_results holds the condition's lock while polling
+        # liveness(), and the liveness closure reads driver state through
+        # result_pending_index — a plain Lock would self-deadlock there.
+        self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
         self._registrations: dict[int, dict] = {}   # index -> {host_hash, addresses}
         self._ranks: Optional[dict[int, int]] = None  # index -> rank
@@ -200,6 +209,192 @@ class DriverService(BasicService):
 
         return merge_snapshots(snaps)
 
+    def result_pending_index(self, index: int) -> bool:
+        """True while no result has arrived for the worker at task ``index``
+        — the liveness check uses this to catch a worker that exits with
+        code 0 WITHOUT reporting (previously invisible: ``rc not in (None,
+        0)`` never flags a clean exit, so the driver blocked for the full
+        timeout)."""
+        with self._lock:
+            if self._ranks is None:
+                return True  # exited before the world even formed
+            rank = self._ranks.get(index)
+            return rank is None or rank not in self._results
+
+
+class ElasticDriverService(DriverService):
+    """Driver service for elastic jobs (ISSUE 3 tentpole): membership is a
+    sequence of *generations* instead of one fixed world.
+
+    Protocol deltas over :class:`DriverService`:
+
+    - ``register``/``rendezvous`` (same fields) record a registration for
+      the generation being *formed*; the launcher declares the expected
+      member set with :meth:`begin_reset` and the service assigns ranks the
+      moment every expected member has (re-)registered.
+    - ``wait_assignment`` blocks until this index's registration was
+      consumed into a formed generation, and the response carries the
+      ``generation`` counter; an index dropped from membership (dead slot,
+      blacklisted host) gets ``{"ok": False, "removed": True}`` so the
+      worker can exit instead of waiting forever.
+    - ``result`` is accepted only for the current generation (a worker
+      failing mid-reset with a stale view must not poison the new world);
+      payloads carry the worker's task ``index`` alongside its rank.
+    - ``elastic_poll`` is the cheap commit-time check workers use to learn
+      that membership changed (host added/removed by discovery) and a
+      reset is wanted even though no collective failed.
+
+    Rank assignment orders members oldest-generation-first, so rank 0 is
+    always a survivor carrying the last committed state — the root of the
+    post-reset state broadcast (elastic/state.py sync())."""
+
+    def __init__(self, key: bytes, fn: Optional[Callable] = None,
+                 args: tuple = (), kwargs: Optional[dict] = None) -> None:
+        super().__init__(0, key, fn=fn, args=args, kwargs=kwargs)
+        self.generation = 0                 # formed generations so far
+        self._forming = False               # begin_reset called, not yet formed
+        self._expected: set[int] = set()    # indices the forming gen waits for
+        self._pending: dict[int, dict] = {}   # fresh registrations by index
+        self._reg_waiting: set[int] = set()   # registered, not yet assigned
+        self._assign: dict[int, dict] = {}    # index -> current assignment
+        self._member_since: dict[int, int] = {}   # index -> first generation
+        self._removed: set[int] = set()     # indices dropped from membership
+
+    # -- protocol
+
+    def handle(self, req: Any, client_addr) -> Any:
+        kind = req.get("kind")
+        if kind in ("register", "rendezvous"):
+            with self._cv:
+                self._pending[req["index"]] = {
+                    "host_hash": req["host_hash"],
+                    "addresses": req["addresses"],
+                    "coord_port": req.get("coord_port", 0),
+                    "jax_coord_port": req.get("jax_coord_port", 0),
+                }
+                self._reg_waiting.add(req["index"])
+                self._removed.discard(req["index"])  # re-admitted slot
+                self._maybe_form()
+                self._cv.notify_all()
+            return {"ok": True}
+        if kind == "wait_assignment":
+            index = req["index"]
+            min_gen = req.get("min_generation", 1)
+            with self._cv:
+                deadline = time.monotonic() + req.get("timeout", 120.0)
+                while time.monotonic() < deadline:
+                    if index in self._removed:
+                        return {"ok": False, "removed": True,
+                                "error": f"task {index} was removed from the "
+                                         "elastic job (dead slot or "
+                                         "blacklisted host)"}
+                    a = self._assign.get(index)
+                    if a is not None and index not in self._reg_waiting \
+                            and a["generation"] >= min_gen:
+                        return a
+                    self._cv.wait(0.5)
+                return {"ok": False,
+                        "error": "timed out waiting for elastic rendezvous"}
+        if kind == "result":
+            with self._cv:
+                gen = req.get("generation", 0)
+                if gen == self.generation and not self._forming:
+                    self._results[req["rank"]] = req["value"]
+                    value = req["value"]
+                    if isinstance(value, dict) and isinstance(
+                            value.get("metrics"), dict):
+                        self._metrics[req["rank"]] = value["metrics"]
+                    self._cv.notify_all()
+                # stale-generation results are dropped: that worker is about
+                # to rendezvous (or be removed) — its view of ranks is dead
+            return {"ok": True}
+        if kind == "elastic_poll":
+            with self._cv:
+                reset = (self._forming
+                         or req.get("generation", 0) != self.generation
+                         or req["index"] in self._removed)
+            return {"ok": True, "reset_required": reset}
+        return super().handle(req, client_addr)
+
+    # -- membership (launcher side)
+
+    def begin_reset(self, expected: set) -> None:
+        """Open the next generation: wait for a fresh registration from every
+        index in ``expected``; everything previously known but absent from
+        ``expected`` is marked removed. Idempotent per membership set."""
+        with self._cv:
+            expected = set(expected)
+            gone = (set(self._member_since) | set(self._pending)) - expected
+            self._removed |= gone
+            for i in gone:
+                self._pending.pop(i, None)
+                self._reg_waiting.discard(i)
+            self._expected = expected
+            self._forming = True
+            self._maybe_form()
+            self._cv.notify_all()
+
+    def _maybe_form(self) -> None:
+        # caller holds self._cv
+        if not self._forming or not self._expected:
+            return
+        if not self._expected <= set(self._pending):
+            return
+        gen = self.generation + 1
+        members = sorted(self._expected)
+        for i in members:
+            self._member_since.setdefault(i, gen)
+        # Oldest members first: rank 0 must be a survivor that holds the
+        # last committed state (it roots the post-reset broadcast).
+        order = sorted(members, key=lambda i: (self._member_since[i], i))
+        ranks = {index: r for r, index in enumerate(order)}
+        self.num_proc = len(members)
+        # Reuse the parent's coordinator-address / topology logic on this
+        # generation's registrations.
+        self._registrations = {i: self._pending[i] for i in members}
+        self._ranks = ranks
+        by_host: dict[str, list] = {}
+        for i in members:
+            by_host.setdefault(self._registrations[i]["host_hash"], []).append(i)
+        rank0_index = order[0]
+        reg = self._registrations[rank0_index]
+        addrs = [a for a, _ in reg["addresses"]]
+        multi_host = len(by_host) > 1
+        host = next((a for a in addrs if not a.startswith("127.")), addrs[0]) \
+            if multi_host else next((a for a in addrs if a.startswith("127.")), addrs[0])
+        self.coord_addr = f"{host}:{reg['coord_port'] or _free_port()}"
+        self.jax_coord_addr = f"{host}:{reg['jax_coord_port'] or _free_port()}"
+        for i in members:
+            self._assign[i] = {
+                "ok": True,
+                "rank": ranks[i],
+                "generation": gen,
+                "topology": self._topology(i, ranks[i]),
+                "coord_addr": self.coord_addr,
+                "jax_coord_addr": self.jax_coord_addr,
+            }
+        self.generation = gen
+        self._forming = False
+        self._expected = set()
+        self._reg_waiting.clear()
+        self._pending.clear()
+        self._results = {}   # results are per generation
+
+    # -- launcher accessors
+
+    def membership(self) -> dict:
+        """Snapshot for the supervision loop: current generation, whether a
+        reset is in flight, member indices, and per-rank results so far."""
+        with self._lock:
+            return {
+                "generation": self.generation,
+                "forming": self._forming,
+                "members": dict(self._member_since),
+                "ranks": dict(self._ranks or {}),
+                "removed": set(self._removed),
+                "results": dict(self._results),
+            }
+
 
 def host_hash() -> str:
     """Host identity for rank grouping (reference horovod/spark/host_hash.py:
@@ -232,8 +427,12 @@ class TaskAgent:
     def __init__(self, index: int, driver_addresses, key: bytes) -> None:
         self.index = index
         # Socket timeout > the driver's 120 s wait_assignment window, so a
-        # slow straggler elsewhere doesn't kill punctual workers.
-        self.client = BasicClient(driver_addresses, key, timeout=180.0)
+        # slow straggler elsewhere doesn't kill punctual workers; the
+        # jittered connect-retry window covers a driver that is still a
+        # moment away from listening when a cold-starting pod's workers
+        # come up (runner/network.py BasicClient).
+        self.client = BasicClient(driver_addresses, key, timeout=180.0,
+                                  connect_retry_s=30.0)
 
     @staticmethod
     def _my_addresses() -> list[tuple[str, int]]:
@@ -263,6 +462,35 @@ class TaskAgent:
                                           "index": self.index})
         if not assignment["ok"]:
             raise RuntimeError(assignment["error"])
+        self._export_assignment(assignment)
+        return assignment
+
+    def rendezvous(self, min_generation: int, timeout: float = 300.0) -> dict:
+        """Elastic re-registration after a membership change (elastic/run.py
+        reset path): register fresh coordinator ports, wait for the next
+        generation's assignment, export the new HOROVOD_* env. Raises
+        :class:`WorkerRemovedError` when the driver dropped this slot."""
+        self.client.request({
+            "kind": "rendezvous",
+            "index": self.index,
+            "host_hash": host_hash(),
+            "addresses": self._my_addresses(),
+            "coord_port": _free_port(),
+            "jax_coord_port": _free_port(),
+        })
+        assignment = self.client.request({
+            "kind": "wait_assignment", "index": self.index,
+            "min_generation": min_generation, "timeout": timeout,
+        })
+        if not assignment["ok"]:
+            if assignment.get("removed"):
+                raise WorkerRemovedError(assignment.get("error", "removed"))
+            raise RuntimeError(assignment["error"])
+        self._export_assignment(assignment)
+        return assignment
+
+    @staticmethod
+    def _export_assignment(assignment: dict) -> None:
         topo = assignment["topology"]
         os.environ["HOROVOD_RANK"] = str(topo["rank"])
         os.environ["HOROVOD_SIZE"] = str(topo["size"])
@@ -273,7 +501,8 @@ class TaskAgent:
         os.environ["HOROVOD_COORD_ADDR"] = assignment["coord_addr"]
         if assignment.get("jax_coord_addr"):
             os.environ["HOROVOD_JAX_COORDINATOR"] = assignment["jax_coord_addr"]
-        return assignment
+        if "generation" in assignment:
+            os.environ["HOROVOD_ELASTIC_GENERATION"] = str(assignment["generation"])
 
     def report_metrics(self) -> None:
         """Push this rank's current metrics snapshot to the driver (mid-run;
@@ -311,6 +540,12 @@ class TaskAgent:
         payload["metrics"] = self._final_snapshot()
         self.client.request({"kind": "result",
                              "rank": int(os.environ["HOROVOD_RANK"]),
+                             "index": self.index,
+                             # Elastic jobs tag results with the generation
+                             # they belong to (stale ones are dropped by the
+                             # ElasticDriverService); 0 for static jobs.
+                             "generation": int(os.environ.get(
+                                 "HOROVOD_ELASTIC_GENERATION", "0")),
                              "value": payload})
         if not payload["ok"]:
             raise RuntimeError("task function failed")

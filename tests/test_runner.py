@@ -203,6 +203,75 @@ def test_run_surfaces_worker_exception():
         run(failing_fn, num_proc=2, timeout=120)
 
 
+def test_run_clean_exit_without_result_fails_fast():
+    """A worker that exits with code 0 WITHOUT reporting a result used to be
+    invisible to the liveness poll (it only flagged non-zero codes), so the
+    driver blocked for the full timeout. It must now fail promptly with an
+    actionable message."""
+    import time
+
+    from horovod_tpu.runner import run
+
+    def silent_quitter():
+        import os
+
+        if os.environ["HOROVOD_TASK_INDEX"] == "1":
+            os._exit(0)   # clean exit, no registration, no result
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        hvd.init()
+        hvd.allreduce(np.ones(1))   # blocks forever waiting for rank 1
+
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError,
+                       match="exited with code 0 before reporting"):
+        run(silent_quitter, num_proc=2, timeout=120)
+    assert time.monotonic() - t0 < 60, "clean exit took the full timeout path"
+
+
+def test_basic_client_connect_retries():
+    """Jittered connect retries (cold-start hardening): a client created
+    BEFORE its service listens must connect once the service appears,
+    instead of dying on the first refused connection."""
+    import socket
+    import threading
+    import time
+
+    class Echo(BasicService):
+        def handle(self, request, client_addr):
+            return {"echo": request}
+
+    key = make_secret()
+    # reserve a port, then start the service on it only after a delay
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    box: dict = {}
+
+    def late_start():
+        time.sleep(1.0)
+        box["svc"] = Echo(key, host="127.0.0.1", port=port)
+
+    t = threading.Thread(target=late_start)
+    t.start()
+    try:
+        client = BasicClient([("127.0.0.1", port)], key, connect_retry_s=15.0)
+        assert client.request({"x": 1}) == {"echo": {"x": 1}}
+        client.close()
+    finally:
+        t.join()
+        box["svc"].stop()
+    # without a retry window the refused connection is immediate and fatal
+    # (port 1 is never listening)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError, match="cannot reach service"):
+        BasicClient([("127.0.0.1", 1)], key)
+    assert time.monotonic() - t0 < 5, "no-retry default should fail fast"
+
+
 def test_run_rejects_bad_num_proc():
     from horovod_tpu.runner import run_command
 

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""CI smoke for elastic training (ISSUE 3 satellite; wired into ci.sh).
+
+Launches a 3-process elastic training job and kills one NON-coordinator
+worker at step 5 via the env-triggered fault hook, then verifies the full
+fault-tolerance contract end to end:
+
+1. the job COMPLETES on the survivors (correct final state: the
+   world-size-invariant accumulator equals the step count exactly, proving
+   resume-from-last-commit with no lost or double-counted steps);
+2. the failed slot's host is blacklisted (threshold 1) and never respawned
+   — the blacklisted-host path, visible in the elastic event log;
+3. the survivors detected the death through the stall watchdog's
+   HOROVOD_STALL_SHUTDOWN_TIME escalation (non-coordinator death = hung
+   collective, the PR 2 detector) and re-rendezvoused into generation 2;
+4. the pod metrics snapshot (HOROVOD_METRICS_SNAPSHOT) schema-validates
+   and shows horovod_elastic_resets_total >= 1 plus the elastic driver
+   summary under info.elastic.
+
+Exits non-zero with a reason on any violation. Wall-clock budget: ~25 s.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+TOTAL_STEPS = 10
+KILL_STEP = 5
+KILL_INDEX = 2
+WORLD = 3
+
+
+def fail(msg: str) -> None:
+    print(f"elastic smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def make_entry(total_steps: int):
+    def entry():
+        import os as _os
+
+        import numpy as _np
+
+        import horovod_tpu as hvd
+
+        state = hvd.elastic.ElasticState(step=0, acc=0.0)
+
+        def train(state):
+            while state.step < total_steps:
+                gen = _os.environ.get("HOROVOD_ELASTIC_GENERATION", "0")
+                out = hvd.allreduce(_np.ones(2), average=True,
+                                    name=f"grad.{state.step}.g{gen}")
+                state.acc = state.acc + float(out[0])
+                state.step += 1
+                state.commit()
+            return (hvd.rank(), hvd.size(), int(state.step),
+                    float(state.acc))
+
+        return hvd.elastic.run(train)(state)
+
+    return entry
+
+
+def main() -> int:
+    from horovod_tpu.metrics import validate_snapshot
+    from horovod_tpu.runner import run_elastic
+
+    tmp = tempfile.mkdtemp(prefix="hvd_elastic_smoke_")
+    event_log = os.path.join(tmp, "events.jsonl")
+    snapshot_path = os.path.join(tmp, "pod_metrics.json")
+    os.environ["HOROVOD_METRICS_SNAPSHOT"] = snapshot_path
+
+    t0 = time.monotonic()
+    try:
+        results = run_elastic(
+            make_entry(TOTAL_STEPS), num_proc=WORLD, timeout=120,
+            env={"HOROVOD_ENGINE": "python",
+                 "HOROVOD_ELASTIC_EVENT_LOG": event_log,
+                 "HOROVOD_ELASTIC_BLACKLIST_THRESHOLD": "1",
+                 "HOROVOD_FAULT_INJECT_STEP": str(KILL_STEP),
+                 "HOROVOD_FAULT_INJECT_INDEX": str(KILL_INDEX),
+                 "HOROVOD_STALL_CHECK_TIME": "0.5",
+                 "HOROVOD_STALL_SHUTDOWN_TIME": "2"})
+    except Exception as e:
+        fail(f"elastic job did not complete: {type(e).__name__}: {e}")
+    elapsed = time.monotonic() - t0
+
+    # 1. completed on survivors with exact resumed state
+    if len(results) != WORLD - 1:
+        fail(f"expected {WORLD - 1} survivor results, got {len(results)}: "
+             f"{results}")
+    for r, (rank, size, step, acc) in enumerate(results):
+        if (rank, size, step, acc) != (r, WORLD - 1, TOTAL_STEPS,
+                                       float(TOTAL_STEPS)):
+            fail(f"wrong final state on rank {r}: "
+                 f"{(rank, size, step, acc)} != "
+                 f"{(r, WORLD - 1, TOTAL_STEPS, float(TOTAL_STEPS))} "
+                 "(resume-from-commit broken?)")
+
+    # 2. + 3. event log: failure, blacklist, second rendezvous
+    try:
+        events = [json.loads(line) for line in open(event_log)]
+    except OSError as e:
+        fail(f"no elastic event log at {event_log}: {e}")
+    kinds = [e["event"] for e in events]
+    if "worker_failed" not in kinds:
+        fail(f"event log lacks worker_failed: {kinds}")
+    if "host_blacklisted" not in kinds:
+        fail(f"event log lacks host_blacklisted (blacklist path not "
+             f"exercised): {kinds}")
+    if kinds.count("rendezvous_complete") < 2:
+        fail(f"expected >= 2 formed generations, events: {kinds}")
+    blacklisted_host = next(e["host"] for e in events
+                            if e["event"] == "host_blacklisted")
+    respawns_after = [e for e in events
+                      if e["event"] == "worker_spawned"
+                      and e["slot"] == blacklisted_host]
+    if len(respawns_after) > 1:
+        fail(f"blacklisted slot {blacklisted_host} was respawned: {events}")
+
+    # 4. pod metrics snapshot: schema-valid, elastic counters present
+    try:
+        with open(snapshot_path) as f:
+            pod = json.load(f)
+    except OSError as e:
+        fail(f"no pod metrics snapshot at {snapshot_path}: {e}")
+    errs = validate_snapshot(pod)
+    if errs:
+        fail(f"pod snapshot schema violations: {errs[:5]}")
+    resets = pod["counters"].get("horovod_elastic_resets_total", 0)
+    if resets < 1:
+        fail(f"pod horovod_elastic_resets_total={resets}, expected >= 1")
+    commits = pod["counters"].get("horovod_elastic_commits_total", 0)
+    if commits < TOTAL_STEPS:
+        fail(f"pod horovod_elastic_commits_total={commits} suspiciously low")
+    elastic_info = pod.get("info", {}).get("elastic", {})
+    if elastic_info.get("generation", 0) < 2:
+        fail(f"pod info.elastic.generation={elastic_info}, expected >= 2")
+    if not elastic_info.get("blacklisted"):
+        fail(f"pod info.elastic.blacklisted empty: {elastic_info}")
+
+    print(f"elastic smoke OK: kill index {KILL_INDEX} at step {KILL_STEP} "
+          f"-> {len(results)} survivors finished {TOTAL_STEPS} steps with "
+          f"exact state, {resets:.0f} worker resets, "
+          f"blacklisted={elastic_info['blacklisted']}, "
+          f"generation {elastic_info['generation']}, {elapsed:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
