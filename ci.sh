@@ -39,6 +39,9 @@ echo "== bench smoke (tiny model, hard timeout: a hang fails fast, not rc=124 at
 HVD_BENCH_SMOKE=1 timeout -k 10 240 env JAX_PLATFORMS=cpu \
   python bench.py --buckets-ab
 
+echo "== eager smoke (4-proc Python engine: steady-state cache hit rate >= 95%, ring data plane carrying the bytes, star==ring bitwise) =="
+timeout -k 10 180 python tools/eager_smoke.py
+
 echo "== metrics smoke (2-proc train, stall check + exposition; snapshot vs docs/metrics_schema.json, timeline JSON shape) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_smoke.py
 

@@ -38,6 +38,7 @@ NATIVE_METRICS = (
     "reducescatter_count", "alltoall_count", "collective_bytes",
     "collective_errors", "negotiation_us", "execution_us",
     "stall_warnings", "cycles", "timeline_dropped",
+    "cache_hits", "cache_misses",
 )
 
 
@@ -82,6 +83,10 @@ def _load():
     lib.hvd_metric.argtypes = [ctypes.c_char_p]
     lib.hvd_last_stall.restype = ctypes.c_int
     lib.hvd_last_stall.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.hvd_cache_size.restype = ctypes.c_int
+    lib.hvd_cache_size.argtypes = []
+    lib.hvd_cache_flush.restype = None
+    lib.hvd_cache_flush.argtypes = []
     lib.hvd_timeline_start.restype = ctypes.c_int
     lib.hvd_timeline_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.hvd_timeline_stop.restype = None
@@ -136,6 +141,10 @@ class NativeEngine:
         os.environ["HOROVOD_SHM"] = "1" if getattr(config, "shm", True) else "0"
         os.environ["HOROVOD_SHM_BYTES"] = str(
             clamp_shm_bytes(getattr(config, "shm_bytes", 16 << 20)))
+        # The response-cache capacity crosses into C++ the same way (cache.h
+        # cache_capacity_from_env reads getenv at coordinator construction).
+        os.environ["HOROVOD_CACHE_CAPACITY"] = str(
+            max(0, int(getattr(config, "cache_capacity", 1024))))
         err = ctypes.create_string_buffer(1024)
         timeline = config.timeline if topo.rank == 0 else ""
         pinned = getattr(config, "pinned", set())
@@ -164,6 +173,10 @@ class NativeEngine:
 
         self._registry = _metrics_registry()
         self._registry.register_collector(self._collect_metrics)
+        # Last native cache counter values seen by the collector: the
+        # registry series are Prometheus counters (inc-only), so the
+        # collector feeds them the DELTA since its previous scrape.
+        self._cache_last = {"cache_hits": 0, "cache_misses": 0}
         # handle -> (op, nbytes, enqueue time): feeds the SAME per-op
         # count/bytes/latency series the Python engine emits
         # (horovod_collective_*), so dashboards read one surface no matter
@@ -287,6 +300,23 @@ class NativeEngine:
         n = self._lib.hvd_last_stall(buf, 4096)
         return buf.value.decode(errors="replace") if n > 0 else ""
 
+    def cache_stats(self) -> dict:
+        """Response-cache counters, same shape as PyEngine.cache_stats
+        (the native data plane is always the peer ring)."""
+        hits = int(self._lib.hvd_metric(b"cache_hits"))
+        misses = int(self._lib.hvd_metric(b"cache_misses"))
+        return {
+            "enabled": int(getattr(self.config, "cache_capacity", 1024)) > 0,
+            "ring_active": self.topo.size > 1,
+            "mirror": {"size": int(self._lib.hvd_cache_size()),
+                       "hits": max(hits, 0), "misses": max(misses, 0)},
+        }
+
+    def cache_flush(self) -> None:
+        """Drop this rank's cached negotiations (elastic reset path); the
+        mirror self-heals from the coordinator's re-announcements."""
+        self._lib.hvd_cache_flush()
+
     def _collect_metrics(self, reg) -> None:
         vals = self.metrics()
         if all(v < 0 for v in vals.values()):
@@ -296,6 +326,22 @@ class NativeEngine:
                 reg.gauge(f"horovod_native_{name}",
                           help="native engine counter (cc/src/engine.h "
                                "EngineMetrics)").set(v)
+        # Both engines expose ONE response-cache series pair
+        # (horovod_engine_cache_{hits,misses}_total): the Python engine
+        # increments directly; here the native atomics feed the counters
+        # by delta so dashboards read one surface either way.
+        for series, native in (("horovod_engine_cache_hits_total", "cache_hits"),
+                               ("horovod_engine_cache_misses_total",
+                                "cache_misses")):
+            v = vals.get(native, -1)
+            if v >= 0:
+                last = self._cache_last.get(native, 0)
+                if v > last:
+                    reg.counter(
+                        series,
+                        help="response-cache negotiations by outcome",
+                    ).inc(v - last)
+                self._cache_last[native] = max(v, last)
         stall = self.last_stall()
         if stall:
             reg.set_info("stall_report", {

@@ -239,7 +239,26 @@ long long hvd_metric(const char* name) {
   if (k == "stall_warnings") return (long long)m.stall_warnings.load();
   if (k == "cycles") return (long long)m.cycles.load();
   if (k == "timeline_dropped") return (long long)eng->timeline_dropped();
+  if (k == "cache_hits") return (long long)m.cache_hits.load();
+  if (k == "cache_misses") return (long long)m.cache_misses.load();
   return -1;
+}
+
+// ---- response cache (this PR: the steady-state fast path) ----
+
+// Live entries in this rank's cache mirror; -1 = no engine.
+int hvd_cache_size() {
+  auto eng = engine();
+  return eng ? eng->cache_size() : -1;
+}
+
+// Drop every cached negotiation on this rank (elastic reset/membership
+// change: a stale cached response must never be servable). Safe per rank:
+// the coordinator re-announces assignments when a full request arrives for
+// an already-bound signature, so a flushed mirror self-heals.
+void hvd_cache_flush() {
+  auto eng = engine();
+  if (eng) eng->cache_flush();
 }
 
 // Latest stall-warning text (empty when none). Returns the full text

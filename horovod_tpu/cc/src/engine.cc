@@ -251,7 +251,44 @@ int64_t Engine::enqueue(OpType op, const std::string& name, DataType dtype,
       timeline_.negotiate_start(e.req.name, op_name(op));
     queue_.push_back(std::move(e));
   }
+  // Wake the loop immediately (adaptive cycle): a small eager op must not
+  // pay the remainder of a cycle sleep, and an idle-backed-off loop must
+  // not pay the backoff.
+  qcv_.notify_one();
   return handle;
+}
+
+void Engine::wait_for_work() {
+  std::unique_lock<std::mutex> lk(qmu_);
+  double base = cycle_time_ms_.load();
+  double timeout_ms = base;
+  // HOROVOD_WAKE_ON_ENQUEUE=0 restores the fixed-cycle sleep (debugging /
+  // tests that need an enqueue to stay unprocessed for a known window).
+  // Read per call, not cached: in-process tests toggle it between engines.
+  const char* woe = std::getenv("HOROVOD_WAKE_ON_ENQUEUE");
+  if (woe && std::string(woe) == "0") {
+    qcv_.wait_for(lk, std::chrono::duration<double, std::milli>(base),
+                  [&] { return shutdown_.load(); });
+    return;
+  }
+  if (queue_.empty() && table_.empty()) {
+    // Fully idle: back off exponentially, capped. Safe in multi-process
+    // worlds because every collective participant wakes on its OWN
+    // enqueue — the barrier assembles from wakes, not from polling.
+    idle_streak_ = std::min(idle_streak_ + 1, 8);
+    static const double cap_ms = [] {
+      const char* v = std::getenv("HOROVOD_CYCLE_IDLE_MAX_MS");
+      double d = (v && *v) ? std::atof(v) : 100.0;
+      return d > 1.0 ? d : 100.0;
+    }();
+    timeout_ms = std::min(base * (double)(1 << std::min(idle_streak_, 6)),
+                          std::max(cap_ms, base));
+  } else {
+    idle_streak_ = 0;
+  }
+  qcv_.wait_for(lk, std::chrono::duration<double, std::milli>(timeout_ms),
+                [&] { return !queue_.empty() || shutdown_.load(); });
+  if (!queue_.empty()) idle_streak_ = 0;
 }
 
 void Engine::finish(Entry& e, Status st, Response res) {
@@ -292,12 +329,14 @@ void Engine::fail_everything(const std::string& reason) {
 
 void Engine::shutdown() {
   if (shutdown_.exchange(true)) {
+    qcv_.notify_all();
     // Second caller: just make sure the thread is gone before returning.
     if (bg_.joinable() && std::this_thread::get_id() != bg_.get_id()) {
       try { bg_.join(); } catch (const std::system_error&) {}
     }
     return;
   }
+  qcv_.notify_all();  // unblock an idle-backed-off loop promptly
   if (bg_.joinable()) bg_.join();
   if (coord_) {
     // Keep the control plane alive until every rank has taken its shutdown
@@ -358,8 +397,7 @@ void Engine::loop() {
   while (true) {
     bool shutting = shutdown_.load();
     if (!shutting) {
-      std::this_thread::sleep_for(
-          std::chrono::duration<double, std::milli>(cycle_time_ms_.load()));
+      wait_for_work();
       shutting = shutdown_.load();
     }
     timeline_.mark_cycle_start();
@@ -403,7 +441,23 @@ bool Engine::tick_multiprocess(bool shutting) {
     fresh.swap(queue_);
   }
   for (auto& e : fresh) {
-    t.reqs.push_back(e.req);
+    // Response cache: a signature the coordinator has bit-bound rides as
+    // one set bit in the tick's bitvector instead of a full Request.
+    bool cached = false;
+    {
+      std::lock_guard<std::mutex> g(cache_mu_);
+      auto it = cache_key_to_bit_.find(cache_key(e.req));
+      if (it != cache_key_to_bit_.end()) {
+        t.set_cache_bit(it->second);
+        cached = true;
+      }
+    }
+    if (cached) {
+      metrics_.cache_hits++;
+    } else {
+      metrics_.cache_misses++;
+      t.reqs.push_back(e.req);
+    }
     std::string name = e.req.name;
     table_.emplace(std::move(name), std::move(e));
   }
@@ -437,6 +491,27 @@ bool Engine::tick_multiprocess(bool shutting) {
               " cycle_time_ms=" + std::to_string(out.cycle_time_ms) +
               " hier_allreduce=" + std::to_string((int)out.hier_allreduce) +
               " hier_allgather=" + std::to_string((int)out.hier_allgather));
+  }
+  // Response-cache announcements: every rank applies the identical
+  // evict/assign stream before its next tick, so the mirrors mutate in
+  // lockstep with the coordinator's authority (cache.h).
+  if (!out.cache_evict.empty() || !out.cache_assign.empty()) {
+    std::lock_guard<std::mutex> g(cache_mu_);
+    for (uint32_t bit : out.cache_evict) {
+      auto it = cache_bit_to_key_.find(bit);
+      if (it == cache_bit_to_key_.end()) continue;
+      auto kb = cache_key_to_bit_.find(it->second);
+      if (kb != cache_key_to_bit_.end() && kb->second == bit)
+        cache_key_to_bit_.erase(kb);
+      cache_bit_to_key_.erase(it);
+    }
+    for (auto& a : out.cache_assign) {
+      std::string key = cache_key(a.req);
+      auto old = cache_key_to_bit_.find(key);
+      if (old != cache_key_to_bit_.end()) cache_bit_to_key_.erase(old->second);
+      cache_key_to_bit_[key] = a.bit;
+      cache_bit_to_key_[a.bit] = key;
+    }
   }
   // Stall warnings: the coordinator process (us, when coord_ is set) already
   // logged them at creation; only worker ranks log on receipt. EVERY rank
@@ -1050,7 +1125,7 @@ bool Coordinator::barrier_complete() const {
 ResponseList Coordinator::tick(int rank, const TickRequest& req) {
   std::unique_lock<std::mutex> lk(mu_);
   auto now = std::chrono::steady_clock::now();
-  for (auto& q : req.reqs) {
+  auto contribute = [&](const Request& q) {
     auto [it, fresh] = pending_.try_emplace(q.name);
     if (fresh) {
       it->second.first_seen = now;
@@ -1059,6 +1134,44 @@ ResponseList Coordinator::tick(int rank, const TickRequest& req) {
     if (timeline_ && timeline_->healthy())
       timeline_->negotiate_rank_ready(q.name, q.rank);
     it->second.contribs[rank] = q;
+  };
+  for (auto& q : req.reqs) {
+    if (cache_.enabled()) {
+      bool have = false;
+      uint32_t old = cache_.bit_for_name(q.name, &have);
+      if (have) {
+        uint32_t bound;
+        if (cache_.key_bound(cache_key(q), &bound) && bound == old) {
+          // Already bound under the SAME signature: a rank with a flushed
+          // mirror is re-learning — re-announce on the next broadcast.
+          cache_.assign(q, {}, &cache_announce_);
+        } else {
+          // Shape/dtype change: evict the stale bit everywhere.
+          cache_.evict_name(q.name, &cache_announce_);
+        }
+      }
+    }
+    contribute(q);
+  }
+  // Expand the rank's cache bitvector into contributions (steady state:
+  // this is the whole tick). Mutation of the authority's LRU is safe here
+  // under mu_; assignments/evictions still only happen at barriers.
+  for (size_t w = 0; w < req.cache_bits.size(); w++) {
+    uint64_t word = req.cache_bits[w];
+    while (word) {
+      int b = __builtin_ctzll(word);
+      word &= word - 1;
+      uint32_t bit = (uint32_t)(w * 64 + (size_t)b);
+      const Request* tmpl = cache_.lookup(bit);
+      if (!tmpl) {
+        HVD_WARN("rank " + std::to_string(rank) +
+                 " submitted unknown cache bit " + std::to_string(bit));
+        continue;
+      }
+      Request q = *tmpl;
+      q.rank = rank;
+      contribute(q);
+    }
   }
   if (req.shutdown) {
     shutdown_seen_ = true;
@@ -1159,11 +1272,31 @@ void Coordinator::build_response_list() {
     consumed.insert(name);
   }
   int64_t ready_bytes = 0;
+  // Freshly-validated signatures become cacheable now (reference
+  // response_cache.cc: the cache is populated from responses). Allgather
+  // is uncacheable — its first dimension is legitimately rank-divergent,
+  // so no single signature matches every rank.
+  std::vector<Request> to_assign;
   for (auto& [name, entry] : ready) {
-    if (entry.kind == ResponseEntry::OK)
+    if (entry.kind == ResponseEntry::OK) {
       ready_bytes += (int64_t)pending_[name].contribs.begin()->second.nbytes();
+      if (cache_.enabled() && entry.op != OpType::ALLGATHER)
+        to_assign.push_back(pending_[name].contribs.begin()->second);
+    }
   }
   for (auto& name : consumed) pending_.erase(name);
+  // Announcements buffered since the last barrier (invalidations, mirror
+  // re-heals) ride this broadcast, then the new assignments. Bits of
+  // tensors still mid-negotiation are protected from LRU eviction.
+  out.cache_evict = std::move(cache_announce_.cache_evict);
+  out.cache_assign = std::move(cache_announce_.cache_assign);
+  cache_announce_.cache_evict.clear();
+  cache_announce_.cache_assign.clear();
+  {
+    std::set<std::string> in_use;
+    for (auto& [n, p] : pending_) in_use.insert(n);
+    for (auto& q : to_assign) cache_.assign(q, in_use, &out);
+  }
   if (!consumed.empty()) {
     std::vector<std::string> keep;
     keep.reserve(arrival_order_.size() - consumed.size());

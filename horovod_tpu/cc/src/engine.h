@@ -42,6 +42,7 @@
 #include <vector>
 
 #include "autotuner.h"
+#include "cache.h"
 #include "fusion.h"
 #include "hvd_common.h"
 #include "ring.h"
@@ -71,6 +72,11 @@ struct EngineMetrics {
   std::atomic<uint64_t> execution_us{0};       // execution wall time
   std::atomic<uint64_t> stall_warnings{0};     // coordinator stall reports seen
   std::atomic<uint64_t> cycles{0};             // negotiation ticks
+  // Response cache (cache.h): negotiations sent as a cache bit vs a full
+  // request list. hits/(hits+misses) is the steady-state health signal the
+  // eager smoke asserts on.
+  std::atomic<uint64_t> cache_hits{0};
+  std::atomic<uint64_t> cache_misses{0};
 };
 
 // One rank's registration record: ring endpoints plus its host coordinates.
@@ -193,6 +199,20 @@ class Engine {
   }
   void timeline_stop() { timeline_.shutdown(); }
 
+  // Response-cache surface: live mirror size and an explicit flush (used
+  // on elastic resets/membership changes; the mirror self-heals because
+  // the coordinator re-announces an assignment whenever a full request
+  // arrives for an already-bound signature).
+  int cache_size() {
+    std::lock_guard<std::mutex> g(cache_mu_);
+    return (int)cache_key_to_bit_.size();
+  }
+  void cache_flush() {
+    std::lock_guard<std::mutex> g(cache_mu_);
+    cache_key_to_bit_.clear();
+    cache_bit_to_key_.clear();
+  }
+
   // Engine telemetry counters (c_api hvd_metric / hvd_last_stall).
   const EngineMetrics& op_metrics() const { return metrics_; }
   uint64_t timeline_dropped() const { return timeline_.dropped(); }
@@ -210,6 +230,11 @@ class Engine {
   };
 
   void loop();                       // reference BackgroundThreadLoop/RunLoopOnce
+  // Adaptive cycle: sleep until enqueue()/shutdown() wakes us, at most the
+  // cycle time while work is in flight, backing off exponentially (capped)
+  // when fully idle — small eager ops skip the half-cycle latency tax and
+  // idle workers stop spinning empty barrier rounds.
+  void wait_for_work();
   void complete_local(Entry& e);     // size==1 fast path
   // One cycle of the multi-process path: exchange metadata, execute the
   // broadcast list over the ring. Returns false when the loop must exit.
@@ -238,7 +263,15 @@ class Engine {
   HandleManager handles_;
   Timeline timeline_;
   std::mutex qmu_;
+  std::condition_variable qcv_;  // wake-on-enqueue (adaptive cycle)
+  int idle_streak_ = 0;          // loop-thread only
   std::deque<Entry> queue_;  // newly enqueued, not yet negotiated
+  // Per-rank response-cache mirror (cache.h): follows the coordinator's
+  // broadcast assign/evict announcements. Touched by the loop thread;
+  // cache_mu_ covers the API-thread flush/size calls.
+  std::mutex cache_mu_;
+  std::unordered_map<std::string, uint32_t> cache_key_to_bit_;
+  std::unordered_map<uint32_t, std::string> cache_bit_to_key_;
   // Sent to the coordinator, awaiting a ResponseList entry. Owned by the
   // loop thread exclusively — no lock (reference tensor_table is the same
   // idea guarded by its global mutex; here single ownership replaces it).
@@ -354,6 +387,11 @@ class Coordinator {
   ResponseList current_;
   std::map<std::string, PendingTensor> pending_;   // the message table
   std::vector<std::string> arrival_order_;
+  // Response-cache authority (cache.h). Announcements produced outside
+  // build_response_list (shape-change invalidation, mirror re-heal seen at
+  // tick arrival) buffer here and ride the next broadcast.
+  CacheAuthority cache_;
+  ResponseList cache_announce_;  // only cache_evict/cache_assign used
   // Warnings produced by timer-driven scans while the barrier is stuck;
   // drained into the next ResponseList so every rank eventually sees them.
   std::vector<std::string> deferred_warnings_;

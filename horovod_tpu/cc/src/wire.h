@@ -119,17 +119,28 @@ struct Request {
 };
 
 // One rank's per-tick message list (reference RequestList, message.h:122-144:
-// requests + shutdown flag).
+// requests + shutdown flag + the response-cache bitvector: tensors whose
+// signature is already bit-bound ride as set bits in cache_bits instead of
+// full Request entries — the steady-state tick frame is a few words).
 struct TickRequest {
   int32_t rank = 0;
   uint8_t shutdown = 0;
   std::vector<Request> reqs;
+  std::vector<uint64_t> cache_bits;  // packed bitvector of cached submissions
+
+  void set_cache_bit(uint32_t bit) {
+    size_t word = bit / 64;
+    if (cache_bits.size() <= word) cache_bits.resize(word + 1, 0);
+    cache_bits[word] |= (uint64_t)1 << (bit % 64);
+  }
 
   void write(Writer& w) const {
     w.i32(rank);
     w.u8(shutdown);
     w.u32((uint32_t)reqs.size());
     for (auto& q : reqs) q.write(w);
+    w.u32((uint32_t)cache_bits.size());
+    for (auto v : cache_bits) w.u64(v);
   }
   static TickRequest read(Reader& r) {
     TickRequest t;
@@ -138,7 +149,28 @@ struct TickRequest {
     uint32_t n = r.u32();
     t.reqs.reserve(n);
     for (uint32_t i = 0; i < n; i++) t.reqs.push_back(Request::read(r));
+    uint32_t nw = r.u32();
+    t.cache_bits.resize(nw);
+    for (uint32_t i = 0; i < nw; i++) t.cache_bits[i] = r.u64();
     return t;
+  }
+};
+
+// One response-cache bit assignment, broadcast to every rank so the
+// per-rank mirrors stay identical (cache.h CacheAuthority).
+struct CacheAssign {
+  uint32_t bit = 0;
+  Request req;  // rank-agnostic signature template
+
+  void write(Writer& w) const {
+    w.u32(bit);
+    req.write(w);
+  }
+  static CacheAssign read(Reader& r) {
+    CacheAssign a;
+    a.bit = r.u32();
+    a.req = Request::read(r);
+    return a;
   }
 };
 
@@ -216,6 +248,10 @@ struct ResponseList {
   uint8_t hier_allgather = 0;
   std::vector<std::string> stall_warnings;
   std::vector<ResponseEntry> entries;
+  // Response-cache announcements (cache.h): applied by every rank before
+  // its next tick, so mirrors mutate in lockstep with the authority.
+  std::vector<uint32_t> cache_evict;
+  std::vector<CacheAssign> cache_assign;
 
   void write(Writer& w) const {
     w.u8(shutdown);
@@ -228,6 +264,10 @@ struct ResponseList {
     for (auto& s : stall_warnings) w.str(s);
     w.u32((uint32_t)entries.size());
     for (auto& e : entries) e.write(w);
+    w.u32((uint32_t)cache_evict.size());
+    for (auto v : cache_evict) w.u32(v);
+    w.u32((uint32_t)cache_assign.size());
+    for (auto& a : cache_assign) a.write(w);
   }
   static ResponseList read(Reader& r) {
     ResponseList l;
@@ -243,6 +283,13 @@ struct ResponseList {
     uint32_t n = r.u32();
     l.entries.reserve(n);
     for (uint32_t i = 0; i < n; i++) l.entries.push_back(ResponseEntry::read(r));
+    uint32_t ne = r.u32();
+    l.cache_evict.resize(ne);
+    for (uint32_t i = 0; i < ne; i++) l.cache_evict[i] = r.u32();
+    uint32_t na = r.u32();
+    l.cache_assign.reserve(na);
+    for (uint32_t i = 0; i < na; i++)
+      l.cache_assign.push_back(CacheAssign::read(r));
     return l;
   }
 };

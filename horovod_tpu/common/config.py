@@ -148,6 +148,14 @@ class Config:
     shm_bytes: int = field(                               # HOROVOD_SHM_BYTES
         default_factory=lambda: clamp_shm_bytes(
             _env_int("HOROVOD_SHM_BYTES", 16 << 20)))
+    # Steady-state fast path (docs/eager-engine.md). Env-aware defaults for
+    # the same reason as shm above: tests construct Config(...) directly and
+    # the launcher env must still win.
+    cache_capacity: int = field(                          # HOROVOD_CACHE_CAPACITY (0 disables)
+        default_factory=lambda: max(
+            0, _env_int("HOROVOD_CACHE_CAPACITY", 1024)))
+    ring_data_plane: bool = field(                        # HOROVOD_RING_DATA_PLANE (0 disables)
+        default_factory=lambda: _env_bool("HOROVOD_RING_DATA_PLANE", True))
     log_level: str = "warning"                            # HOROVOD_LOG_LEVEL
     log_hide_time: bool = False                           # HOROVOD_LOG_HIDE_TIME
     # Which env vars were explicitly pinned (autotuner must not override,

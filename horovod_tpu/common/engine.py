@@ -2,10 +2,10 @@
 
 This is the analog of the reference's background-thread engine
 (operations.cc:1695-2380): framework threads enqueue named tensors
-asynchronously and get handles; a background loop ticks every cycle_time,
-negotiates which tensors are globally ready (every rank submitted them),
-fuses eligible ones, executes the collective, and fires completions
-(HandleManager, torch/handle_manager.h:32-43).
+asynchronously and get handles; a background loop wakes on enqueue (or a
+cycle-time heartbeat while work is in flight), negotiates which tensors are
+globally ready (every rank submitted them), executes the collective, and
+fires completions (HandleManager, torch/handle_manager.h:32-43).
 
 It serves the *eager* path only — torch tensors, numpy arrays, host metrics.
 The compiled JAX path needs none of this (ordering is static at trace time).
@@ -17,16 +17,28 @@ Two implementations behind one interface:
 
 Control plane: rank 0 is coordinator over TCP (replaces the per-tick
 MPI_Gather/MPI_Bcast of RequestLists/ResponseLists, operations.cc:2088-2109,
-2282-2287). Data plane: the coordinator relays reduced buffers — a correct,
-simple star that is O(N*bytes) through rank 0 per collective, which is why
-this engine is the *fallback*: the native engine (horovod_tpu/cc) moves
-tensor bytes over a peer-to-peer ring with a metadata-only control plane
-and is the default in multi-process worlds.
+2282-2287), with a *response cache* (response_cache.py; the reference's
+response_cache.{cc,h}, its single biggest eager-path latency win): after a
+tensor's first full negotiation the coordinator binds its signature to a
+small integer bit, and steady-state ticks exchange per-rank cache
+bitvectors — one small fixed-size frame — instead of full request lists.
 
-Every frame on this channel is authenticated: HMAC-SHA256 over the pickled
-payload, keyed by the launcher-distributed ``HOROVOD_SECRET``, verified
-before unpickling (the repo rule set by runner/network.py: never unpickle
-unauthenticated bytes), with a hard payload cap against allocation abuse.
+Data plane: allreduce tensor bytes move over a peer-to-peer TCP ring
+(reduce-scatter + allgather between ring neighbours, the same shape as the
+native engine's ring.h), so rank 0 carries O(bytes) instead of the old
+star relay's O(N·bytes). The star remains the fallback — for worlds of
+size <= 2, when HOROVOD_RING_DATA_PLANE=0, on peer-connect failure, and
+for the non-allreduce ops (allgather/broadcast/alltoall/reducescatter,
+whose eager payloads are small). Star and ring reduce in the SAME
+canonical chunk order (_ring_order_reduce), so results are bitwise
+identical across data planes and across cold/cached negotiations.
+
+Every frame on every channel is authenticated: the coordinator channel is
+HMAC-SHA256 over the pickled payload keyed by the launcher-distributed
+``HOROVOD_SECRET``, verified before unpickling; the peer ring rides
+runner/network.py's Channel (session-keyed, sequence-numbered HMAC — the
+repo rule: never unpickle unauthenticated bytes), with a hard payload cap
+against allocation abuse.
 """
 
 from __future__ import annotations
@@ -34,6 +46,7 @@ from __future__ import annotations
 import hmac
 import os
 import pickle
+import queue as queue_mod
 import socket
 import struct
 import threading
@@ -43,7 +56,8 @@ from typing import Any, Optional
 
 import numpy as np
 
-from .config import Config, STALL_WARNING_TIME_S
+from .config import Config, STALL_WARNING_TIME_S, _env_float
+from .response_cache import CacheMirror, ResponseCache, request_key
 from .topology import Topology
 from ..metrics import StallInfo, StallWatchdog, registry as _metrics_registry
 from ..metrics.registry import DEFAULT_BYTE_BUCKETS
@@ -75,19 +89,28 @@ def _secret_from_env() -> bytes:
     return s.encode() if s else b""
 
 
-def _send_msg(sock: socket.socket, obj: Any, key: bytes) -> None:
+def _send_msg(sock: socket.socket, obj: Any, key: bytes) -> int:
+    """Send one authenticated frame; returns the payload size in bytes
+    (the control-plane byte counters read it)."""
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     digest = hmac.new(key, payload, sha256).digest()
     sock.sendall(digest + struct.pack("!Q", len(payload)) + payload)
+    return len(payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    # recv_into a preallocated buffer: the naive bytes-+= loop re-copies the
+    # accumulated prefix on every ~64 KiB segment, which is quadratic on the
+    # MB-sized frames the data plane moves. Returns the bytearray itself —
+    # hmac and pickle.loads take buffers; a bytes() copy would be waste.
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionError("peer closed")
-        buf += chunk
+        got += r
     return buf
 
 
@@ -144,6 +167,281 @@ class HandleManager:
         return result
 
 
+# --------------------------------------------------- canonical ring reduction
+
+def _chunk_bounds(n: int, world: int) -> list[int]:
+    """np.array_split boundaries for a flat array of n elements."""
+    base, rem = divmod(n, world)
+    bounds = [0]
+    for i in range(world):
+        bounds.append(bounds[-1] + base + (1 if i < rem else 0))
+    return bounds
+
+
+def _acc_start(chunk: np.ndarray) -> np.ndarray:
+    """Seed a chunk accumulator: float64 for floating dtypes (numerical
+    robustness of the old star reducer, kept), native width otherwise.
+    The seed is never mutated by either plane (adds allocate or land on
+    the received buffer), so same-width inputs pass through copy-free."""
+    if np.issubdtype(chunk.dtype, np.floating) and chunk.dtype != np.float64:
+        return chunk.astype(np.float64)
+    return chunk
+
+
+def _acc_finish(acc: np.ndarray, average: bool, world: int,
+                dtype: np.dtype) -> np.ndarray:
+    if average:
+        acc = acc / world
+    return acc if acc.dtype == dtype else acc.astype(dtype)
+
+
+def _ring_order_reduce(arrs: list[np.ndarray], average: bool) -> np.ndarray:
+    """Canonical allreduce reduction, shared by the star relay and the peer
+    ring: chunk c accumulates contributions starting at rank (c+1) % world
+    in ring order — exactly the order the ring reduce-scatter performs —
+    so the two data planes (and cold vs cached negotiations) produce
+    BITWISE-IDENTICAL results."""
+    world = len(arrs)
+    shape, dtype = arrs[0].shape, arrs[0].dtype
+    flats = [np.ascontiguousarray(a).ravel() for a in arrs]
+    n = flats[0].size
+    bounds = _chunk_bounds(n, world)
+    out = np.empty(n, dtype=dtype)
+    for c in range(world):
+        lo, hi = bounds[c], bounds[c + 1]
+        start = (c + 1) % world
+        acc = _acc_start(flats[start][lo:hi])
+        for k in range(1, world):
+            acc = acc + flats[(start + k) % world][lo:hi]
+        out[lo:hi] = _acc_finish(acc, average, world, dtype)
+    return out.reshape(shape)
+
+
+# ----------------------------------------------------------- peer ring plane
+
+class _PeerRing:
+    """Authenticated peer-to-peer TCP ring for the Python engine's allreduce
+    data plane (reduce-scatter + allgather, the shape of the native ring.h
+    and the reference's NCCL ring, operations.cc:1221-1446).
+
+    Links ride :class:`horovod_tpu.runner.network.Channel` — the repo's
+    session-keyed, sequence-numbered HMAC framing — under a purpose-bound
+    subkey of the job secret, so a captured ring frame neither replays nor
+    authenticates on the coordinator channel. A dedicated sender thread
+    decouples send from recv (both neighbours push ~equal bytes per step;
+    blocking sends back-to-back would deadlock once chunks exceed the
+    socket buffers).
+    """
+
+    _STOP = object()
+
+    def __init__(self, rank: int, world: int, next_ch, prev_ch,
+                 next_sock, prev_sock, listener,
+                 on_bytes=None) -> None:
+        self.rank = rank
+        self.world = world
+        self._next_ch = next_ch
+        self._prev_ch = prev_ch
+        self._socks = [next_sock, prev_sock, listener]
+        self._on_bytes = on_bytes or (lambda n: None)
+        self.bytes_sent = 0
+        self._err: Optional[Exception] = None
+        self._sendq: "queue_mod.Queue" = queue_mod.Queue()
+        self._sender = threading.Thread(
+            target=self._send_loop, name="hvd_ring_send", daemon=True)
+        self._sender.start()
+
+    # -- establishment ------------------------------------------------------
+
+    @classmethod
+    def establish(cls, client: "_Client", topo, key: bytes, enabled: bool,
+                  on_bytes=None, connect_timeout: float = 60.0):
+        """Negotiate and build the ring, or return None for the star.
+
+        Every rank must reach the same verdict (a half-ring deadlocks), so
+        activation is two coordinator barriers: ``ring_hello`` gathers the
+        listener endpoints (a rank with the plane disabled reports so, and
+        everyone falls back), ``ring_confirm`` gathers per-rank connect
+        success — the plane is active only when ALL ranks connected.
+        """
+        from ..runner.network import Channel, derive_key
+
+        rank, world = topo.rank, topo.size
+        listener = None
+        ok = False
+        ring = None
+        ring_key = derive_key(key, b"eager-ring")
+        try:
+            if enabled:
+                listener = socket.create_server(("0.0.0.0", 0), backlog=4)
+                listener.settimeout(connect_timeout)
+                port = listener.getsockname()[1]
+                host = client.local_host()
+            else:
+                host, port = "", 0
+            peers = client.ring_hello(host, port, enabled=enabled)
+            if peers is not None:
+                nxt, prv = (rank + 1) % world, (rank - 1) % world
+                accepted: dict = {}
+
+                def _accept():
+                    try:
+                        conn, _ = listener.accept()
+                        conn.settimeout(connect_timeout)
+                        ch = Channel(conn, ring_key, server=True)
+                        hello = ch.recv()
+                        if (hello.get("hello") != prv
+                                or hello.get("to") != rank):
+                            raise ConnectionError(
+                                f"ring accept: expected rank {prv}, got "
+                                f"{hello}")
+                        ch.send({"ok": 1})
+                        accepted["ch"], accepted["sock"] = ch, conn
+                    except Exception as e:  # noqa: BLE001
+                        accepted["err"] = e
+
+                t = threading.Thread(target=_accept, daemon=True)
+                t.start()
+                nhost, nport = peers[nxt]
+                deadline = time.monotonic() + connect_timeout
+                nsock = None
+                while True:
+                    try:
+                        nsock = socket.create_connection(
+                            (nhost, nport), timeout=connect_timeout)
+                        break
+                    except OSError:
+                        if time.monotonic() >= deadline:
+                            raise
+                        time.sleep(0.1)
+                nsock.settimeout(connect_timeout)
+                nch = Channel(nsock, ring_key, server=False)
+                nch.send({"hello": rank, "to": nxt})
+                if nch.recv().get("ok") != 1:
+                    raise ConnectionError("ring connect: bad ack from next")
+                t.join(timeout=connect_timeout)
+                if "ch" not in accepted:
+                    raise accepted.get(
+                        "err", ConnectionError("ring accept timed out"))
+                # Generous steady-state deadline: a dead peer still wakes us
+                # (RST); a healthy-but-slow one must not.
+                for s_ in (nsock, accepted["sock"]):
+                    s_.settimeout(600.0)
+                    s_.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    # MB-scale chunk hops with default (~200 KiB) buffers
+                    # cost dozens of sender/receiver context-switch pairs
+                    # per hop — pure overhead when ranks share cores.
+                    for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+                        try:
+                            s_.setsockopt(socket.SOL_SOCKET, opt, 4 << 20)
+                        except OSError:  # pragma: no cover - cap by sysctl
+                            pass
+                ring = cls(rank, world, nch, accepted["ch"], nsock,
+                           accepted["sock"], listener, on_bytes=on_bytes)
+                ok = True
+        except Exception as e:  # noqa: BLE001
+            log("warning",
+                f"peer ring data plane unavailable on rank {rank} ({e}); "
+                "falling back to the star relay")
+            ok = False
+        active = client.ring_confirm(ok)
+        if active and ring is not None:
+            return ring
+        if ring is not None:
+            ring.close()
+        elif listener is not None:
+            try:
+                listener.close()
+            except OSError:
+                pass
+        return None
+
+    # -- data movement ------------------------------------------------------
+
+    def _send_loop(self) -> None:
+        while True:
+            item = self._sendq.get()
+            if item is self._STOP:
+                return
+            try:
+                self._next_ch.send_bytes(item)
+            except Exception as e:  # noqa: BLE001
+                self._err = e
+                return
+
+    def _send(self, arr: np.ndarray) -> None:
+        # Raw-buffer frame (Channel.send_bytes): the receiver derives shape
+        # and dtype from protocol position, so the chunk bytes skip pickle
+        # entirely — on a CPU-bound host that is ~45% of the per-byte cost.
+        if self._err is not None:
+            raise ConnectionError(f"ring sender failed: {self._err}")
+        arr = np.ascontiguousarray(arr)
+        self._sendq.put(arr)
+        self.bytes_sent += int(arr.nbytes)
+        self._on_bytes(int(arr.nbytes))
+
+    def _recv(self, dtype, count: int) -> np.ndarray:
+        if self._err is not None:
+            raise ConnectionError(f"ring sender failed: {self._err}")
+        buf = self._prev_ch.recv_bytes()
+        expected = count * np.dtype(dtype).itemsize
+        if len(buf) != expected:
+            raise ConnectionError(
+                f"ring frame size {len(buf)} != expected {expected}")
+        return np.frombuffer(buf, dtype=dtype) if count else \
+            np.empty(0, dtype=dtype)
+
+    def allreduce(self, arr: np.ndarray, average: bool) -> np.ndarray:
+        """Ring allreduce, bitwise-identical to _ring_order_reduce.
+
+        Phase 1 (reduce-scatter): partial sums travel at accumulator width
+        (float64 for floating dtypes); after world-1 hops this rank owns
+        the finished sum of chunk ``rank``. Phase 2 (allgather): finished
+        chunks circulate at native width.
+        """
+        arr = np.ascontiguousarray(arr)
+        world, rank = self.world, self.rank
+        if world == 1:
+            return arr
+        flat = arr.ravel()
+        bounds = _chunk_bounds(flat.size, world)
+        wdt = _acc_start(flat[:0]).dtype  # accumulator/wire width, phase 1
+
+        def chunk(c):
+            return flat[bounds[c]:bounds[c + 1]]
+
+        def csize(c):
+            return bounds[c + 1] - bounds[c]
+
+        part = _acc_start(chunk((rank - 1) % world))
+        for s in range(1, world):
+            self._send(part)
+            c = (rank - s - 1) % world
+            part = self._recv(wdt, csize(c))
+            # In-place on the received buffer (np.frombuffer over the recv
+            # bytearray is writable): same IEEE results as `recv + chunk`,
+            # one allocation+copy less per hop.
+            part += chunk(c)
+        mine = _acc_finish(part, average, world, arr.dtype)
+        out = np.empty_like(flat)
+        out[bounds[rank]:bounds[rank + 1]] = mine
+        cur = mine
+        for s in range(1, world):
+            self._send(cur)
+            c = (rank - s) % world
+            cur = self._recv(arr.dtype, csize(c))
+            out[bounds[c]:bounds[c + 1]] = cur
+        return out.reshape(arr.shape)
+
+    def close(self) -> None:
+        self._sendq.put(self._STOP)
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
 # ------------------------------------------------------------------ engine
 
 _OPS = ("allreduce", "allgather", "broadcast", "alltoall", "reducescatter")
@@ -164,6 +462,13 @@ class PyEngine:
                 "(set HOROVOD_ENGINE=native to honor the knob)")
         self.handles = HandleManager()
         self._shutdown = threading.Event()
+        self._wake = threading.Event()   # wake-on-enqueue (adaptive cycle)
+        # HOROVOD_WAKE_ON_ENQUEUE=0 restores the fixed-cycle sleep
+        # (debugging / tests that need an enqueue to stay unprocessed).
+        self._wake_on_enqueue = os.environ.get(
+            "HOROVOD_WAKE_ON_ENQUEUE", "1") != "0"
+        self._idle_max_s = max(
+            _env_float("HOROVOD_CYCLE_IDLE_MAX_MS", 100.0), 1.0) / 1000.0
         self._lock = threading.Lock()
         # name → (op, array, root, handle, enqueue_time); the tensor table
         # (reference operations.cc:121-127 tensor_table + message_queue).
@@ -176,6 +481,38 @@ class PyEngine:
             self._timeline = Timeline(config.timeline, mark_cycles=config.timeline_mark_cycles)
         self._coord: Optional[_Coordinator] = None
         self._client: Optional[_Client] = None
+        self._ring: Optional[_PeerRing] = None
+        self._ring_error: Optional[str] = None
+        # Per-rank response-cache mirror (response_cache.py): follows the
+        # coordinator's assign/evict announcements; capacity lives with the
+        # coordinator authority.
+        cache_cap = int(getattr(config, "cache_capacity", 0) or 0)
+        self._mirror: Optional[CacheMirror] = (
+            CacheMirror() if cache_cap > 0 else None)
+        # Telemetry (ISSUE 2 + this PR's steady-state counters).
+        self._metrics = _metrics_registry()
+        self._m_hits = self._metrics.counter(
+            "horovod_engine_cache_hits_total",
+            help="response-cache hits (negotiations sent as a cache bit)")
+        self._m_misses = self._metrics.counter(
+            "horovod_engine_cache_misses_total",
+            help="response-cache misses (negotiations sent as full requests)")
+        self._m_full = self._metrics.counter(
+            "horovod_engine_full_requests_total",
+            help="full request dicts shipped to the coordinator")
+        self._m_ctrl = self._metrics.counter(
+            "horovod_engine_control_bytes_total",
+            help="exchange payload bytes excluding tensor data (the "
+                 "bytes-per-tick negotiation cost)")
+        self._m_exch = self._metrics.counter(
+            "horovod_engine_exchanges_total",
+            help="coordinator exchanges performed")
+        self._m_star = self._metrics.counter(
+            "horovod_engine_data_bytes_total",
+            help="tensor bytes moved by the eager data plane", plane="star")
+        self._m_ring = self._metrics.counter(
+            "horovod_engine_data_bytes_total",
+            help="tensor bytes moved by the eager data plane", plane="ring")
         if topo.size > 1:
             addr = os.environ.get("HOROVOD_COORD_ADDR")
             if not addr:
@@ -193,16 +530,22 @@ class PyEngine:
                 )
             host, port = addr.rsplit(":", 1)
             if topo.rank == 0:
-                self._coord = _Coordinator(topo.size, host, int(port), key=key)
+                self._coord = _Coordinator(topo.size, host, int(port), key=key,
+                                           cache_capacity=cache_cap)
                 self._coord.start()
             self._client = _Client(host, int(port), topo.rank, key=key)
-        # Telemetry (ISSUE 2): per-op collective counters + latency
-        # histograms in the process-wide registry, and the stall watchdog
-        # thread replacing the old inline loop check — it keeps reporting
-        # even when the loop is wedged inside a blocking exchange, names
-        # missing ranks on the coordinator rank, and can escalate
-        # (HOROVOD_STALL_SHUTDOWN_TIME) by failing the stalled collective.
-        self._metrics = _metrics_registry()
+            # Ring data plane: worlds of 3+ only (a 2-world ring IS the star
+            # shape), every rank must agree (establish() runs the hello +
+            # confirm barriers and returns None when any rank fell back).
+            want_ring = (topo.size > 2
+                         and bool(getattr(config, "ring_data_plane", True)))
+            self._ring = _PeerRing.establish(
+                self._client, topo, key, enabled=want_ring,
+                on_bytes=self._m_ring.inc)
+        # Stall watchdog (ISSUE 2): keeps reporting even when the loop is
+        # wedged inside a blocking exchange, names missing ranks on the
+        # coordinator rank, and can escalate (HOROVOD_STALL_SHUTDOWN_TIME)
+        # by failing the stalled collective.
         self._watchdog: Optional[StallWatchdog] = None
         if not config.stall_check_disable:
             stall_s = getattr(config, "stall_warning_s", STALL_WARNING_TIME_S)
@@ -258,6 +601,10 @@ class PyEngine:
                 )
             self._inflight.add(name)
             self._queue.append(entry)
+        # Wake the loop immediately: small eager ops must not pay a
+        # half-cycle of sleep latency (this PR's adaptive-cycle satellite).
+        if self._wake_on_enqueue:
+            self._wake.set()
         self._metrics.counter(
             "horovod_collectives_enqueued_total",
             help="collectives submitted to the eager engine", op=op).inc()
@@ -290,12 +637,42 @@ class PyEngine:
             self._timeline.close()
             self._timeline = None
 
+    # -- response-cache surface (docs/eager-engine.md)
+
+    def cache_stats(self) -> dict:
+        """Live response-cache counters plus the data-plane verdict."""
+        out = {
+            "enabled": self._mirror is not None,
+            "ring_active": self._ring is not None,
+            # `is not None`, not truthiness: CacheMirror defines __len__,
+            # so a freshly-flushed (empty) mirror is falsy.
+            "mirror": (self._mirror.stats()
+                       if self._mirror is not None else None),
+        }
+        if self._coord is not None:
+            out["authority"] = self._coord.cache_stats()
+        return out
+
+    def cache_flush(self) -> None:
+        """Drop every cached negotiation (elastic reset / membership change:
+        a stale cached response must never be servable). Safe to call on any
+        subset of ranks — the coordinator re-announces assignments with
+        every result delivery, so a flushed mirror self-heals."""
+        if self._mirror is not None:
+            self._mirror.flush()
+        if self._coord is not None:
+            self._coord.cache_flush()
+
     def shutdown(self) -> None:
         self._shutdown.set()
+        self._wake.set()
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
         self._thread.join(timeout=5)
+        self.cache_flush()
+        if self._ring:
+            self._ring.close()
         if self._client:
             self._client.close()
         if self._coord:
@@ -320,14 +697,28 @@ class PyEngine:
         cycles = self._metrics.counter(
             "horovod_engine_cycles_total",
             help="eager-engine negotiation cycles")
+        idle = 0
         while not self._shutdown.is_set():
-            time.sleep(self.config.cycle_time_ms / 1000.0)
+            base = self.config.cycle_time_ms / 1000.0
+            # Adaptive cycle: wake instantly on enqueue; with work in
+            # flight tick at the configured cycle time; when idle, back off
+            # exponentially (capped) so idle workers stop spinning.
+            timeout = (min(base * (1 << min(idle, 6)), self._idle_max_s)
+                       if idle and self._wake_on_enqueue else base)
+            self._wake.wait(timeout)
+            self._wake.clear()
+            if self._shutdown.is_set():
+                break
             cycles.inc()
             if self._timeline:
                 self._timeline.mark_cycle()
             with self._lock:
                 batch = self._queue
                 self._queue = []
+            if not batch:
+                idle += 1
+                continue
+            idle = 0
             if self.topo.size == 1:
                 for e in batch:
                     self._complete_local(e)
@@ -372,30 +763,64 @@ class PyEngine:
             self._timeline.end(name)
         self._finish(e, None, arr)
 
+    def _entry_key(self, e: dict) -> tuple:
+        return (e["name"], e["op"], tuple(e["array"].shape),
+                str(e["array"].dtype), e["root"], bool(e["average"]))
+
+    def _rides_ring(self, e: dict) -> bool:
+        return self._ring is not None and e["op"] == "allreduce"
+
     def _negotiate_and_execute(self, batch: list[dict]) -> None:
         # Workers ship their request list to the coordinator (MPI_Gatherv
-        # analog); coordinator matches by name across ranks, validates,
-        # executes, and ships results back (MPI_Bcast analog). The relay also
-        # carries the data, so negotiation+execution is one round trip here.
-        requests = [
-            {
-                "name": e["name"], "op": e["op"], "shape": tuple(e["array"].shape),
-                "dtype": str(e["array"].dtype), "root": e["root"],
-                "average": e["average"],
-            }
-            for e in batch
-        ]
-        # First contribution ships the bytes; re-polls of a name whose bytes
-        # the coordinator already holds are metadata-only (otherwise every
-        # cycle spent waiting on a straggling PEER would re-ship this rank's
-        # full tensor).
-        arrays = {e["name"]: e["array"] for e in batch if not e.get("sent")}
+        # analog); the coordinator matches by name across ranks, validates,
+        # and answers. Star-plane ops carry their bytes on this channel and
+        # get values back; ring-plane allreduces are METADATA-ONLY here and
+        # get an ordered execution directive instead — the bytes move
+        # between ring neighbours. Cached signatures ride as bits in one
+        # small bitvector instead of full request dicts.
+        requests: list[dict] = []
+        bits = 0
+        arrays: dict[str, np.ndarray] = {}
+        for e in batch:
+            first = not e.get("sent")
+            if first and not self._rides_ring(e):
+                # First contribution ships the bytes; re-polls of a name
+                # whose bytes the coordinator already holds are
+                # metadata-only (otherwise every cycle spent waiting on a
+                # straggling PEER would re-ship this rank's full tensor).
+                arrays[e["name"]] = e["array"]
+            bit = None
+            if self._mirror is not None:
+                key = self._entry_key(e)
+                if first:
+                    bit = self._mirror.lookup(key)
+                    (self._m_hits if bit is not None else self._m_misses).inc()
+                else:
+                    bit = self._mirror.peek(key)  # re-poll: no stats
+            if bit is not None:
+                bits |= 1 << bit
+            else:
+                requests.append({
+                    "name": e["name"], "op": e["op"],
+                    "shape": tuple(e["array"].shape),
+                    "dtype": str(e["array"].dtype), "root": e["root"],
+                    "average": e["average"],
+                })
+                self._m_full.inc()
         try:
-            results = self._client.exchange(requests, arrays)
+            results = self._client.exchange(requests, arrays, bits=bits)
         except Exception as exc:
             for e in batch:
                 self._finish(e, HorovodInternalError(str(exc)), None)
             return
+        self._m_exch.inc()
+        data_bytes = sum(int(a.nbytes) for a in arrays.values())
+        self._m_star.inc(data_bytes)
+        self._m_ctrl.inc(max(0, self._client.last_sent_bytes - data_bytes))
+        if self._mirror is not None:
+            assign, evict = self._client.last_cache
+            self._mirror.apply(assign, evict)
+        directives: list[tuple[int, dict, dict]] = []
         for e in batch:
             name = e["name"]
             res = results.get(name)
@@ -408,8 +833,29 @@ class PyEngine:
             err, value = res
             if err is not None:
                 self._finish(e, TensorShapeMismatchError(err), None)
+            elif isinstance(value, dict) and "__ring__" in value:
+                directives.append((value["seq"], e, value))
             else:
+                if isinstance(value, np.ndarray):
+                    self._m_star.inc(int(value.nbytes))
                 self._finish(e, None, value)
+        # Ring execution in global sequence order: the coordinator stamps
+        # each ready allreduce with a monotonic seq, and every rank executes
+        # them in that order, so the neighbour exchanges pair up.
+        for _seq, e, d in sorted(directives, key=lambda t: t[0]):
+            if self._ring_error is not None:
+                self._finish(e, HorovodInternalError(self._ring_error), None)
+                continue
+            try:
+                out = self._ring.allreduce(e["array"], bool(d["average"]))
+            except Exception as exc:  # noqa: BLE001
+                # A broken ring has no resync point (peer streams may be
+                # mid-message): fail this and every later ring collective.
+                self._ring_error = f"ring data plane failed: {exc}"
+                log("warning", self._ring_error)
+                self._finish(e, HorovodInternalError(self._ring_error), None)
+            else:
+                self._finish(e, None, out)
 
     def _stall_source(self) -> list:
         """Watchdog view of this rank's in-flight queue (reference
@@ -448,13 +894,15 @@ class PyEngine:
 # ------------------------------------------------------- multi-process plumbing
 
 class _Coordinator:
-    """Rank-0 TCP coordinator: collects per-tick request lists + data from all
-    ranks, matches by name, validates cross-rank consistency, computes, and
-    returns results. Plays the reference's coordinator role
-    (IncrementTensorCount/ConstructResponse, operations.cc:287-523)."""
+    """Rank-0 TCP coordinator: collects per-tick request lists (or cache
+    bitvectors) + star-plane data from all ranks, matches by name, validates
+    cross-rank consistency, computes star results or stamps ring execution
+    directives, and returns them. Plays the reference's coordinator role
+    (IncrementTensorCount/ConstructResponse, operations.cc:287-523) plus its
+    response-cache authority (response_cache.cc)."""
 
     def __init__(self, world: int, host: str, port: int,
-                 key: bytes = b"") -> None:
+                 key: bytes = b"", cache_capacity: Optional[int] = None) -> None:
         self.world = world
         self.key = key or _secret_from_env()
         if not self.key:
@@ -465,12 +913,25 @@ class _Coordinator:
         self._threads: list[threading.Thread] = []
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        # name → {rank: (request, array)}; the message_table
-        self._pending: dict[str, dict[int, tuple[dict, np.ndarray]]] = {}
+        # name → {rank: (request, array-or-None)}; the message_table
+        self._pending: dict[str, dict[int, tuple[dict, Optional[np.ndarray]]]] = {}
         # name → monotonic time of first contribution (stall-watchdog ages)
         self._first_seen: dict[str, float] = {}
         self._results: dict[str, tuple[Optional[str], Any]] = {}
         self._claimed: dict[str, set[int]] = {}
+        # --- response cache (authority half) ---
+        self._cache = ResponseCache(capacity=cache_capacity)
+        self._assigned: dict[str, tuple[int, tuple]] = {}  # name → (bit, key)
+        # Evictions queued per rank, drained into that rank's next response;
+        # tombstones keep an evicted bit resolvable until EVERY rank has
+        # seen the eviction (a rank may have sent the bit before it landed).
+        self._evict_q: dict[int, list[int]] = {r: [] for r in range(world)}
+        self._tombstones: dict[int, tuple[tuple, dict, set]] = {}
+        # --- ring data plane negotiation ---
+        self.ring_active = False
+        self._ring_endpoints: dict[int, Optional[tuple[str, int]]] = {}
+        self._ring_votes: dict[int, bool] = {}
+        self._ring_seq = 0
 
     def start(self) -> None:
         t = threading.Thread(target=self._accept_loop, name="hvd_coord_accept", daemon=True)
@@ -479,6 +940,8 @@ class _Coordinator:
 
     def stop(self) -> None:
         self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
         try:
             self.server.close()
         except OSError:
@@ -498,10 +961,20 @@ class _Coordinator:
         try:
             while not self._stop.is_set():
                 msg = _recv_msg(conn, self.key)
-                if msg["kind"] == "exchange":
-                    out = self._handle_exchange(msg["rank"], msg["requests"], msg["arrays"])
+                kind = msg["kind"]
+                if kind == "exchange":
+                    out = self._handle_exchange(
+                        msg["rank"], msg["requests"], msg["arrays"],
+                        msg.get("bits", 0))
                     _send_msg(conn, out, self.key)
-                elif msg["kind"] == "bye":
+                elif kind == "ring_hello":
+                    _send_msg(conn, self._handle_ring_hello(
+                        msg["rank"], msg["host"], msg["port"],
+                        msg.get("enabled", True)), self.key)
+                elif kind == "ring_confirm":
+                    _send_msg(conn, self._handle_ring_confirm(
+                        msg["rank"], bool(msg["ok"])), self.key)
+                elif kind == "bye":
                     return
         except (ConnectionError, EOFError, OSError):
             return
@@ -513,10 +986,128 @@ class _Coordinator:
             except OSError:
                 pass
 
-    def _handle_exchange(self, rank: int, requests: list[dict], arrays: dict) -> dict:
+    # -- ring negotiation barriers
+
+    def _handle_ring_hello(self, rank: int, host: str, port: int,
+                           enabled: bool) -> dict:
+        with self._cv:
+            self._ring_endpoints[rank] = (host, port) if enabled else None
+            self._cv.notify_all()
+            deadline = time.monotonic() + 120.0
+            while (len(self._ring_endpoints) < self.world
+                   and not self._stop.is_set()
+                   and time.monotonic() < deadline):
+                self._cv.wait(1.0)
+            if (len(self._ring_endpoints) < self.world
+                    or any(v is None for v in self._ring_endpoints.values())):
+                return {"peers": None}
+            return {"peers": dict(self._ring_endpoints)}
+
+    def _handle_ring_confirm(self, rank: int, ok: bool) -> dict:
+        with self._cv:
+            self._ring_votes[rank] = ok
+            self._cv.notify_all()
+            deadline = time.monotonic() + 120.0
+            while (len(self._ring_votes) < self.world
+                   and not self._stop.is_set()
+                   and time.monotonic() < deadline):
+                self._cv.wait(1.0)
+            self.ring_active = (len(self._ring_votes) == self.world
+                                and all(self._ring_votes.values()))
+            return {"active": self.ring_active}
+
+    # -- response cache authority
+
+    def cache_stats(self) -> dict:
+        with self._lock:
+            return self._cache.stats()
+
+    def cache_flush(self) -> None:
+        with self._cv:
+            self._queue_evictions(self._cache.flush())
+
+    def _queue_evictions(self, evicted) -> None:
+        """Record evictions (from assign/evict_name/flush) for broadcast.
+        ``evicted``: list of (bit, key, meta) triples. Callers hold _lock."""
+        for bit, key, meta in evicted:
+            name = key[0]
+            if self._assigned.get(name, (None,))[0] == bit:
+                del self._assigned[name]
+            self._tombstones[bit] = (key, meta, set(range(self.world)))
+            for r in range(self.world):
+                self._evict_q[r].append(bit)
+
+    def _drain_evictions(self, rank: int) -> list[int]:
+        out = self._evict_q[rank]
+        self._evict_q[rank] = []
+        for bit in out:
+            tomb = self._tombstones.get(bit)
+            if tomb is not None:
+                tomb[2].discard(rank)
+                if not tomb[2]:
+                    del self._tombstones[bit]
+        return out
+
+    def _resolve_bits(self, bits: int) -> list[dict]:
+        """Expand a rank's cache bitvector into request dicts."""
+        reqs = []
+        m = bits
+        while m:
+            b = (m & -m).bit_length() - 1
+            m &= m - 1
+            entry = self._cache.lookup_bit(b)
+            if entry is None:
+                tomb = self._tombstones.get(b)
+                entry = (tomb[0], tomb[1]) if tomb else None
+            if entry is None:
+                log("warning", f"coordinator: unknown cache bit {b} ignored")
+                continue
+            self._cache.hits += 1
+            reqs.append(dict(entry[1]))
+        return reqs
+
+    def _maybe_assign(self, name: str, contribs: dict) -> None:
+        """Bind a freshly-completed tensor's signature to a cache bit.
+        Allgather is uncacheable: its first dimension is legitimately
+        rank-divergent, so no single signature matches every rank."""
+        if not self._cache.enabled:
+            return
+        req0 = contribs[min(contribs)][0]
+        if req0["op"] == "allgather":
+            return
+        key = request_key(req0)
+        if self._cache.bit_for(key) is not None:
+            return  # already bound (idempotent re-completion)
+        bit, evicted = self._cache.assign(
+            key, dict(req0), in_use=set(self._pending))
+        self._queue_evictions(evicted)
+        if bit is not None:
+            self._assigned[name] = (bit, key)
+
+    # -- the exchange
+
+    def _handle_exchange(self, rank: int, requests: list[dict], arrays: dict,
+                         bits: int = 0) -> dict:
         ready: list[str] = []
         with self._cv:
-            for req in requests:
+            full_reqs = list(requests)
+            if full_reqs and self._cache.enabled:
+                for req in full_reqs:
+                    # Shape-change invalidation: a full request for a name
+                    # bound under a DIFFERENT signature evicts the stale bit
+                    # everywhere. (Same signature = a flushed mirror
+                    # re-learning; the assignment is re-announced with the
+                    # result delivery.)
+                    old = self._cache.bit_for_name(req["name"])
+                    if old is not None and self._cache.lookup_bit(old)[0] != \
+                            request_key(req):
+                        self._queue_evictions(
+                            self._cache.evict_name(req["name"]))
+                    if (req["name"] not in self._results
+                            and rank not in self._pending.get(req["name"], {})):
+                        self._cache.misses += 1
+            all_reqs = full_reqs + self._resolve_bits(bits)
+            for req in all_reqs:
                 name = req["name"]
                 # Re-poll after a partial response: the result is already
                 # waiting for this rank — don't contribute again (a stale
@@ -527,14 +1118,22 @@ class _Coordinator:
                 self._first_seen.setdefault(name, time.monotonic())
                 if name in arrays:
                     entry[rank] = (req, arrays[name])
+                elif (rank not in entry and self.ring_active
+                        and req["op"] == "allreduce"):
+                    # Ring-plane allreduce: metadata-only contribution —
+                    # the bytes never transit the coordinator.
+                    entry[rank] = (req, None)
                 # else: metadata-only re-poll — this rank's bytes are already
                 # stored from its first contribution; nothing to overwrite.
                 if len(entry) == self.world:
                     ready.append(name)
             for name in ready:
-                self._results[name] = self._execute(name, self._pending.pop(name))
+                contribs = self._pending.pop(name)
+                self._results[name] = self._execute(name, contribs)
                 self._first_seen.pop(name, None)
                 self._claimed[name] = set()
+                if self._results[name][0] is None:
+                    self._maybe_assign(name, contribs)
             self._cv.notify_all()
             # Collective semantics: a tensor completes only when every rank
             # contributed. But an exchange never blocks on a straggler (the
@@ -551,7 +1150,7 @@ class _Coordinator:
             # original enqueue age (reference CheckForStalledTensors,
             # operations.cc:1625-1672).
             out: dict[str, tuple[Optional[str], Any]] = {}
-            names = [r["name"] for r in requests]
+            names = [r["name"] for r in all_reqs]
             empty_deadline = time.monotonic() + 0.1
             grace: Optional[float] = None
             while True:
@@ -570,14 +1169,18 @@ class _Coordinator:
                     if time.monotonic() >= empty_deadline:
                         break  # nothing ready: hand control back to the rank
                     self._cv.wait(timeout=0.02)
+            assign: list[tuple[int, tuple]] = []
             for n in names:
                 if n in self._results and rank not in self._claimed[n]:
                     out[n] = self._results[n]
+                    if n in self._assigned:
+                        assign.append(self._assigned[n])
                     self._claimed[n].add(rank)
                     if len(self._claimed[n]) == self.world:
                         del self._results[n]
                         del self._claimed[n]
-        return out
+            return {"results": out, "assign": assign,
+                    "evict": self._drain_evictions(rank)}
 
     def stall_candidates(self) -> list:
         """Watchdog source (reference CheckForStalledTensors with
@@ -596,30 +1199,45 @@ class _Coordinator:
                     missing_ranks=missing))
         return out
 
-    def _execute(self, name: str, contributions: dict[int, tuple[dict, np.ndarray]]):
-        reqs = [contributions[r][0] for r in sorted(contributions)]
-        arrs = [contributions[r][1] for r in sorted(contributions)]
+    def _validate(self, name: str, reqs: list[dict]) -> Optional[str]:
+        """Cross-rank validation (ConstructResponse, operations.cc:321-523)."""
         op = reqs[0]["op"]
-        # Cross-rank validation (ConstructResponse, operations.cc:321-523).
         if any(r["op"] != op for r in reqs):
-            return (f"Mismatched collective operations for tensor {name}", None)
+            return f"Mismatched collective operations for tensor {name}"
         if any(r["dtype"] != reqs[0]["dtype"] for r in reqs):
-            return (f"Mismatched data types for tensor {name}", None)
+            return f"Mismatched data types for tensor {name}"
         if op in ("allreduce", "broadcast", "alltoall", "reducescatter") and any(
             r["shape"] != reqs[0]["shape"] for r in reqs
         ):
-            return (f"Mismatched tensor shapes for {op} {name}", None)
-        if op == "allgather" and any(r["shape"][1:] != reqs[0]["shape"][1:] for r in reqs):
-            return (f"Mismatched non-first dimensions for allgather {name}", None)
+            return f"Mismatched tensor shapes for {op} {name}"
+        if op == "allgather" and any(
+                tuple(r["shape"][1:]) != tuple(reqs[0]["shape"][1:])
+                for r in reqs):
+            return f"Mismatched non-first dimensions for allgather {name}"
         if op == "broadcast" and any(r["root"] != reqs[0]["root"] for r in reqs):
-            return (f"Mismatched root ranks for broadcast {name}", None)
+            return f"Mismatched root ranks for broadcast {name}"
+        return None
+
+    def _execute(self, name: str, contributions: dict[int, tuple[dict, Optional[np.ndarray]]]):
+        reqs = [contributions[r][0] for r in sorted(contributions)]
+        op = reqs[0]["op"]
+        err = self._validate(name, reqs)
+        if err is not None:
+            return (err, None)
+        if self.ring_active and op == "allreduce":
+            # Ring directive: every rank executes this allreduce against its
+            # neighbours, in the global order this seq defines. The
+            # coordinator never touches the bytes.
+            seq = self._ring_seq
+            self._ring_seq += 1
+            return (None, {"__ring__": True, "seq": seq,
+                           "average": bool(reqs[0]["average"])})
+        arrs = [contributions[r][1] for r in sorted(contributions)]
+        if any(a is None for a in arrs):  # pragma: no cover - engine bug guard
+            return (f"missing tensor bytes for star-plane {op} {name}", None)
         try:
             if op == "allreduce":
-                acc = np.sum(np.stack(arrs, axis=0), axis=0, dtype=np.float64) \
-                    if np.issubdtype(arrs[0].dtype, np.floating) else sum(arrs)
-                if reqs[0]["average"]:
-                    acc = acc / len(arrs)
-                return (None, np.asarray(acc, dtype=arrs[0].dtype))
+                return (None, _ring_order_reduce(arrs, reqs[0]["average"]))
             if op == "allgather":
                 return (None, np.concatenate(arrs, axis=0))
             if op == "broadcast":
@@ -661,13 +1279,49 @@ class _Client:
             raise HorovodInternalError(f"cannot reach coordinator at {host}:{port}: {last}")
         self.sock.settimeout(120)
         self._lock = threading.Lock()
+        self.last_sent_bytes = 0
+        # (assign, evict) announcements from the latest exchange response;
+        # the engine applies them to its CacheMirror.
+        self.last_cache: tuple[list, list] = ([], [])
 
-    def exchange(self, requests: list[dict], arrays: dict) -> dict:
+    def local_host(self) -> str:
+        """Local address of the control connection — the interface that
+        routes to the coordinator, advertised for this rank's ring
+        listener (native Client::local_host analog)."""
+        return self.sock.getsockname()[0]
+
+    def ring_hello(self, host: str, port: int, enabled: bool = True):
+        """Registration barrier for the peer ring: returns the rank-indexed
+        endpoint map, or None when any rank has the plane disabled."""
         with self._lock:
-            _send_msg(self.sock, {"kind": "exchange", "rank": self.rank,
-                                  "requests": requests, "arrays": arrays},
-                      self.key)
-            out = _recv_msg(self.sock, self.key)
+            _send_msg(self.sock, {"kind": "ring_hello", "rank": self.rank,
+                                  "host": host, "port": port,
+                                  "enabled": enabled}, self.key)
+            return _recv_msg(self.sock, self.key).get("peers")
+
+    def ring_confirm(self, ok: bool) -> bool:
+        """Connect-success barrier: True only when EVERY rank connected."""
+        with self._lock:
+            _send_msg(self.sock, {"kind": "ring_confirm", "rank": self.rank,
+                                  "ok": bool(ok)}, self.key)
+            return bool(_recv_msg(self.sock, self.key).get("active"))
+
+    def exchange(self, requests: list[dict], arrays: dict,
+                 bits: int = 0) -> dict:
+        with self._lock:
+            self.last_sent_bytes = _send_msg(
+                self.sock, {"kind": "exchange", "rank": self.rank,
+                            "requests": requests, "arrays": arrays,
+                            "bits": bits},
+                self.key)
+            resp = _recv_msg(self.sock, self.key)
+        if isinstance(resp, dict) and "results" in resp:
+            self.last_cache = (resp.get("assign") or [],
+                               resp.get("evict") or [])
+            out = resp["results"]
+        else:  # pragma: no cover - legacy shape
+            self.last_cache = ([], [])
+            out = resp
         # Unwrap per-rank results (reducescatter / alltoall)
         for name, (err, val) in list(out.items()):
             if err is None and isinstance(val, dict) and "__per_rank__" in val:

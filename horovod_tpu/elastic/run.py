@@ -201,6 +201,18 @@ def run(fn: Callable) -> Callable:
                         f"{type(exc).__name__}: {exc}; "
                         f"{'rolling back to last commit' if rollback else 'state already committed'}"
                         " and re-rendezvousing")
+                    # Response-cache flush FIRST, explicitly, on every rank:
+                    # a bit bound under the old membership must never serve
+                    # a negotiation in the new one. shutdown() also tears
+                    # the engine (and with it both cache halves) down, but
+                    # the order matters if teardown is interrupted — a
+                    # flushed cache is safe even when the engine object
+                    # briefly outlives this generation.
+                    try:
+                        if basics._state.engine is not None:
+                            basics._state.engine.cache_flush()
+                    except Exception:
+                        pass
                     try:
                         basics.shutdown()
                     except Exception:

@@ -172,14 +172,15 @@ def test_native_timeline(native, tmp_path):
     assert "CYCLE_START" in content
 
 
-def test_native_duplicate_name_rejected(native):
+def test_native_duplicate_name_rejected(native, monkeypatch):
     """Second enqueue of a live name must raise (reference duplicate-name
     test, test_torch.py:356)."""
     from horovod_tpu.cc.native_engine import HorovodInternalError
 
-    # A long cycle keeps the first enqueue live across the second one —
-    # with the default 1 ms cycle a loaded CI host can drain h1 in the gap
-    # between the two enqueues and the duplicate is never seen.
+    # A long fixed cycle keeps the first enqueue live across the second
+    # one. HOROVOD_WAKE_ON_ENQUEUE=0 opts out of the adaptive cycle's
+    # instant wake, which would otherwise drain h1 before the duplicate.
+    monkeypatch.setenv("HOROVOD_WAKE_ON_ENQUEUE", "0")
     eng = native(Topology(0, 1, 0, 1, 0, 1), Config(cycle_time_ms=500.0))
     try:
         eng._lib  # engine built
@@ -206,13 +207,16 @@ def test_native_autoname_unique(native):
         eng.shutdown()
 
 
-def test_native_timeout_keeps_handle(native):
+def test_native_timeout_keeps_handle(native, monkeypatch):
     """A timed-out wait must not consume the handle; the result stays
     claimable (review finding: stranded-result leak)."""
     import threading
     from horovod_tpu.common.config import Config
     from horovod_tpu.common.topology import Topology
 
+    # Fixed-cycle mode: the adaptive cycle's wake-on-enqueue would finish
+    # the op before the deliberately-too-short wait below.
+    monkeypatch.setenv("HOROVOD_WAKE_ON_ENQUEUE", "0")
     eng = native(Topology(0, 1, 0, 1, 0, 1), Config(cycle_time_ms=200.0))
     try:
         h = eng.enqueue("allreduce", np.arange(4.0), "slowpoke")

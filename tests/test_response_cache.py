@@ -1,0 +1,465 @@
+"""Response-cache + ring-data-plane tests (the steady-state fast path).
+
+Unit level: ResponseCache / CacheMirror semantics (hit, miss, LRU
+eviction, shape-change invalidation, flush). Protocol level: the
+bitvector agreement between _Client and _Coordinator. System level
+(spawned worlds via launch_util): 4-proc ring-vs-star bitwise-identical
+allreduce, zero coordinator-relayed tensor bytes on the ring plane,
+steady-state hit rate, capacity-bounded eviction under churn, and the
+elastic-reset flush (a stale cached response must never be servable
+across a membership change).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.engine import (
+    HorovodInternalError,
+    PyEngine,
+    _Client,
+    _Coordinator,
+    _ring_order_reduce,
+)
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.response_cache import (
+    CacheMirror,
+    ResponseCache,
+    request_key,
+)
+from horovod_tpu.common.topology import Topology
+
+from launch_util import launch_world
+
+
+def _req(name, shape=(4,), op="allreduce", dtype="float32", root=0,
+         average=True):
+    return {"name": name, "op": op, "shape": tuple(shape), "dtype": dtype,
+            "root": root, "average": average}
+
+
+# ------------------------------------------------------------------ unit tier
+
+def test_authority_assign_and_hit():
+    c = ResponseCache(capacity=4)
+    key = request_key(_req("g0"))
+    bit, evicted = c.assign(key, _req("g0"))
+    assert bit is not None and evicted == []
+    assert c.bit_for(key) == bit
+    assert c.lookup_bit(bit)[0] == key
+    # idempotent re-assign returns the same bit
+    bit2, _ = c.assign(key, _req("g0"))
+    assert bit2 == bit
+    assert len(c) == 1
+
+
+def test_authority_lru_eviction_order():
+    c = ResponseCache(capacity=2)
+    b0, _ = c.assign(request_key(_req("g0")), _req("g0"))
+    b1, _ = c.assign(request_key(_req("g1")), _req("g1"))
+    c.lookup_bit(b0)  # touch g0: g1 becomes LRU
+    b2, evicted = c.assign(request_key(_req("g2")), _req("g2"))
+    assert [e[0] for e in evicted] == [b1]
+    assert c.lookup_bit(b0) is not None
+    assert c.lookup_bit(b1) is None
+    assert len(c) == 2 and c.evictions == 1
+
+
+def test_authority_never_evicts_in_use_bits():
+    c = ResponseCache(capacity=1)
+    b0, _ = c.assign(request_key(_req("g0")), _req("g0"))
+    bit, evicted = c.assign(request_key(_req("g1")), _req("g1"),
+                            in_use={"g0"})
+    assert bit is None and evicted == []  # table full of protected bits
+    assert c.lookup_bit(b0) is not None
+
+
+def test_authority_shape_change_evicts_stale_bit():
+    c = ResponseCache(capacity=8)
+    b0, _ = c.assign(request_key(_req("g0", shape=(4,))), _req("g0"))
+    new = _req("g0", shape=(8,))
+    b1, evicted = c.assign(request_key(new), new)
+    assert [e[0] for e in evicted] == [b0]
+    assert b1 != b0
+    assert c.bit_for(request_key(_req("g0", shape=(4,)))) is None
+
+
+def test_authority_flush_and_capacity_zero():
+    c = ResponseCache(capacity=4)
+    c.assign(request_key(_req("a")), _req("a"))
+    c.assign(request_key(_req("b")), _req("b"))
+    assert sorted(e[1][0] for e in c.flush()) == ["a", "b"]
+    assert len(c) == 0
+    off = ResponseCache(capacity=0)
+    assert not off.enabled
+    assert off.assign(request_key(_req("a")), _req("a")) == (None, [])
+
+
+def test_mirror_follows_announcements_and_flushes():
+    m = CacheMirror()
+    key = request_key(_req("g0"))
+    assert m.lookup(key) is None and m.misses == 1
+    m.apply([(7, key)], [])
+    assert m.lookup(key) == 7 and m.hits == 1
+    assert m.peek(key) == 7 and m.hits == 1  # peek: no stats
+    m.apply([], [7])
+    assert m.lookup(key) is None
+    m.apply([(9, key)], [])
+    m.flush()
+    assert len(m) == 0 and m.peek(key) is None
+
+
+def test_ring_order_reduce_matches_manual():
+    arrs = [np.arange(10, dtype=np.float64) * (r + 1) for r in range(4)]
+    out = _ring_order_reduce(arrs, average=True)
+    np.testing.assert_allclose(out, np.arange(10) * 2.5)
+    ints = [np.full(5, r, dtype=np.int32) for r in range(3)]
+    np.testing.assert_array_equal(
+        _ring_order_reduce(ints, average=False), np.full(5, 3, np.int32))
+
+
+# ------------------------------------------------- protocol tier (in-process)
+
+KEY = b"test-secret"
+
+
+def _run_ranks(world, fn):
+    coord = _Coordinator(world, "127.0.0.1", 0, key=KEY, cache_capacity=64)
+    port = coord.server.getsockname()[1]
+    coord.start()
+    results, errors = {}, []
+
+    def worker(rank):
+        try:
+            client = _Client("127.0.0.1", port, rank, key=KEY)
+            try:
+                results[rank] = fn(rank, client)
+            finally:
+                client.close()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    stats = coord.cache_stats()
+    coord.stop()
+    assert not errors, errors
+    return results, stats
+
+
+def test_bitvector_agreement_protocol():
+    """Full request -> assignment announcement -> bit-only resubmission
+    produces the same result, and the authority records the hits."""
+
+    def fn(rank, client):
+        req = _req("g", dtype="float64")
+        arr = np.full(4, float(rank))
+        out1 = client.exchange([req], {"g": arr})
+        assign = list(client.last_cache[0])
+        assert assign, "no assignment announced with the result"
+        bit, key = assign[0]
+        assert tuple(key) == request_key(req)
+        # steady state: no request dicts at all, just the bitvector
+        out2 = client.exchange([], {"g": arr + 1}, bits=1 << bit)
+        return out1["g"], out2["g"]
+
+    results, stats = _run_ranks(2, fn)
+    for rank in range(2):
+        (e1, v1), (e2, v2) = results[rank]
+        assert e1 is None and e2 is None
+        np.testing.assert_allclose(v1, [0.5] * 4)
+        np.testing.assert_allclose(v2, [1.5] * 4)
+    assert stats["hits"] == 2 and stats["size"] == 1
+
+
+def test_protocol_shape_change_reassigns():
+    """A full request under a NEW shape evicts the stale bit everywhere
+    and the renamed signature gets a fresh bit."""
+
+    def fn(rank, client):
+        client.exchange([_req("g", shape=(4,), dtype="float64")],
+                        {"g": np.ones(4)})
+        bit0 = client.last_cache[0][0][0]
+        client.exchange([_req("g", shape=(8,), dtype="float64")],
+                        {"g": np.ones(8)})
+        assign, evict = client.last_cache
+        return bit0, assign, list(evict)
+
+    results, stats = _run_ranks(2, fn)
+    for rank in range(2):
+        bit0, assign, evict = results[rank]
+        assert bit0 in evict, "stale bit not evicted on shape change"
+        assert assign and assign[0][0] != bit0
+    assert stats["size"] == 1
+
+
+def test_mirror_flush_self_heals():
+    """A rank that flushed its mirror falls back to full requests; the
+    coordinator re-announces the existing assignment instead of thrashing
+    the bit table."""
+
+    def fn(rank, client):
+        req = _req("g", dtype="float64")
+        client.exchange([req], {"g": np.ones(4)})
+        bit0 = client.last_cache[0][0][0]
+        # flushed-mirror behavior: full request again, same signature
+        client.exchange([req], {"g": np.ones(4)})
+        return bit0, list(client.last_cache[0]), list(client.last_cache[1])
+
+    results, stats = _run_ranks(2, fn)
+    for rank in range(2):
+        bit0, assign, evict = results[rank]
+        assert evict == []
+        assert any(b == bit0 for b, _k in assign), "assignment not re-announced"
+    assert stats["size"] == 1
+
+
+# --------------------------------------------------- system tier (subprocess)
+
+RING_VS_STAR_WORKER = r"""
+import hashlib, json, os, sys
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import PyEngine
+from horovod_tpu.common.topology import Topology
+from horovod_tpu import metrics as hvd_metrics
+
+rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+eng = PyEngine(Topology(rank, world, 0, 1, rank, world),
+               Config(cycle_time_ms=1.0, stall_check_disable=True))
+try:
+    digest = hashlib.sha256()
+    for i in range(6):
+        for t in range(4):
+            out = eng.run(
+                "allreduce",
+                (np.arange(777, dtype=np.float32) * (rank + 1) + i * t) / 3.0,
+                f"grad.{t}")
+            digest.update(out.tobytes())
+    snap = hvd_metrics.registry().snapshot()["counters"]
+    stats = eng.cache_stats()
+    print(json.dumps({
+        "rank": rank, "hash": digest.hexdigest(),
+        "ring_active": stats["ring_active"],
+        "mirror": stats["mirror"],
+        "star_bytes": snap.get(
+            'horovod_engine_data_bytes_total{plane="star"}', 0),
+        "ring_bytes": snap.get(
+            'horovod_engine_data_bytes_total{plane="ring"}', 0),
+    }))
+finally:
+    eng.shutdown()
+"""
+
+
+@pytest.mark.engine
+def test_ring_vs_star_bitwise_identical_4proc():
+    """The tentpole contract on 4 real processes: both data planes produce
+    BITWISE-identical allreduce results (canonical chunk order), the ring
+    plane moves the bytes peer-to-peer (coordinator relays exactly 0
+    tensor bytes), and steady-state negotiations hit the cache."""
+    ring = launch_world(4, RING_VS_STAR_WORKER,
+                        extra_env={"HOROVOD_RING_DATA_PLANE": "1"})
+    star = launch_world(4, RING_VS_STAR_WORKER,
+                        extra_env={"HOROVOD_RING_DATA_PLANE": "0"})
+    ring_hashes = {r["out"]["hash"] for r in ring}
+    star_hashes = {r["out"]["hash"] for r in star}
+    assert len(ring_hashes) == 1, "ring ranks disagree"
+    assert ring_hashes == star_hashes, "ring and star disagree bitwise"
+    for r in ring:
+        o = r["out"]
+        assert o["ring_active"]
+        assert o["star_bytes"] == 0, (
+            f"coordinator relayed {o['star_bytes']} tensor bytes on ring")
+        assert o["ring_bytes"] > 0
+        m = o["mirror"]
+        assert m["hits"] >= 5 * 4 and m["misses"] <= 4  # 4 cold, rest hot
+    for r in star:
+        assert not r["out"]["ring_active"]
+        assert r["out"]["star_bytes"] > 0  # the relay carried the bytes
+
+
+EVICTION_WORKER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import PyEngine
+from horovod_tpu.common.topology import Topology
+
+rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+eng = PyEngine(Topology(rank, world, 0, 1, rank, world),
+               Config(cycle_time_ms=1.0, stall_check_disable=True))
+try:
+    ok = True
+    for i in range(3):
+        for t in range(8):  # 8 distinct names > capacity of 4
+            out = eng.run("allreduce", np.full(16, float(rank + t)),
+                          f"churn.{t}", average=False)
+            ok = ok and bool(np.allclose(
+                out, sum(r + t for r in range(world))))
+    stats = eng.cache_stats()
+    print(json.dumps({"rank": rank, "ok": ok, "stats": stats}))
+finally:
+    eng.shutdown()
+"""
+
+
+@pytest.mark.engine
+def test_eviction_under_capacity_churn_2proc():
+    """HOROVOD_CACHE_CAPACITY bounds the table under name churn: results
+    stay correct, the authority never exceeds capacity, and evictions
+    are really happening (the mirror stays bounded too)."""
+    res = launch_world(2, EVICTION_WORKER,
+                       extra_env={"HOROVOD_CACHE_CAPACITY": "4"})
+    for r in res:
+        assert r["out"]["ok"]
+        assert r["out"]["stats"]["mirror"]["size"] <= 4
+    auth = next(r["out"]["stats"].get("authority") for r in res
+                if r["out"]["stats"].get("authority"))
+    assert auth["size"] <= 4 and auth["capacity"] == 4
+    assert auth["evictions"] > 0
+
+
+RESET_WORKER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import PyEngine
+from horovod_tpu.common.topology import Topology
+
+rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+topo = Topology(rank, world, 0, 1, rank, world)
+eng = PyEngine(topo, Config(cycle_time_ms=1.0, stall_check_disable=True))
+for i in range(3):
+    eng.run("allreduce", np.full(8, float(rank)), "state.sync", average=False)
+warm = eng.cache_stats()["mirror"]
+# The elastic reset path: flush + teardown + re-init under a bumped
+# generation (hvd.elastic.run does exactly this around re-rendezvous).
+eng.cache_flush()
+flushed = eng.cache_stats()["mirror"]
+eng.shutdown()
+# Generation bump: like a real elastic reset, the new world rendezvouses
+# on a FRESH coordinator address (runner/service.py hands one out per
+# generation) — the old port may still be draining.
+os.environ["HOROVOD_ELASTIC_GENERATION"] = "1"
+os.environ["HOROVOD_COORD_ADDR"] = os.environ["HVD_COORD2"]
+eng2 = PyEngine(topo, Config(cycle_time_ms=1.0, stall_check_disable=True))
+fresh = eng2.cache_stats()["mirror"]
+out = eng2.run("allreduce", np.full(8, float(rank)), "state.sync",
+               average=False)
+post = eng2.cache_stats()["mirror"]
+eng2.shutdown()
+print(json.dumps({
+    "rank": rank, "warm": warm, "flushed": flushed, "fresh": fresh,
+    "post": post, "correct": bool(np.allclose(out, sum(range(world)))),
+}))
+"""
+
+
+@pytest.mark.engine
+def test_elastic_reset_flushes_cache_2proc():
+    """Satellite contract: across a reset/generation bump no stale cached
+    response is servable — the rebuilt engine starts cold (size 0), the
+    first post-reset negotiation is a miss, and the result is computed
+    fresh and correct."""
+    from launch_util import free_port
+
+    res = launch_world(
+        2, RESET_WORKER,
+        extra_env={"HVD_COORD2": f"127.0.0.1:{free_port()}"})
+    for r in res:
+        o = r["out"]
+        assert o["warm"]["size"] >= 1 and o["warm"]["hits"] >= 2
+        assert o["flushed"]["size"] == 0
+        assert o["fresh"]["size"] == 0 and o["fresh"]["hits"] == 0
+        assert o["post"]["misses"] >= 1  # renegotiated from scratch
+        assert o["correct"]
+
+
+def test_elastic_run_wrapper_flushes_cache(monkeypatch):
+    """hvd.elastic.run flushes the response cache on EVERY reset, before
+    engine teardown (stale bits must not survive into the next
+    generation even if teardown is interrupted)."""
+    import importlib
+
+    from horovod_tpu.common import basics
+
+    # horovod_tpu.elastic re-exports run() the decorator; we need the module
+    elastic_run = importlib.import_module("horovod_tpu.elastic.run")
+
+    events = []
+
+    class FakeEngine:
+        def cache_flush(self):
+            events.append("flush")
+
+        def shutdown(self):
+            events.append("engine_shutdown")
+
+    class FakeCtx:
+        index = 0
+        generation = 0
+
+        def poll_reset_required(self):
+            return False
+
+        def rendezvous(self, timeout=300.0):
+            events.append("rendezvous")
+            return {}
+
+    class FakeState:
+        def restore(self):
+            events.append("restore")
+
+        def sync(self, root_rank=0):
+            pass
+
+    monkeypatch.setattr(elastic_run._WorkerContext, "from_env",
+                        classmethod(lambda cls: FakeCtx()))
+    monkeypatch.setattr(basics, "init", lambda: None)
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "size", lambda: 1)
+    monkeypatch.setattr(basics, "shutdown", lambda: events.append("shutdown"))
+    monkeypatch.setattr(basics._state, "engine", FakeEngine(),
+                        raising=False)
+    attempts = [0]
+
+    @elastic_run.run
+    def train(state):
+        attempts[0] += 1
+        if attempts[0] == 1:
+            raise HorovodInternalError("injected peer loss")
+        return "done"
+
+    assert train(FakeState()) == "done"
+    assert "flush" in events
+    assert events.index("flush") < events.index("shutdown")
+    assert "restore" in events and "rendezvous" in events
+
+
+def test_wake_on_enqueue_latency():
+    """Adaptive-cycle satellite: a small eager op must complete far below
+    the configured cycle time (the old fixed sleep taxed every op a
+    half-cycle; wake-on-enqueue removes it)."""
+    import time
+
+    eng = PyEngine(Topology(0, 1, 0, 1, 0, 1),
+                   Config(cycle_time_ms=300.0, stall_check_disable=True))
+    try:
+        eng.run("allreduce", np.ones(4), "warm")  # thread warm
+        t0 = time.monotonic()
+        eng.run("allreduce", np.ones(4), "fast")
+        dt = time.monotonic() - t0
+        assert dt < 0.15, (
+            f"op took {dt * 1000:.0f}ms against a 300ms cycle: "
+            "wake-on-enqueue not effective")
+    finally:
+        eng.shutdown()

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""CI smoke for the eager-engine steady-state fast path (wired into ci.sh).
+
+Spawns a 4-process Python-engine world (the ring + response-cache tentpole)
+running a training-shaped eager loop — the same 8 named gradient tensors
+re-submitted every step — and asserts the steady-state contract end to end:
+
+1. response cache: after a short warmup, the post-warmup negotiation
+   window has a cache hit rate >= 95% and ships ZERO full request lists
+   (the bytes-per-tick control counter stays at bitvector size);
+2. ring data plane: the peer ring is active and carries the tensor bytes —
+   the coordinator relays exactly 0 tensor bytes for the allreduce path;
+3. correctness: every rank's reduced results are bitwise identical, and
+   equal to the star plane's for the same inputs (canonical chunk order).
+
+Exits non-zero with a reason on any violation. Wall-clock budget: ~20 s.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORLD = 4
+WARMUP_STEPS = 2
+STEPS = 30
+TENSORS = 8
+
+WORKER = r"""
+import hashlib, json, os, sys
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import PyEngine
+from horovod_tpu.common.topology import Topology
+from horovod_tpu import metrics as hvd_metrics
+
+rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+warmup = int(os.environ["SMOKE_WARMUP"]); steps = int(os.environ["SMOKE_STEPS"])
+tensors = int(os.environ["SMOKE_TENSORS"])
+eng = PyEngine(Topology(rank, world, 0, 1, rank, world),
+               Config(cycle_time_ms=1.0, stall_check_disable=True))
+try:
+    digest = hashlib.sha256()
+
+    def step(i):
+        for t in range(tensors):
+            out = eng.run("allreduce",
+                          np.arange(512, dtype=np.float32) * (rank + 1) + i + t,
+                          f"grad.{t}")
+            digest.update(out.tobytes())
+
+    for i in range(warmup):
+        step(i)
+    reg = hvd_metrics.registry()
+    snap0 = reg.snapshot()["counters"]
+    for i in range(warmup, steps):
+        step(i)
+    snap1 = reg.snapshot()["counters"]
+
+    def delta(series):
+        return snap1.get(series, 0) - snap0.get(series, 0)
+
+    stats = eng.cache_stats()
+    print(json.dumps({
+        "rank": rank,
+        "hash": digest.hexdigest(),
+        "ring_active": stats["ring_active"],
+        "window_hits": delta("horovod_engine_cache_hits_total"),
+        "window_misses": delta("horovod_engine_cache_misses_total"),
+        "window_full_requests": delta("horovod_engine_full_requests_total"),
+        "star_bytes": snap1.get(
+            'horovod_engine_data_bytes_total{plane="star"}', 0),
+        "ring_bytes": snap1.get(
+            'horovod_engine_data_bytes_total{plane="ring"}', 0),
+    }), flush=True)
+finally:
+    eng.shutdown()
+"""
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def fail(msg: str) -> None:
+    print(f"eager smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run_world(ring: bool) -> list[dict]:
+    port = free_port()
+    secret = secrets.token_hex(16)
+    procs = []
+    for rank in range(WORLD):
+        env = dict(os.environ)
+        env.update({
+            "HVD_REPO": REPO,
+            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(WORLD),
+            "HOROVOD_COORD_ADDR": f"127.0.0.1:{port}",
+            "HOROVOD_SECRET": secret,
+            "HOROVOD_ENGINE": "python",
+            "HOROVOD_RING_DATA_PLANE": "1" if ring else "0",
+            "SMOKE_WARMUP": str(WARMUP_STEPS),
+            "SMOKE_STEPS": str(STEPS),
+            "SMOKE_TENSORS": str(TENSORS),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=120)
+            if p.returncode != 0:
+                fail(f"worker rc={p.returncode}:\n{stderr[-2000:]}")
+            outs.append(json.loads(stdout.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return outs
+
+
+def main() -> int:
+    ring = run_world(ring=True)
+
+    # 1. steady-state cache contract, per rank
+    for r in ring:
+        window = r["window_hits"] + r["window_misses"]
+        rate = r["window_hits"] / max(window, 1)
+        if rate < 0.95:
+            fail(f"rank {r['rank']}: post-warmup cache hit rate {rate:.2%} "
+                 f"< 95% ({r['window_hits']}/{window})")
+        if r["window_full_requests"] != 0:
+            fail(f"rank {r['rank']}: {r['window_full_requests']} full "
+                 "request lists in the steady-state window (want 0: "
+                 "cached ticks are bitvector-only)")
+
+    # 2. data plane: ring active, coordinator relayed zero tensor bytes
+    for r in ring:
+        if not r["ring_active"]:
+            fail(f"rank {r['rank']}: peer ring not active")
+        if r["star_bytes"] != 0:
+            fail(f"rank {r['rank']}: coordinator relayed {r['star_bytes']} "
+                 "tensor bytes with the ring active (want 0)")
+        if r["ring_bytes"] <= 0:
+            fail(f"rank {r['rank']}: ring moved no bytes")
+
+    # 3. correctness: all ranks identical, and identical to the star plane
+    if len({r["hash"] for r in ring}) != 1:
+        fail("ring-plane results differ across ranks")
+    star = run_world(ring=False)
+    if any(r["ring_active"] for r in star):
+        fail("HOROVOD_RING_DATA_PLANE=0 world still activated the ring")
+    if {r["hash"] for r in star} != {ring[0]["hash"]}:
+        fail("star and ring planes disagree bitwise")
+
+    hits = sum(r["window_hits"] for r in ring)
+    window = hits + sum(r["window_misses"] for r in ring)
+    print(f"eager smoke OK: hit rate {hits}/{window} "
+          f"({hits / window:.1%}), ring bytes/rank "
+          f"{ring[0]['ring_bytes']:.0f}, star relay bytes 0, "
+          "star==ring bitwise")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
