@@ -184,7 +184,9 @@ def _build(fusion_threshold=None, compression=None, hierarchical=False,
     opt = hvd.jax.DistributedOptimizer(
         optax.sgd(0.01 * n_dev, momentum=0.9),
         fusion_threshold=fusion_threshold or tuned_default,
-        compression=compression or hvd.Compression.none,
+        # None = the HOROVOD_COMPRESSION env knob (explicit values win),
+        # so the env var A/Bs the wire dtype on the main bench path too.
+        compression=compression,
         hierarchical=hierarchical,
         num_buckets=num_buckets,
     )
@@ -229,11 +231,12 @@ def _build(fusion_threshold=None, compression=None, hierarchical=False,
     return step, (params, batch_stats, opt_state), (x, y), batch, n_dev
 
 
-def _build_smoke(fusion_threshold=None, num_buckets=None):
-    """Tiny-MLP train step for smoke/CI runs and the CPU --buckets-ab path:
-    same DistributedOptimizer hot path (fuse → psum-per-bucket → unfuse) as
-    the ResNet step, but compiles in seconds. 13 parameter leaves give the
-    bucket planner real material to split."""
+def _build_smoke(fusion_threshold=None, num_buckets=None, compression=None):
+    """Tiny-MLP train step for smoke/CI runs and the CPU --buckets-ab /
+    --compression-ab paths: same DistributedOptimizer hot path (fuse →
+    (cast) → psum-per-bucket → unfuse) as the ResNet step, but compiles in
+    seconds. 13 parameter leaves give the bucket planner real material to
+    split. ``compression`` is a HOROVOD_COMPRESSION name or None (env)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -255,6 +258,11 @@ def _build_smoke(fusion_threshold=None, num_buckets=None):
         optax.sgd(0.01 * n_dev, momentum=0.9),
         fusion_threshold=fusion_threshold,
         num_buckets=num_buckets,
+        compression=(hvd.Compression.by_name(compression)
+                     if compression is not None else None),
+        # Tiny model: every bucket is below the production min-bytes cut,
+        # so the A/B must lower it for the cast to actually engage.
+        compression_min_bytes=0 if compression else None,
     )
     opt_state = opt.init(params)
 
@@ -530,8 +538,15 @@ def eager_worker_main() -> None:
     eng = PyEngine(Topology(rank, world, 0, 1, rank, world),
                    Config(cycle_time_ms=1.0, stall_check_disable=True))
     try:
-        n = max(1, int(per_rank_mb * (1 << 20) // 8))
-        big = np.arange(n, dtype=np.float64) * (rank + 1) / 7.0
+        # HVD_EAGER_DTYPE: float64 (default, the historical --eager payload)
+        # or float32 (--compression-ab: gradients are f32, and the wire
+        # claim under test is the classic f32->16-bit halving).
+        pay_dt = np.dtype(os.environ.get("HVD_EAGER_DTYPE", "float64"))
+        n = max(1, int(per_rank_mb * (1 << 20) // pay_dt.itemsize))
+        big = (np.arange(n, dtype=np.float64) * (rank + 1) / 7.0).astype(pay_dt)
+        # Analytic truth for the tolerance check (--compression-ab): the
+        # average over ranks of arange(n)*(r+1)/7 is arange(n)*(w+1)/14.
+        expected = np.arange(n, dtype=np.float64) * (world + 1) / 14.0
         eng.run("allreduce", big, "warmup")  # connect + first negotiation
         outs = []
         t0 = time.monotonic()
@@ -544,6 +559,12 @@ def eager_worker_main() -> None:
         digest = hashlib.sha256()
         for out in outs:
             digest.update(out.tobytes())
+        # Max relative error vs the analytic average — float-epsilon for
+        # compression=none, ~1e-2 for the 16-bit wire dtypes.
+        scale = float(np.abs(expected).max()) or 1.0
+        max_rel_err = float(
+            max(np.abs(out.astype(np.float64) - expected).max()
+                for out in outs) / scale)
         del outs
         # Negotiation latency, cold vs cached: unique names every time
         # (cache can never hit) vs one name re-submitted (steady state).
@@ -571,6 +592,12 @@ def eager_worker_main() -> None:
             "rank": rank,
             "payload_mb_s": round(payload_mb_s, 2),
             "payload_hash": digest.hexdigest(),
+            "payload_max_rel_err": max_rel_err,
+            "compression": stats.get("compression", "none"),
+            "wire_bytes": snap1.get(
+                'horovod_wire_bytes_total{plane="eager"}', 0),
+            "wire_bytes_saved": snap1.get(
+                'horovod_wire_bytes_saved_total{plane="eager"}', 0),
             "cold_neg_ops_s": round(neg_ops / cold_s, 1),
             "cached_neg_ops_s": round(neg_ops / cached_s, 1),
             "cold_hash": cold_hash.hexdigest(),
@@ -697,11 +724,118 @@ def eager_main() -> None:
     budget.emit(out)
 
 
+def compression_ab_main() -> None:
+    """bench.py --compression-ab: A/B the on-the-wire gradient compression
+    (ISSUE 5) on BOTH data planes.
+
+    Ring plane: two 4-proc Python-engine worlds (HOROVOD_COMPRESSION=none
+    vs bf16) move the same per-rank payload over the peer ring; the
+    headline value is the bf16/none steady-state throughput ratio, with the
+    wire-byte counters proving the reduction and the analytic max-rel-err
+    proving the results stay within 16-bit tolerance (none stays exactly
+    0 — bitwise identical to the uncompressed baseline). Compiled plane: a
+    mini joint autotune over (fusion_threshold, num_buckets, compression)
+    on the smoke MLP — the ISSUE 5 third search dimension — reporting the
+    per-config steps/s. One JSON line, always (budget watchdog)."""
+    budget = _Budget.install("compression_ab_ring_speedup", "x")
+    world = int(os.environ.get("HVD_EAGER_WORLD", "4"))
+    if _smoke_on():
+        os.environ.setdefault("HVD_EAGER_MB", "1")
+        os.environ.setdefault("HVD_EAGER_ITERS", "3")
+        os.environ.setdefault("HVD_EAGER_NEG_OPS", "16")
+    stage_s = min(max(budget.remaining() / 3 - 10, 30), 240)
+    # f32 payloads: what gradients actually are, and the wire claim under
+    # test (f32 -> 16-bit = the classic 2x; phase-1 partials drop 4x from
+    # the uncompressed plane's f64 accumulator width).
+    budget.stage("ring-none")
+    none = _spawn_eager_world(
+        world, {"HOROVOD_RING_DATA_PLANE": "1", "HVD_EAGER_DTYPE": "float32",
+                "HOROVOD_COMPRESSION": "none"}, stage_s)
+    budget.stage("ring-bf16")
+    bf16 = _spawn_eager_world(
+        world, {"HOROVOD_RING_DATA_PLANE": "1", "HVD_EAGER_DTYPE": "float32",
+                "HOROVOD_COMPRESSION": "bf16"}, stage_s)
+    out = {"metric": "compression_ab_ring_speedup", "value": 0.0,
+           "unit": "x", "world": world,
+           "payload_mb_per_rank": float(os.environ.get("HVD_EAGER_MB", "32")),
+           "iters": int(os.environ.get("HVD_EAGER_ITERS", "3"))}
+    if none is None or bf16 is None:
+        out.update({"partial": True,
+                    "reason": "a bench world failed or timed out",
+                    "none_ok": none is not None, "bf16_ok": bf16 is not None})
+        budget.emit(out)
+        return
+    none_mbs = min(r["payload_mb_s"] for r in none)
+    bf16_mbs = min(r["payload_mb_s"] for r in bf16)
+    wire = sum(r["wire_bytes"] for r in bf16)
+    saved = sum(r["wire_bytes_saved"] for r in bf16)
+    out.update({
+        "value": round(bf16_mbs / none_mbs, 3),
+        "ring_none_mb_s": round(none_mbs, 2),
+        "ring_bf16_mb_s": round(bf16_mbs, 2),
+        "ring_active": bf16[0]["ring_active"],
+        # Wire proof: bytes halved-or-better, results inside 16-bit
+        # tolerance, and the uncompressed world untouched (exactly 0 error
+        # vs the analytic truth = bitwise the PR 4 baseline).
+        "wire_bytes_reduction": round((wire + saved) / max(wire, 1), 2),
+        "bf16_max_rel_err": max(r["payload_max_rel_err"] for r in bf16),
+        "none_max_rel_err": max(r["payload_max_rel_err"] for r in none),
+        "none_ranks_agree": len({r["payload_hash"] for r in none}) == 1,
+        "bf16_ranks_agree": len({r["payload_hash"] for r in bf16}) == 1,
+    })
+    # Compiled plane: the (threshold, buckets, wire-dtype) joint autotune on
+    # the smoke MLP (full grids belong to --buckets-ab; this exercises the
+    # third dimension end to end and reports the winner).
+    if not budget.skip_if_low("compiled-ab", 45):
+        budget.stage("compiled-ab")
+        import horovod_tpu as hvd
+        from horovod_tpu.jax.autotune import tune
+
+        hvd.init()
+        batch_box = [0]
+
+        def step_factory(fusion_threshold, num_buckets, compression):
+            step, state, (x, y), batch, _ = _build_smoke(
+                fusion_threshold, num_buckets, compression)
+            state = list(state)
+            loss_box = [None]
+
+            def run():
+                p, o, loss_box[0] = step(*state, x, y)
+                state[:] = (p, o)
+            batch_box[0] = batch
+            return run, lambda: float(loss_box[0])
+
+        report = tune(step_factory, thresholds=(1 << 20,),
+                      num_buckets=(1, 4), compressions=("none", "bf16"),
+                      warmup=2, iters=5, reps=2, gp_rounds=0,
+                      log_path=os.environ.get("HVD_AUTOTUNE_LOG", ""),
+                      verbose=True)
+        print(report.knob_curve(), file=sys.stderr)
+        comp_best = {m.compression: max(
+            (x for x in report.table if x.compression == m.compression),
+            key=lambda x: x.steps_per_s) for m in report.table}
+        batch = batch_box[0]
+        out.update({
+            "compiled_none_img_s": round(
+                comp_best["none"].steps_per_s * batch, 2),
+            "compiled_bf16_img_s": round(
+                comp_best["bf16"].steps_per_s * batch, 2),
+            "compiled_bf16_vs_none": round(
+                comp_best["bf16"].steps_per_s
+                / comp_best["none"].steps_per_s, 4),
+            "autotuned": report.best.config,
+        })
+    budget.emit(out)
+
+
 def main() -> None:
     if "--eager-worker" in sys.argv:
         return eager_worker_main()
     if "--eager" in sys.argv:
         return eager_main()
+    if "--compression-ab" in sys.argv:
+        return compression_ab_main()
 
     # Arm the watchdog BEFORE the first jax import: on a degraded platform
     # backend init itself can wedge (the BENCH_r05 signature), and the

@@ -30,7 +30,8 @@ import ctypes, os
 # A missing -lrt builds cleanly but dies at dlopen with "undefined symbol:
 # shm_open" — load the library here so the link line can't silently regress.
 lib = ctypes.CDLL(os.path.join("horovod_tpu", "cc", "libhvd_core.so"))
-for sym in ("hvd_init", "hvd_pm_create", "hvd_pm_set_num_buckets"):
+for sym in ("hvd_init", "hvd_pm_create", "hvd_pm_set_num_buckets",
+            "hvd_compression"):
     assert hasattr(lib, sym), sym
 print("native core loads ok (shm_open resolved)")
 PY
@@ -39,8 +40,8 @@ echo "== bench smoke (tiny model, hard timeout: a hang fails fast, not rc=124 at
 HVD_BENCH_SMOKE=1 timeout -k 10 240 env JAX_PLATFORMS=cpu \
   python bench.py --buckets-ab
 
-echo "== eager smoke (4-proc Python engine: steady-state cache hit rate >= 95%, ring data plane carrying the bytes, star==ring bitwise) =="
-timeout -k 10 180 python tools/eager_smoke.py
+echo "== eager smoke (4-proc Python engine: steady-state cache hit rate >= 95%, ring data plane carrying the bytes, star==ring bitwise; bf16 wire >= 2x fewer bytes within tolerance) =="
+timeout -k 10 240 python tools/eager_smoke.py
 
 echo "== metrics smoke (2-proc train, stall check + exposition; snapshot vs docs/metrics_schema.json, timeline JSON shape) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_smoke.py

@@ -259,16 +259,22 @@ def measure(args, mesh, n_dev, block_q, block_k):
         # host round-trips between steps — the shape a DeviceCache-fed
         # training loop takes, and the measurement that separates device
         # time from the tunnel's per-dispatch latency. A PRNG key rides the
-        # donated carry (chained ACROSS dispatches, seeded per rank), so
-        # every scan step of every dispatch draws genuinely fresh random
-        # tokens — the loss sits at the no-signal plateau instead of
-        # memorizing reused data.
+        # donated carry (chained ACROSS dispatches), so every scan step of
+        # every dispatch draws genuinely fresh random tokens — the loss
+        # sits at the no-signal plateau instead of memorizing reused data.
+        # The CARRIED key is a constant seed, identical on every rank (its
+        # in/out specs are the replicated P(), and a rank-divergent value
+        # for a replicated argument is undefined in a multi-process world —
+        # ADVICE r5); the per-rank decorrelation instead folds the mesh
+        # axis index into the DRAW key inside the traced function.
         inner = train_step
 
         def train_step(params, opt_state, key, tokens):  # noqa: F811
             def body(carry, _):
                 p, o, k = carry
                 k, sub = jax.random.split(k)
+                sub = jax.random.fold_in(
+                    sub, jax.lax.axis_index(hvd.HVD_AXIS))
                 toks = jax.random.randint(sub, tokens.shape, 0, args.vocab,
                                           dtype=tokens.dtype)
                 p, o, loss = inner(p, o, toks)
@@ -302,7 +308,9 @@ def measure(args, mesh, n_dev, block_q, block_k):
 
     state = [params, opt_state]
     if scan_steps > 1:
-        state.append(jax.random.PRNGKey(1000 * hvd.rank() + 17))
+        # Constant seed on every rank: the key is a replicated (P()) carry;
+        # rank decorrelation happens inside the traced fn (axis_index fold).
+        state.append(jax.random.PRNGKey(17))
     loss_box = [None]
 
     def run():

@@ -38,7 +38,7 @@ NATIVE_METRICS = (
     "reducescatter_count", "alltoall_count", "collective_bytes",
     "collective_errors", "negotiation_us", "execution_us",
     "stall_warnings", "cycles", "timeline_dropped",
-    "cache_hits", "cache_misses",
+    "cache_hits", "cache_misses", "wire_bytes", "wire_bytes_saved",
 )
 
 
@@ -85,6 +85,8 @@ def _load():
     lib.hvd_last_stall.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.hvd_cache_size.restype = ctypes.c_int
     lib.hvd_cache_size.argtypes = []
+    lib.hvd_compression.restype = ctypes.c_int
+    lib.hvd_compression.argtypes = []
     lib.hvd_cache_flush.restype = None
     lib.hvd_cache_flush.argtypes = []
     lib.hvd_timeline_start.restype = ctypes.c_int
@@ -145,6 +147,11 @@ class NativeEngine:
         # cache_capacity_from_env reads getenv at coordinator construction).
         os.environ["HOROVOD_CACHE_CAPACITY"] = str(
             max(0, int(getattr(config, "cache_capacity", 1024))))
+        # And the wire-compression dtype (engine.h wire_dtype_from_env,
+        # read at Engine construction): export the Config value so
+        # Config(compression=...) behaves like every other field.
+        os.environ["HOROVOD_COMPRESSION"] = str(
+            getattr(config, "compression", "none") or "none")
         err = ctypes.create_string_buffer(1024)
         timeline = config.timeline if topo.rank == 0 else ""
         pinned = getattr(config, "pinned", set())
@@ -173,10 +180,11 @@ class NativeEngine:
 
         self._registry = _metrics_registry()
         self._registry.register_collector(self._collect_metrics)
-        # Last native cache counter values seen by the collector: the
-        # registry series are Prometheus counters (inc-only), so the
-        # collector feeds them the DELTA since its previous scrape.
+        # Last native counter values seen by the collector: the registry
+        # series are Prometheus counters (inc-only), so the collector feeds
+        # them the DELTA since its previous scrape.
         self._cache_last = {"cache_hits": 0, "cache_misses": 0}
+        self._wire_last = {"wire_bytes": 0, "wire_bytes_saved": 0}
         # handle -> (op, nbytes, enqueue time): feeds the SAME per-op
         # count/bytes/latency series the Python engine emits
         # (horovod_collective_*), so dashboards read one surface no matter
@@ -287,7 +295,14 @@ class NativeEngine:
             "hier_allgather": int(self._lib.hvd_hier_allgather_on()),
             "hier_capable": int(self._lib.hvd_hier_capable()),
             "shm_links": int(self._lib.hvd_shm_links()),
+            "wire_dtype": self.wire_dtype(),
         }
+
+    def wire_dtype(self) -> Optional[str]:
+        """Name of the HOROVOD_COMPRESSION wire dtype the engine casts
+        allreduce payloads to, or None when compression is off."""
+        wid = int(self._lib.hvd_compression())
+        return DTYPES[wid] if 0 <= wid < len(DTYPES) else None
 
     def metrics(self) -> dict:
         """Raw native telemetry counters (c_api hvd_metric)."""
@@ -308,6 +323,8 @@ class NativeEngine:
         return {
             "enabled": int(getattr(self.config, "cache_capacity", 1024)) > 0,
             "ring_active": self.topo.size > 1,
+            "compression": ("none" if self.wire_dtype() is None
+                            else getattr(self.config, "compression", "none")),
             "mirror": {"size": int(self._lib.hvd_cache_size()),
                        "hits": max(hits, 0), "misses": max(misses, 0)},
         }
@@ -342,6 +359,23 @@ class NativeEngine:
                         help="response-cache negotiations by outcome",
                     ).inc(v - last)
                 self._cache_last[native] = max(v, last)
+        # Same delta pattern for the wire-compression counters: the native
+        # atomics feed the SAME horovod_wire_bytes_* series the Python
+        # engine increments directly, labeled by plane.
+        for series, native, hlp in (
+                ("horovod_wire_bytes_total", "wire_bytes",
+                 "gradient payload bytes moved at the compressed wire "
+                 "dtype"),
+                ("horovod_wire_bytes_saved_total", "wire_bytes_saved",
+                 "bytes the compressed wire avoided sending vs the "
+                 "uncompressed plane")):
+            v = vals.get(native, -1)
+            if v >= 0:
+                last = self._wire_last.get(native, 0)
+                if v > last:
+                    reg.counter(series, help=hlp,
+                                plane="native").inc(v - last)
+                self._wire_last[native] = max(v, last)
         stall = self.last_stall()
         if stall:
             reg.set_info("stall_report", {

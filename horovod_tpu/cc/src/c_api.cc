@@ -50,14 +50,21 @@ int hvd_init(int rank, int size, int local_rank, int local_size, int cross_rank,
     // it to the kernel on free, so each collective's tensor-table entry,
     // response vector, and numpy result re-faults ~25k pages per 100 MB —
     // measured at roughly a memcpy's cost per buffer on this class of host.
-    // Raising the thresholds makes the allocator RE-USE those pages across
-    // iterations (process-wide, numpy included — the eager path's analog of
-    // the reference's fusion-buffer reuse). Footprint stays bounded by peak
-    // live bytes; HOROVOD_NO_MALLOC_TUNING=1 opts out.
+    // Raising M_MMAP_THRESHOLD makes the allocator RE-USE those pages
+    // across iterations (process-wide, numpy included — the eager path's
+    // analog of the reference's fusion-buffer reuse). M_TRIM_THRESHOLD
+    // stays moderate (ADVICE r5): a 512 MiB trim threshold pinned every
+    // freed gradient-sized block in the arena process-wide for the life of
+    // the job; 64 MiB keeps steady-state reuse (the hot path frees and
+    // re-allocates same-sized buffers well under a trim window) while
+    // letting genuinely idle memory drain back to the kernel. Shutdown
+    // malloc_trim()s whatever is left (hvd_shutdown below). Footprint
+    // stays bounded by peak live bytes; HOROVOD_NO_MALLOC_TUNING=1 opts
+    // out.
     const char* no_tune = std::getenv("HOROVOD_NO_MALLOC_TUNING");
     if (!(no_tune && std::string(no_tune) == "1")) {
       ::mallopt(M_MMAP_THRESHOLD, 512 << 20);
-      ::mallopt(M_TRIM_THRESHOLD, 512 << 20);
+      ::mallopt(M_TRIM_THRESHOLD, 64 << 20);
     }
     Topology t{rank, size, local_rank, local_size, cross_rank, cross_size};
     EngineConfig c;
@@ -92,7 +99,14 @@ void hvd_shutdown() {
     eng = std::move(g_engine);
     g_engine.reset();
   }
-  if (eng) eng->shutdown();  // destructor runs when the last caller drops it
+  if (eng) {
+    eng->shutdown();  // destructor runs when the last caller drops it
+    eng.reset();
+    // Return the arena's dead pages to the kernel now that the engine's
+    // buffers are gone (the counterpart of the raised M_MMAP_THRESHOLD in
+    // hvd_init — re-init re-tunes, so trimming here is always safe).
+    ::malloc_trim(0);
+  }
 }
 
 int hvd_is_initialized() { return engine() ? 1 : 0; }
@@ -241,7 +255,17 @@ long long hvd_metric(const char* name) {
   if (k == "timeline_dropped") return (long long)eng->timeline_dropped();
   if (k == "cache_hits") return (long long)m.cache_hits.load();
   if (k == "cache_misses") return (long long)m.cache_misses.load();
+  if (k == "wire_bytes") return (long long)m.wire_bytes.load();
+  if (k == "wire_bytes_saved") return (long long)m.wire_bytes_saved.load();
   return -1;
+}
+
+// Live HOROVOD_COMPRESSION wire dtype: the DataType id (hvd_common.h order,
+// same table as native_engine.py DTYPES) payloads are cast to at enqueue,
+// or -1 when compression is off / no engine.
+int hvd_compression() {
+  auto eng = engine();
+  return eng ? eng->wire_dtype() : -1;
 }
 
 // ---- response cache (this PR: the steady-state fast path) ----

@@ -48,11 +48,16 @@ inline size_t cache_capacity_from_env() {
 
 // Full request signature; rank deliberately excluded (the template is
 // rank-agnostic — the coordinator stamps the contributing rank back in).
+// orig_dtype is included (ISSUE 5): a compressed allreduce (dtype = wire
+// format, orig_dtype = caller dtype) and its uncompressed twin are
+// DIFFERENT signatures, so a wire-dtype change misses, falls back to the
+// full-request path, and invalidates the stale bit like a shape change.
 inline std::string cache_key(const Request& q) {
   std::string k = q.name;
   k.push_back('\0');
   k.push_back((char)q.op);
   k.push_back((char)q.dtype);
+  k.push_back((char)q.orig_dtype);
   k.push_back((char)q.average);
   k.append(std::to_string(q.root_rank));
   for (int64_t d : q.shape) {

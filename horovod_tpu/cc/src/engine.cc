@@ -76,6 +76,50 @@ void HandleManager::release(int64_t h) {
   done_.erase(h);
 }
 
+// --------------------------------------------------- wire compression casts
+// HOROVOD_COMPRESSION (ISSUE 5): f32/f64 allreduce payloads are cast to the
+// 16-bit wire dtype HERE, once, at enqueue — after that the whole pipeline
+// (tensor table, fusion buffer, ring hops) moves and reduces 2-byte
+// elements natively, with f32 arithmetic per add inside the ring's
+// add_chunk (ring.h; reference analog half.h:135 float16_sum). The result
+// is cast back to the caller dtype at completion (finish()).
+
+static uint16_t to_wire_one(DataType wire, float v) {
+  return wire == DataType::BF16 ? float_to_bf16(v) : float_to_half(v);
+}
+
+static float from_wire_one(DataType wire, uint16_t v) {
+  return wire == DataType::BF16 ? bf16_to_float(v) : half_to_float(v);
+}
+
+// Cast `n` elements of `from`-typed `src` into `wire`-typed `out`.
+static void cast_to_wire(DataType from, DataType wire, const void* src,
+                         size_t n, std::vector<uint8_t>& out) {
+  out.resize(n * dtype_size(wire));
+  uint16_t* dst = (uint16_t*)out.data();
+  if (from == DataType::F32) {
+    const float* s = (const float*)src;
+    for (size_t i = 0; i < n; i++) dst[i] = to_wire_one(wire, s[i]);
+  } else {  // F64: via float — bf16/f16 carry < f32 precision anyway
+    const double* s = (const double*)src;
+    for (size_t i = 0; i < n; i++) dst[i] = to_wire_one(wire, (float)s[i]);
+  }
+}
+
+// Cast `n` wire-typed elements back to the caller dtype.
+static void cast_from_wire(DataType wire, DataType to, const void* src,
+                           size_t n, std::vector<uint8_t>& out) {
+  out.resize(n * dtype_size(to));
+  const uint16_t* s = (const uint16_t*)src;
+  if (to == DataType::F32) {
+    float* dst = (float*)out.data();
+    for (size_t i = 0; i < n; i++) dst[i] = from_wire_one(wire, s[i]);
+  } else {
+    double* dst = (double*)out.data();
+    for (size_t i = 0; i < n; i++) dst[i] = (double)from_wire_one(wire, s[i]);
+  }
+}
+
 // ------------------------------------------------------------------- Engine
 // dtype note: f16/bf16 reduce at NATIVE width end to end — 2 bytes/element
 // on the wire and in buffers, f32 arithmetic per add inside the ring's
@@ -87,6 +131,7 @@ Engine::Engine(const Topology& topo, const EngineConfig& cfg)
     : topo_(topo), cfg_(cfg) {
   cycle_time_ms_ = cfg_.cycle_time_ms;
   fusion_threshold_ = (int64_t)cfg_.fusion_threshold;
+  wire_dtype_ = wire_dtype_from_env();
   if (!cfg_.timeline_path.empty() && topo_.rank == 0) {
     timeline_.init(cfg_.timeline_path, cfg_.timeline_mark_cycles);
   }
@@ -221,6 +266,7 @@ int64_t Engine::enqueue(OpType op, const std::string& name, DataType dtype,
   e.req.rank = topo_.rank;
   e.req.op = op;
   e.req.dtype = dtype;
+  e.req.orig_dtype = dtype;
   e.handle = handles_.allocate();
   // Auto-name by handle like the reference's GetOpName (mpi_ops_v2.cc:44-50):
   // handles increment identically across ranks when op order matches.
@@ -230,8 +276,23 @@ int64_t Engine::enqueue(OpType op, const std::string& name, DataType dtype,
   e.req.root_rank = root_rank;
   e.req.average = average ? 1 : 0;
   e.req.shape = shape;
-  size_t nbytes = e.req.elements() * dtype_size(dtype);
-  e.data.assign((const uint8_t*)data, (const uint8_t*)data + nbytes);
+  size_t elems = e.req.elements();
+  // Cast-on-send (HOROVOD_COMPRESSION): allreduce payloads of wider floats
+  // enter the engine already at the 16-bit wire dtype — the tensor table,
+  // fusion buffer and every ring hop then move half (f32) or a quarter
+  // (f64) of the bytes; add_chunk accumulates each add in f32 (ring.h).
+  DataType wire = (DataType)wire_dtype_;
+  if (wire_dtype_ >= 0 && op == OpType::ALLREDUCE &&
+      (dtype == DataType::F32 || dtype == DataType::F64) && dtype != wire) {
+    e.req.dtype = wire;
+    cast_to_wire(dtype, wire, data, elems, e.data);
+    metrics_.wire_bytes += (uint64_t)e.data.size();
+    metrics_.wire_bytes_saved +=
+        (uint64_t)(elems * dtype_size(dtype) - e.data.size());
+  } else {
+    size_t nbytes = elems * dtype_size(dtype);
+    e.data.assign((const uint8_t*)data, (const uint8_t*)data + nbytes);
+  }
   int64_t handle = e.handle;
   e.enqueued = std::chrono::steady_clock::now();
   {
@@ -299,6 +360,17 @@ void Engine::finish(Entry& e, Status st, Response res) {
   // Central completion point = central count point: every path (local
   // fast path, fused ring, error/abort sweeps) lands here exactly once.
   if (st.ok()) {
+    // Wire decompression: a compressed allreduce finished with wire-dtype
+    // bytes; restore the caller dtype exactly here, so every execution
+    // path (single-tensor fast path, fused bucket, local world) converts
+    // once and the handle always yields the dtype the caller enqueued.
+    if (e.req.compressed() && res.kind == Response::OK) {
+      std::vector<uint8_t> full;
+      cast_from_wire(e.req.dtype, e.req.orig_dtype, res.data.data(),
+                     res.data.size() / dtype_size(e.req.dtype), full);
+      res.data.swap(full);
+      res.dtype = e.req.orig_dtype;
+    }
     switch (e.req.op) {
       case OpType::ALLREDUCE: metrics_.allreduce_count++; break;
       case OpType::ALLGATHER: metrics_.allgather_count++; break;
@@ -306,8 +378,10 @@ void Engine::finish(Entry& e, Status st, Response res) {
       case OpType::REDUCESCATTER: metrics_.reducescatter_count++; break;
       case OpType::ALLTOALL: metrics_.alltoall_count++; break;
     }
+    // Caller-visible payload size (orig width), matching the Python
+    // engine's accounting whether or not the wire was compressed.
     metrics_.collective_bytes +=
-        (uint64_t)e.req.elements() * dtype_size(e.req.dtype);
+        (uint64_t)e.req.elements() * dtype_size(e.req.orig_dtype);
   } else {
     metrics_.collective_errors++;
   }
@@ -1400,6 +1474,10 @@ bool Coordinator::validate(const std::string& name,
       return fail("Mismatched collective operations for tensor " + name);
     if (q.dtype != first.dtype)
       return fail("Mismatched data types for tensor " + name);
+    if (q.orig_dtype != first.orig_dtype)
+      // Divergent HOROVOD_COMPRESSION across ranks: half the world would
+      // ship 2-byte chunks the other half reads at full width.
+      return fail("Mismatched wire compression for tensor " + name);
   }
   if (first.op == OpType::ALLGATHER) {
     if (first.shape.empty())

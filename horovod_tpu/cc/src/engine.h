@@ -28,9 +28,11 @@
 #define HVD_ENGINE_H
 
 #include <atomic>
+#include <cctype>
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <deque>
 #include <map>
 #include <memory>
@@ -77,7 +79,26 @@ struct EngineMetrics {
   // eager smoke asserts on.
   std::atomic<uint64_t> cache_hits{0};
   std::atomic<uint64_t> cache_misses{0};
+  // On-the-wire compression (ISSUE 5): payload bytes enqueued at the wire
+  // dtype, and the bytes the cast avoided vs the caller dtype. Mirrored by
+  // native_engine.py into horovod_wire_bytes_{,saved_}total{plane="native"}.
+  std::atomic<uint64_t> wire_bytes{0};
+  std::atomic<uint64_t> wire_bytes_saved{0};
 };
+
+// HOROVOD_COMPRESSION={none,fp16,bf16} -> the 16-bit wire dtype allreduce
+// payloads are cast to at enqueue, or -1 for none/unknown. Read from the
+// env like the cache capacity (native_engine.py exports the Config value
+// right before hvd_init).
+inline int wire_dtype_from_env() {
+  const char* v = std::getenv("HOROVOD_COMPRESSION");
+  if (!v || !*v) return -1;
+  std::string s(v);
+  for (auto& c : s) c = (char)std::tolower((unsigned char)c);
+  if (s == "fp16") return (int)DataType::F16;
+  if (s == "bf16") return (int)DataType::BF16;
+  return -1;
+}
 
 // One rank's registration record: ring endpoints plus its host coordinates.
 // The coordinator gathers these in hello and broadcasts the full map, which
@@ -213,6 +234,10 @@ class Engine {
     cache_bit_to_key_.clear();
   }
 
+  // Live wire-compression dtype: (int)DataType of the 16-bit wire format,
+  // or -1 when HOROVOD_COMPRESSION is none (c_api hvd_compression).
+  int wire_dtype() const { return wire_dtype_; }
+
   // Engine telemetry counters (c_api hvd_metric / hvd_last_stall).
   const EngineMetrics& op_metrics() const { return metrics_; }
   uint64_t timeline_dropped() const { return timeline_.dropped(); }
@@ -304,6 +329,11 @@ class Engine {
   // across collectives so the hot path never re-faults a fresh scratch).
   std::vector<uint8_t> ring_scratch_;
   std::unique_ptr<ParameterManager> pm_;  // single-process tuning only
+  // HOROVOD_COMPRESSION wire dtype ((int)DataType, -1 = none): allreduce
+  // payloads are cast to it at enqueue (cast-on-send) and restored to the
+  // caller dtype at completion; the ring then moves and reduces 2-byte
+  // elements natively (add_chunk accumulates each add in f32, ring.h).
+  int wire_dtype_ = -1;
   std::atomic<double> cycle_time_ms_{5.0};
   std::atomic<int64_t> fusion_threshold_{64 << 20};
   std::atomic<uint32_t> applied_knob_version_{0};

@@ -76,11 +76,18 @@ class Reader {
 };
 
 // A collective request from one rank — metadata only (reference
-// message.h:44-120).
+// message.h:44-120). `dtype` is the dtype the engine MOVES AND REDUCES —
+// under HOROVOD_COMPRESSION (ISSUE 5) an allreduce's payload is cast to the
+// 16-bit wire dtype at enqueue, so dtype names the wire format while
+// `orig_dtype` tags the caller's dtype (restored into the Response at
+// completion). Uncompressed requests have orig_dtype == dtype. Both are
+// part of the signature, so cache.h bits distinguish compressed from
+// uncompressed negotiations of the same tensor.
 struct Request {
   int32_t rank = 0;
   OpType op = OpType::ALLREDUCE;
-  DataType dtype = DataType::F32;
+  DataType dtype = DataType::F32;       // wire/working dtype
+  DataType orig_dtype = DataType::F32;  // caller dtype (== dtype when uncompressed)
   std::string name;
   int32_t root_rank = 0;
   uint8_t average = 1;
@@ -92,11 +99,13 @@ struct Request {
     return n;
   }
   size_t nbytes() const { return elements() * dtype_size(dtype); }
+  bool compressed() const { return orig_dtype != dtype; }
 
   void write(Writer& w) const {
     w.i32(rank);
     w.u8((uint8_t)op);
     w.u8((uint8_t)dtype);
+    w.u8((uint8_t)orig_dtype);
     w.str(name);
     w.i32(root_rank);
     w.u8(average);
@@ -108,6 +117,7 @@ struct Request {
     q.rank = r.i32();
     q.op = (OpType)r.u8();
     q.dtype = (DataType)r.u8();
+    q.orig_dtype = (DataType)r.u8();
     q.name = r.str();
     q.root_rank = r.i32();
     q.average = r.u8();

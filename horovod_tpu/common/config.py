@@ -55,6 +55,27 @@ DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
 DEFAULT_NUM_BUCKETS = 1
 # Default cycle time: 5 ms (reference operations.cc:1844).
 DEFAULT_CYCLE_TIME_MS = 5.0
+# Buckets below this byte size skip wire compression on the compiled plane:
+# the cast pair costs more than the bytes it saves on tiny buffers, and
+# non-gradient scalars (loss, counters) keep full precision.
+DEFAULT_COMPRESSION_MIN_BYTES = 4096
+
+
+def _env_compression() -> str:
+    """HOROVOD_COMPRESSION={none,fp16,bf16}: the wire dtype every data plane
+    casts gradient payloads to (docs/compression.md). Unknown values warn
+    and fall back to none — config parsing never takes the job down."""
+    from ..compression import WIRE_DTYPES
+
+    v = os.environ.get("HOROVOD_COMPRESSION", "none").lower() or "none"
+    if v not in WIRE_DTYPES:
+        import sys
+
+        print(f"[horovod_tpu/warning] unknown HOROVOD_COMPRESSION={v!r}; "
+              f"expected one of {sorted(WIRE_DTYPES)}; using 'none'",
+              file=sys.stderr)
+        return "none"
+    return v
 # Stall-check warning period: 60 s (reference operations.cc:258 STALL_WARNING_TIME).
 STALL_WARNING_TIME_S = 60.0
 # Stall-shutdown escalation: 0 disables (reference STALL_SHUTDOWN_TIME is
@@ -156,6 +177,17 @@ class Config:
             0, _env_int("HOROVOD_CACHE_CAPACITY", 1024)))
     ring_data_plane: bool = field(                        # HOROVOD_RING_DATA_PLANE (0 disables)
         default_factory=lambda: _env_bool("HOROVOD_RING_DATA_PLANE", True))
+    # On-the-wire gradient compression (ISSUE 5, docs/compression.md).
+    # Env-aware defaults like shm/cache above: tests and bench workers
+    # construct Config(...) directly and the launcher env must still win.
+    compression: str = field(                             # HOROVOD_COMPRESSION
+        default_factory=_env_compression)
+    compression_error_feedback: bool = field(             # HOROVOD_COMPRESSION_ERROR_FEEDBACK
+        default_factory=lambda: _env_bool(
+            "HOROVOD_COMPRESSION_ERROR_FEEDBACK", False))
+    compression_min_bytes: int = field(                   # HOROVOD_COMPRESSION_MIN_BYTES
+        default_factory=lambda: max(0, _env_int(
+            "HOROVOD_COMPRESSION_MIN_BYTES", DEFAULT_COMPRESSION_MIN_BYTES)))
     log_level: str = "warning"                            # HOROVOD_LOG_LEVEL
     log_hide_time: bool = False                           # HOROVOD_LOG_HIDE_TIME
     # Which env vars were explicitly pinned (autotuner must not override,

@@ -58,6 +58,7 @@ import numpy as np
 
 from .config import Config, STALL_WARNING_TIME_S, _env_float
 from .response_cache import CacheMirror, ResponseCache, request_key
+from ..compression import numpy_dtype_by_name, numpy_wire_dtype
 from .topology import Topology
 from ..metrics import StallInfo, StallWatchdog, registry as _metrics_registry
 from ..metrics.registry import DEFAULT_BYTE_BUCKETS
@@ -195,25 +196,50 @@ def _acc_finish(acc: np.ndarray, average: bool, world: int,
     return acc if acc.dtype == dtype else acc.astype(dtype)
 
 
-def _ring_order_reduce(arrs: list[np.ndarray], average: bool) -> np.ndarray:
+def _ring_order_reduce(arrs: list[np.ndarray], average: bool,
+                       wire_dtype=None) -> np.ndarray:
     """Canonical allreduce reduction, shared by the star relay and the peer
     ring: chunk c accumulates contributions starting at rank (c+1) % world
     in ring order — exactly the order the ring reduce-scatter performs —
     so the two data planes (and cold vs cached negotiations) produce
-    BITWISE-IDENTICAL results."""
+    BITWISE-IDENTICAL results.
+
+    ``wire_dtype`` (HOROVOD_COMPRESSION) simulates the compressed ring's
+    wire hops exactly: every partial sum is rounded to the wire dtype
+    before the next contribution lands (the reduce-scatter hop payload),
+    and the finished chunk is rounded once more (the allgather hop) so
+    every rank — including the chunk's owner — holds the identical
+    wire-representable value. Compressed accumulation runs at float32 —
+    the native engine's accumulate-in-fp32 (ring.h add_chunk) — which is
+    lossless relative to the per-hop 16-bit rounding and half the cast/add
+    cost of the float64 path; contributions were quantized at enqueue, so
+    viewing them at f32 drops no information either."""
     world = len(arrs)
     shape, dtype = arrs[0].shape, arrs[0].dtype
     flats = [np.ascontiguousarray(a).ravel() for a in arrs]
     n = flats[0].size
     bounds = _chunk_bounds(n, world)
     out = np.empty(n, dtype=dtype)
+    if wire_dtype is not None:
+        acc_dt = np.dtype(np.float32)
+        flats = [f if f.dtype == acc_dt else f.astype(acc_dt) for f in flats]
     for c in range(world):
         lo, hi = bounds[c], bounds[c + 1]
         start = (c + 1) % world
-        acc = _acc_start(flats[start][lo:hi])
+        if wire_dtype is None:
+            acc = _acc_start(flats[start][lo:hi])
+        else:
+            acc = flats[start][lo:hi]
         for k in range(1, world):
+            if wire_dtype is not None:
+                # The hop: the sender rounds the partial to the wire dtype,
+                # the receiver upcasts to accumulator width before adding.
+                acc = acc.astype(wire_dtype).astype(acc_dt)
             acc = acc + flats[(start + k) % world][lo:hi]
-        out[lo:hi] = _acc_finish(acc, average, world, dtype)
+        fin = _acc_finish(acc, average, world, dtype)
+        if wire_dtype is not None:
+            fin = fin.astype(wire_dtype).astype(dtype)
+        out[lo:hi] = fin
     return out.reshape(shape)
 
 
@@ -237,13 +263,17 @@ class _PeerRing:
 
     def __init__(self, rank: int, world: int, next_ch, prev_ch,
                  next_sock, prev_sock, listener,
-                 on_bytes=None) -> None:
+                 on_bytes=None, on_wire=None) -> None:
         self.rank = rank
         self.world = world
         self._next_ch = next_ch
         self._prev_ch = prev_ch
         self._socks = [next_sock, prev_sock, listener]
         self._on_bytes = on_bytes or (lambda n: None)
+        # on_wire(wire_bytes, saved_bytes): compression telemetry — called
+        # per compressed hop with the bytes actually sent and the bytes the
+        # uncompressed plane would have sent minus that.
+        self._on_wire = on_wire or (lambda w, s: None)
         self.bytes_sent = 0
         self._err: Optional[Exception] = None
         self._sendq: "queue_mod.Queue" = queue_mod.Queue()
@@ -255,7 +285,7 @@ class _PeerRing:
 
     @classmethod
     def establish(cls, client: "_Client", topo, key: bytes, enabled: bool,
-                  on_bytes=None, connect_timeout: float = 60.0):
+                  on_bytes=None, on_wire=None, connect_timeout: float = 60.0):
         """Negotiate and build the ring, or return None for the star.
 
         Every rank must reach the same verdict (a half-ring deadlocks), so
@@ -337,7 +367,8 @@ class _PeerRing:
                         except OSError:  # pragma: no cover - cap by sysctl
                             pass
                 ring = cls(rank, world, nch, accepted["ch"], nsock,
-                           accepted["sock"], listener, on_bytes=on_bytes)
+                           accepted["sock"], listener, on_bytes=on_bytes,
+                           on_wire=on_wire)
                 ok = True
         except Exception as e:  # noqa: BLE001
             log("warning",
@@ -376,7 +407,10 @@ class _PeerRing:
         if self._err is not None:
             raise ConnectionError(f"ring sender failed: {self._err}")
         arr = np.ascontiguousarray(arr)
-        self._sendq.put(arr)
+        # uint8 view (zero-copy): ml_dtypes wire dtypes (bfloat16) have no
+        # PEP-3118 buffer format, so memoryview(arr) inside send_bytes
+        # would raise; the byte view is dtype-agnostic and free.
+        self._sendq.put(arr.view(np.uint8))
         self.bytes_sent += int(arr.nbytes)
         self._on_bytes(int(arr.nbytes))
 
@@ -391,13 +425,23 @@ class _PeerRing:
         return np.frombuffer(buf, dtype=dtype) if count else \
             np.empty(0, dtype=dtype)
 
-    def allreduce(self, arr: np.ndarray, average: bool) -> np.ndarray:
+    def allreduce(self, arr: np.ndarray, average: bool,
+                  wire_dtype=None) -> np.ndarray:
         """Ring allreduce, bitwise-identical to _ring_order_reduce.
 
-        Phase 1 (reduce-scatter): partial sums travel at accumulator width
-        (float64 for floating dtypes); after world-1 hops this rank owns
-        the finished sum of chunk ``rank``. Phase 2 (allgather): finished
+        Uncompressed (``wire_dtype=None``): phase-1 partial sums travel at
+        accumulator width (float64 for floating dtypes); after world-1 hops
+        this rank owns the finished sum of chunk ``rank``; phase-2 finished
         chunks circulate at native width.
+
+        Compressed (HOROVOD_COMPRESSION): every hop carries 2-byte
+        wire-dtype payloads — phase-1 partials are rounded to the wire
+        dtype per hop and upcast to accumulator width before each add
+        (cast-on-send, accumulate-in-fp64), and the finished chunk is
+        rounded once for the allgather so every rank (owner included)
+        stores the identical wire-representable value. The exact same
+        rounding sequence lives in ``_ring_order_reduce``, keeping star
+        and ring bitwise identical under compression too.
         """
         arr = np.ascontiguousarray(arr)
         world, rank = self.world, self.rank
@@ -405,32 +449,73 @@ class _PeerRing:
             return arr
         flat = arr.ravel()
         bounds = _chunk_bounds(flat.size, world)
-        wdt = _acc_start(flat[:0]).dtype  # accumulator/wire width, phase 1
+        acc_dt = _acc_start(flat[:0]).dtype  # uncompressed phase-1 width
+        if wire_dtype is not None:
+            # Compressed accumulate-in-fp32 (native ring.h parity; same
+            # rounding chain as the oracle): the enqueue-time quantization
+            # makes the f32 view of the contribution lossless, and f32
+            # casts/adds run at half the f64 path's CPU cost. The saved
+            # counter still compares against what the UNCOMPRESSED plane
+            # ships on this hop (acc_dt-width partials).
+            wire_acc = np.dtype(np.float32)
+            work = flat if flat.dtype == wire_acc else flat.astype(wire_acc)
+        else:
+            work = flat
 
         def chunk(c):
-            return flat[bounds[c]:bounds[c + 1]]
+            return work[bounds[c]:bounds[c + 1]]
 
         def csize(c):
             return bounds[c + 1] - bounds[c]
 
-        part = _acc_start(chunk((rank - 1) % world))
+        if wire_dtype is None:
+            part = _acc_start(chunk((rank - 1) % world))
+        else:
+            part = chunk((rank - 1) % world)
         for s in range(1, world):
-            self._send(part)
+            if wire_dtype is None:
+                self._send(part)
+            else:
+                w = part.astype(wire_dtype)
+                self._send(w)
+                self._on_wire(
+                    int(w.nbytes),
+                    int(w.size) * int(acc_dt.itemsize) - int(w.nbytes))
             c = (rank - s - 1) % world
-            part = self._recv(wdt, csize(c))
-            # In-place on the received buffer (np.frombuffer over the recv
-            # bytearray is writable): same IEEE results as `recv + chunk`,
-            # one allocation+copy less per hop.
-            part += chunk(c)
+            if wire_dtype is None:
+                part = self._recv(acc_dt, csize(c))
+                # In-place on the received buffer (np.frombuffer over the
+                # recv bytearray is writable): same IEEE results as
+                # `recv + chunk`, one allocation+copy less per hop.
+                part += chunk(c)
+            else:
+                part = self._recv(wire_dtype, csize(c)).astype(wire_acc)
+                part += chunk(c)
         mine = _acc_finish(part, average, world, arr.dtype)
         out = np.empty_like(flat)
-        out[bounds[rank]:bounds[rank + 1]] = mine
-        cur = mine
-        for s in range(1, world):
-            self._send(cur)
-            c = (rank - s) % world
-            cur = self._recv(arr.dtype, csize(c))
-            out[bounds[c]:bounds[c + 1]] = cur
+        if wire_dtype is None:
+            out[bounds[rank]:bounds[rank + 1]] = mine
+            cur = mine
+            for s in range(1, world):
+                self._send(cur)
+                c = (rank - s) % world
+                cur = self._recv(arr.dtype, csize(c))
+                out[bounds[c]:bounds[c + 1]] = cur
+        else:
+            cur_w = mine.astype(wire_dtype)
+            out[bounds[rank]:bounds[rank + 1]] = cur_w.astype(arr.dtype)
+            native_itemsize = arr.dtype.itemsize
+            for s in range(1, world):
+                self._send(cur_w)
+                self._on_wire(
+                    int(cur_w.nbytes),
+                    int(cur_w.size * native_itemsize - cur_w.nbytes))
+                c = (rank - s) % world
+                # Forward the wire bytes verbatim: re-rounding an already
+                # wire-representable chunk is the identity, so every rank
+                # stores the same upcast value.
+                cur_w = self._recv(wire_dtype, csize(c))
+                out[bounds[c]:bounds[c + 1]] = cur_w.astype(arr.dtype)
         return out.reshape(arr.shape)
 
     def close(self) -> None:
@@ -489,6 +574,17 @@ class PyEngine:
         cache_cap = int(getattr(config, "cache_capacity", 0) or 0)
         self._mirror: Optional[CacheMirror] = (
             CacheMirror() if cache_cap > 0 else None)
+        # On-the-wire compression (ISSUE 5, docs/compression.md): allreduce
+        # contributions are quantized ONCE at enqueue (cast to the wire
+        # dtype and back — the same value the wire will carry), the ring
+        # hops and the star channel move 2-byte payloads, and accumulation
+        # stays at the float64 _acc_start width. Error feedback keeps the
+        # local quantization residual and folds it into the NEXT submission
+        # of the same tensor name (Lin et al., Deep Gradient Compression).
+        self._compression = getattr(config, "compression", "none") or "none"
+        self._error_feedback = bool(
+            getattr(config, "compression_error_feedback", False))
+        self._residuals: dict[str, np.ndarray] = {}
         # Telemetry (ISSUE 2 + this PR's steady-state counters).
         self._metrics = _metrics_registry()
         self._m_hits = self._metrics.counter(
@@ -513,6 +609,14 @@ class PyEngine:
         self._m_ring = self._metrics.counter(
             "horovod_engine_data_bytes_total",
             help="tensor bytes moved by the eager data plane", plane="ring")
+        self._m_wire = self._metrics.counter(
+            "horovod_wire_bytes_total",
+            help="gradient payload bytes moved at the compressed wire dtype",
+            plane="eager")
+        self._m_wire_saved = self._metrics.counter(
+            "horovod_wire_bytes_saved_total",
+            help="bytes the compressed wire avoided sending vs the "
+                 "uncompressed plane", plane="eager")
         if topo.size > 1:
             addr = os.environ.get("HOROVOD_COORD_ADDR")
             if not addr:
@@ -541,7 +645,9 @@ class PyEngine:
                          and bool(getattr(config, "ring_data_plane", True)))
             self._ring = _PeerRing.establish(
                 self._client, topo, key, enabled=want_ring,
-                on_bytes=self._m_ring.inc)
+                on_bytes=self._m_ring.inc,
+                on_wire=lambda w, s: (self._m_wire.inc(w),
+                                      self._m_wire_saved.inc(s)))
         # Stall watchdog (ISSUE 2): keeps reporting even when the loop is
         # wedged inside a blocking exchange, names missing ranks on the
         # coordinator rank, and can escalate (HOROVOD_STALL_SHUTDOWN_TIME)
@@ -584,14 +690,34 @@ class PyEngine:
             # Auto-name by handle (reference GetOpName, mpi_ops_v2.cc:44-50):
             # handles increment identically across ranks when op order matches.
             name = f"{op}.noname.{handle}"
+        arr = np.asarray(array)
+        wire_np = (numpy_wire_dtype(self._compression, arr.dtype)
+                   if op == "allreduce" else None)
+        wire_arr = None
+        if wire_np is not None:
+            if self._error_feedback:
+                res = self._residuals.get(name)
+                if (res is not None and res.shape == arr.shape
+                        and res.dtype == arr.dtype):
+                    arr = arr + res
+            # Quantize the contribution once, here: both data planes then
+            # move/reduce the exact wire-representable value, which is what
+            # keeps star==ring and cold==cached bitwise under compression.
+            wire_arr = np.ascontiguousarray(arr).astype(wire_np)
+            deq = wire_arr.astype(arr.dtype)
+            if self._error_feedback:
+                self._residuals[name] = arr - deq
+            arr = deq
         entry = {
             "op": op,
-            "array": np.asarray(array),
+            "array": arr,
             "name": name,
             "root": root_rank,
             "average": average,
             "handle": handle,
             "t": time.monotonic(),
+            "wire": wire_np,
+            "wire_array": wire_arr,
         }
         with self._lock:
             if name in self._inflight:
@@ -644,6 +770,7 @@ class PyEngine:
         out = {
             "enabled": self._mirror is not None,
             "ring_active": self._ring is not None,
+            "compression": self._compression,
             # `is not None`, not truthiness: CacheMirror defines __len__,
             # so a freshly-flushed (empty) mirror is falsy.
             "mirror": (self._mirror.stats()
@@ -657,11 +784,15 @@ class PyEngine:
         """Drop every cached negotiation (elastic reset / membership change:
         a stale cached response must never be servable). Safe to call on any
         subset of ranks — the coordinator re-announces assignments with
-        every result delivery, so a flushed mirror self-heals."""
+        every result delivery, so a flushed mirror self-heals. Error-feedback
+        residuals drop too: they compensate THIS membership's quantization
+        stream, and carrying them across an elastic reset would fold a dead
+        world's error into the new one's first step."""
         if self._mirror is not None:
             self._mirror.flush()
         if self._coord is not None:
             self._coord.cache_flush()
+        self._residuals.clear()
 
     def shutdown(self) -> None:
         self._shutdown.set()
@@ -764,8 +895,14 @@ class PyEngine:
         self._finish(e, None, arr)
 
     def _entry_key(self, e: dict) -> tuple:
+        # The trailing element is the wire dtype ('' = uncompressed), so
+        # cache bits distinguish compressed from uncompressed negotiations
+        # and a wire-dtype change invalidates the stale bit like a shape
+        # change would (response_cache.request_key mirrors this).
+        wire = e.get("wire")
         return (e["name"], e["op"], tuple(e["array"].shape),
-                str(e["array"].dtype), e["root"], bool(e["average"]))
+                str(e["array"].dtype), e["root"], bool(e["average"]),
+                str(wire) if wire is not None else "")
 
     def _rides_ring(self, e: dict) -> bool:
         return self._ring is not None and e["op"] == "allreduce"
@@ -788,7 +925,16 @@ class PyEngine:
                 # whose bytes the coordinator already holds are
                 # metadata-only (otherwise every cycle spent waiting on a
                 # straggling PEER would re-ship this rank's full tensor).
-                arrays[e["name"]] = e["array"]
+                # Compressed allreduces ship the 2-byte wire cast — the
+                # coordinator upcasts losslessly (the contribution was
+                # quantized at enqueue, so the wire cast is exact).
+                if e.get("wire_array") is not None:
+                    arrays[e["name"]] = e["wire_array"]
+                    self._m_wire.inc(int(e["wire_array"].nbytes))
+                    self._m_wire_saved.inc(
+                        int(e["array"].nbytes - e["wire_array"].nbytes))
+                else:
+                    arrays[e["name"]] = e["array"]
             bit = None
             if self._mirror is not None:
                 key = self._entry_key(e)
@@ -800,12 +946,15 @@ class PyEngine:
             if bit is not None:
                 bits |= 1 << bit
             else:
-                requests.append({
+                req = {
                     "name": e["name"], "op": e["op"],
                     "shape": tuple(e["array"].shape),
                     "dtype": str(e["array"].dtype), "root": e["root"],
                     "average": e["average"],
-                })
+                }
+                if e.get("wire") is not None:
+                    req["wire"] = str(e["wire"])
+                requests.append(req)
                 self._m_full.inc()
         try:
             results = self._client.exchange(requests, arrays, bits=bits)
@@ -835,6 +984,16 @@ class PyEngine:
                 self._finish(e, TensorShapeMismatchError(err), None)
             elif isinstance(value, dict) and "__ring__" in value:
                 directives.append((value["seq"], e, value))
+            elif isinstance(value, dict) and "__wire__" in value:
+                # Compressed star result: the coordinator ships the reduced
+                # value at wire width (lossless — the canonical reduction
+                # ends with a wire-dtype rounding); upcast to the original.
+                w = value["__wire__"]
+                out_arr = w.astype(np.dtype(value["dtype"]))
+                self._m_star.inc(int(w.nbytes))
+                self._m_wire.inc(int(w.nbytes))
+                self._m_wire_saved.inc(int(out_arr.nbytes - w.nbytes))
+                self._finish(e, None, out_arr)
             else:
                 if isinstance(value, np.ndarray):
                     self._m_star.inc(int(value.nbytes))
@@ -847,7 +1006,8 @@ class PyEngine:
                 self._finish(e, HorovodInternalError(self._ring_error), None)
                 continue
             try:
-                out = self._ring.allreduce(e["array"], bool(d["average"]))
+                out = self._ring.allreduce(e["array"], bool(d["average"]),
+                                           wire_dtype=e.get("wire"))
             except Exception as exc:  # noqa: BLE001
                 # A broken ring has no resync point (peer streams may be
                 # mid-message): fail this and every later ring collective.
@@ -1206,6 +1366,10 @@ class _Coordinator:
             return f"Mismatched collective operations for tensor {name}"
         if any(r["dtype"] != reqs[0]["dtype"] for r in reqs):
             return f"Mismatched data types for tensor {name}"
+        if any(r.get("wire") != reqs[0].get("wire") for r in reqs):
+            # Divergent HOROVOD_COMPRESSION across ranks: half the world
+            # would ship 2-byte chunks the other half reads at full width.
+            return f"Mismatched wire compression for tensor {name}"
         if op in ("allreduce", "broadcast", "alltoall", "reducescatter") and any(
             r["shape"] != reqs[0]["shape"] for r in reqs
         ):
@@ -1237,6 +1401,20 @@ class _Coordinator:
             return (f"missing tensor bytes for star-plane {op} {name}", None)
         try:
             if op == "allreduce":
+                wire_name = reqs[0].get("wire")
+                if wire_name:
+                    # Contributions arrived at wire width (exact: they were
+                    # quantized at enqueue). Upcast, run the canonical
+                    # reduction with the wire's hop rounding, and hand the
+                    # result back at wire width — the final rounding makes
+                    # that lossless too.
+                    wire_np = numpy_dtype_by_name(wire_name)
+                    orig = np.dtype(reqs[0]["dtype"])
+                    full = [a.astype(orig) for a in arrs]
+                    red = _ring_order_reduce(full, reqs[0]["average"],
+                                             wire_dtype=wire_np)
+                    return (None, {"__wire__": red.astype(wire_np),
+                                   "dtype": str(orig)})
                 return (None, _ring_order_reduce(arrs, reqs[0]["average"]))
             if op == "allgather":
                 return (None, np.concatenate(arrs, axis=0))

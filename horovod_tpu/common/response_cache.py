@@ -52,9 +52,13 @@ def cache_capacity_from_env() -> int:
 
 
 def request_key(req: dict) -> tuple:
-    """Signature tuple for a request dict (engine wire shape)."""
+    """Signature tuple for a request dict (engine wire shape). The trailing
+    element is the wire-compression dtype ('' = uncompressed), so a cache
+    bit bound under one wire dtype invalidates when HOROVOD_COMPRESSION
+    changes — mirroring PyEngine._entry_key exactly."""
     return (req["name"], req["op"], tuple(req["shape"]), req["dtype"],
-            req.get("root", 0), bool(req.get("average", True)))
+            req.get("root", 0), bool(req.get("average", True)),
+            str(req.get("wire") or ""))
 
 
 class ResponseCache:
@@ -205,7 +209,8 @@ class CacheMirror:
                 self._key_to_bit.pop(key, None)
         for bit, key in assign or ():
             key = tuple(key)
-            key = (key[0], key[1], tuple(key[2]), key[3], key[4], bool(key[5]))
+            key = ((key[0], key[1], tuple(key[2]), key[3], key[4],
+                    bool(key[5])) + tuple(str(k) for k in key[6:]))
             old = self._key_to_bit.get(key)
             if old is not None:
                 self._bit_to_key.pop(old, None)

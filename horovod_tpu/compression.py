@@ -5,15 +5,79 @@ format (same exponent range as fp32; the MXU natively consumes it).
 
 Usage matches the reference: ``Compression.fp16.compress(t)`` returns
 ``(compressed, ctx)``; ``decompress(compressed, ctx)`` restores dtype.
+
+Since ISSUE 5 this module is also the single source of truth for the *wire
+dtype* every data plane uses:
+
+- the compiled plane (parallel/fusion.py) casts gradient buckets to the wire
+  dtype around each ``psum``;
+- the eager Python engine (common/engine.py) quantizes contributions and
+  ring hops to it;
+- the native C++ engine reads the same ``HOROVOD_COMPRESSION`` env knob
+  (cc/src/engine.cc) and casts at enqueue.
+
+The helpers here are deliberately importable WITHOUT jax (the eager engine
+and ``bench.py --eager-worker`` never import a backend): jax.numpy is only
+pulled in lazily by the Compressor classes, and the numpy-side wire-dtype
+resolution uses ml_dtypes for bfloat16.
 """
 
 from __future__ import annotations
 
-import jax.numpy as jnp
+from typing import Optional
+
+import numpy as np
+
+# HOROVOD_COMPRESSION values -> numpy dtype *name* of the wire format.
+WIRE_DTYPES = {"none": None, "fp16": "float16", "bf16": "bfloat16"}
+
+
+def normalize(name: Optional[str]) -> str:
+    """Normalize a HOROVOD_COMPRESSION value; unknown values mean 'none'
+    (callers warn — config parsing must never take the job down)."""
+    s = (name or "none").lower()
+    return s if s in WIRE_DTYPES else "none"
+
+
+def numpy_wire_dtype(compression: Optional[str],
+                     dtype) -> Optional[np.dtype]:
+    """The numpy dtype gradient bytes travel as, or None when compression
+    is a no-op for ``dtype`` (non-float input, already at/below wire width,
+    or compression 'none').
+
+    bfloat16 resolves through ml_dtypes (numpy has no native bf16); fp16 is
+    plain ``np.float16``. Only *wider* floats are compressed — casting an
+    f16 tensor to bf16 would lose mantissa for zero byte savings.
+    """
+    name = normalize(compression)
+    wire_name = WIRE_DTYPES[name]
+    if wire_name is None:
+        return None
+    dtype = np.dtype(dtype)
+    if dtype.kind != "f" or dtype.itemsize <= 2:
+        return None
+    if wire_name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(np.float16)
+
+
+def numpy_dtype_by_name(name: str) -> np.dtype:
+    """np.dtype from a wire-dtype name, routing 'bfloat16' through ml_dtypes
+    (``np.dtype('bfloat16')`` raises even with ml_dtypes imported)."""
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
 
 
 class Compressor:
     """Interface matching the reference's Compressor staticmethod pair."""
+
+    # HOROVOD_COMPRESSION spelling of this compressor ("none"/"fp16"/"bf16").
+    name = "none"
 
     @staticmethod
     def compress(tensor):
@@ -27,6 +91,8 @@ class Compressor:
 class NoneCompressor(Compressor):
     """Pass-through (reference NoneCompressor)."""
 
+    name = "none"
+
     @staticmethod
     def compress(tensor):
         return tensor, None
@@ -37,13 +103,22 @@ class NoneCompressor(Compressor):
 
 
 class _CastCompressor(Compressor):
-    wire_dtype: jnp.dtype = None
+    wire_dtype_name: str = ""
+
+    @classmethod
+    def _wire_dtype(cls):
+        import jax.numpy as jnp
+
+        return jnp.dtype(cls.wire_dtype_name)
 
     @classmethod
     def compress(cls, tensor):
+        import jax.numpy as jnp
+
         dtype = tensor.dtype
-        if jnp.issubdtype(dtype, jnp.floating) and dtype != cls.wire_dtype:
-            return tensor.astype(cls.wire_dtype), dtype
+        wire = cls._wire_dtype()
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != wire:
+            return tensor.astype(wire), dtype
         return tensor, None
 
     @classmethod
@@ -54,14 +129,16 @@ class _CastCompressor(Compressor):
 class FP16Compressor(_CastCompressor):
     """Cast float tensors to fp16 for the wire (reference FP16Compressor)."""
 
-    wire_dtype = jnp.float16
+    name = "fp16"
+    wire_dtype_name = "float16"
 
 
 class BF16Compressor(_CastCompressor):
     """Cast float tensors to bf16 — preferred on TPU: halves ICI/DCN bytes
     with fp32 exponent range, so no loss-scaling is needed."""
 
-    wire_dtype = jnp.bfloat16
+    name = "bf16"
+    wire_dtype_name = "bfloat16"
 
 
 class Compression:
@@ -71,3 +148,19 @@ class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+
+    @classmethod
+    def by_name(cls, name: Optional[str]) -> type[Compressor]:
+        """Resolve a HOROVOD_COMPRESSION value to its compressor class."""
+        return {"none": cls.none, "fp16": cls.fp16,
+                "bf16": cls.bf16}[normalize(name)]
+
+
+def compression_name(compression) -> str:
+    """Normalize a compression spec — a Compressor class, an instance, or a
+    HOROVOD_COMPRESSION string — to its canonical name."""
+    if compression is None:
+        return "none"
+    if isinstance(compression, str):
+        return normalize(compression)
+    return normalize(getattr(compression, "name", "none"))

@@ -63,42 +63,48 @@ def _resolved_num_buckets(num_buckets):
     return Config.from_env().num_buckets
 
 
+def _resolved_compression(compression):
+    """None -> the HOROVOD_COMPRESSION env knob (the same knob both eager
+    engines honor, common/config.py), so one env var flips the wire dtype
+    on every data plane; an explicit argument — including an explicit
+    ``Compression.none`` — wins."""
+    if compression is not None:
+        return compression
+    return Compression.by_name(Config.from_env().compression)
+
+
 def allreduce_gradients(
     grads,
     axis_name: str = HVD_AXIS,
     op: ReduceOp = ReduceOp.AVERAGE,
-    compression: type[Compressor] = Compression.none,
+    compression: type[Compressor] | None = None,
     fusion_threshold: int | None = None,
     hierarchical: bool = False,
     num_buckets: int | None = None,
+    compression_min_bytes: int | None = None,
 ):
     """Fused allreduce of a gradient pytree (the DistributedOptimizer hot
     path). ``fusion_threshold=None`` reads HOROVOD_FUSION_THRESHOLD (default
     64 MiB) so the env knob tunes the compiled path like the reference's;
     ``num_buckets=None`` reads HOROVOD_NUM_BUCKETS the same way (K > 1
     issues one collective per reverse-backward-order bucket so XLA can
-    overlap communication with the rest of the backward pass)."""
+    overlap communication with the rest of the backward pass);
+    ``compression=None`` reads HOROVOD_COMPRESSION (eligible buckets are
+    cast to the 16-bit wire dtype around their psum — half the wire bytes;
+    see docs/compression.md for the per-bucket opt-outs)."""
     fusion_threshold = _resolved_threshold(fusion_threshold)
     num_buckets = _resolved_num_buckets(num_buckets)
-    ctx_box = {}
-
-    def compress(buf):
-        out, ctx = compression.compress(buf)
-        ctx_box[id(buf)] = ctx
-        return out
-
-    def decompress(buf, orig_dtype):
-        return buf.astype(orig_dtype) if buf.dtype != orig_dtype else buf
+    compression = _resolved_compression(compression)
 
     return fusion.fused_allreduce(
         grads,
         axis_name=axis_name,
         threshold=fusion_threshold,
         op=op,
-        compress=compress if compression is not Compression.none else None,
-        decompress=decompress if compression is not Compression.none else None,
         hierarchical=hierarchical,
         num_buckets=num_buckets,
+        compression=compression,
+        compression_min_bytes=compression_min_bytes,
     )
 
 
@@ -106,11 +112,12 @@ def DistributedOptimizer(
     optimizer: optax.GradientTransformation,
     axis_name: str = HVD_AXIS,
     op: ReduceOp = ReduceOp.AVERAGE,
-    compression: type[Compressor] = Compression.none,
+    compression: type[Compressor] | None = None,
     fusion_threshold: int | None = None,
     hierarchical: bool = False,
     backward_passes_per_step: int = 1,
     num_buckets: int | None = None,
+    compression_min_bytes: int | None = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so that ``update()`` first averages gradients
     across the mesh axis, exactly where the reference wraps
@@ -127,6 +134,14 @@ def DistributedOptimizer(
     allreduce) and with ``hierarchical`` (each bucket rides the
     RS→psum→AG ladder independently). Autotuned jointly with
     ``fusion_threshold`` by ``bench.py --buckets-ab`` / jax.autotune.tune.
+
+    ``compression`` (or HOROVOD_COMPRESSION) = ``hvd.Compression.bf16`` /
+    ``fp16`` halves the bytes each bucket's collective moves: eligible
+    buckets are cast to the wire dtype before the psum and back after
+    (non-float and tiny buckets opt out per bucket). bf16 is the TPU pick —
+    fp32 exponent range, so no loss scaling. The wire dtype joins the
+    ``(fusion_threshold, num_buckets)`` joint autotune as a third dimension
+    (``bench.py --compression-ab``). Full story: docs/compression.md.
     """
 
     def update_fn(grads, state, params=None, **extra):
@@ -138,6 +153,7 @@ def DistributedOptimizer(
             fusion_threshold=fusion_threshold,
             hierarchical=hierarchical,
             num_buckets=num_buckets,
+            compression_min_bytes=compression_min_bytes,
         )
         return optimizer.update(reduced, state, params, **extra)
 
@@ -150,7 +166,7 @@ def DistributedOptimizer(
 def distributed_gradients(
     grads_or_fn,
     axis_name: str = HVD_AXIS,
-    compression: type[Compressor] = Compression.none,
+    compression: type[Compressor] | None = None,
     **kw,
 ):
     """DistributedGradientTape analog: either allreduce an existing grad

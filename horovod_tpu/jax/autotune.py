@@ -52,11 +52,15 @@ class Measurement:
     fusion_threshold: int
     steps_per_s: float
     num_buckets: int = 1
+    compression: str = "none"
 
     @property
     def config(self) -> dict:
-        return {**self.branch, "fusion_threshold": self.fusion_threshold,
-                "num_buckets": self.num_buckets}
+        out = {**self.branch, "fusion_threshold": self.fusion_threshold,
+               "num_buckets": self.num_buckets}
+        if self.compression != "none":
+            out["compression"] = self.compression
+        return out
 
 
 @dataclass
@@ -67,17 +71,22 @@ class TuneReport:
     def knob_curve(self) -> str:
         """Human-readable measured knob curve for docs/logs."""
         with_buckets = any(m.num_buckets != 1 for m in self.table)
-        head = "branch | fusion_threshold | steps/s"
+        with_comp = any(m.compression != "none" for m in self.table)
+        head = "branch | fusion_threshold | "
         if with_buckets:
-            head = "branch | fusion_threshold | num_buckets | steps/s"
-        lines = [head]
+            head += "num_buckets | "
+        if with_comp:
+            head += "compression | "
+        lines = [head + "steps/s"]
         for m in sorted(self.table,
                         key=lambda m: (str(m.branch), m.fusion_threshold,
-                                       m.num_buckets)):
+                                       m.num_buckets, m.compression)):
             b = ",".join(f"{k}={v}" for k, v in sorted(m.branch.items())) or "-"
             mid = f"{m.fusion_threshold >> 20} MiB | "
             if with_buckets:
                 mid += f"{m.num_buckets} | "
+            if with_comp:
+                mid += f"{m.compression} | "
             lines.append(f"{b} | {mid}{m.steps_per_s:.2f}")
         return "\n".join(lines)
 
@@ -205,6 +214,7 @@ def tune(step_factory: Callable[..., Callable[[], None]],
          thresholds: Sequence[int] = DEFAULT_THRESHOLDS,
          branches: Optional[Sequence[dict]] = None,
          num_buckets: Optional[Sequence[int]] = None,
+         compressions: Optional[Sequence[str]] = None,
          warmup: int = 2, iters: int = 5, reps: int = 3,
          gp_rounds: int = 2, log_path: Optional[str] = None,
          verbose: bool = False) -> TuneReport:
@@ -225,60 +235,83 @@ def tune(step_factory: Callable[..., Callable[[], None]],
     acquisition, autotuner.h). The factory is then called with an extra
     ``num_buckets=`` kwarg; when the argument is None (default) the factory
     signature and the log format stay exactly as before.
+
+    ``compressions``: a grid of HOROVOD_COMPRESSION names (e.g. ``("none",
+    "bf16")``) joins the joint autotune as a THIRD dimension (ISSUE 5). The
+    wire dtype is categorical, so it is explored exhaustively — the seed
+    grid covers the full (threshold × buckets × compression) cross product
+    and the continuous GP/EI refinement runs per compression value in the
+    (threshold, buckets) plane, exactly how the native ParameterManager
+    treats its hierarchical categoricals beside the numeric knobs. The
+    factory is then called with an extra ``compression=`` kwarg (a
+    HOROVOD_COMPRESSION name).
     """
     branches = list(branches) if branches is not None else [{}]
     tune_buckets = num_buckets is not None
     bucket_grid = tuple(num_buckets) if tune_buckets else (1,)
+    tune_comp = compressions is not None
+    comp_grid = tuple(compressions) if tune_comp else ("none",)
     table: list[Measurement] = []
     log_rows = []
 
-    def run(branch: dict, th: int, nb: int = 1) -> Measurement:
+    def run(branch: dict, th: int, nb: int = 1,
+            comp: str = "none") -> Measurement:
         kw = dict(branch)
         if tune_buckets:
             kw["num_buckets"] = nb
+        if tune_comp:
+            kw["compression"] = comp
         made = step_factory(fusion_threshold=th, **kw)
         step, sync = made if isinstance(made, tuple) else (made, None)
         rate = measure_steps_per_s(step, warmup, iters, reps, sync=sync)
-        m = Measurement(branch, th, rate, nb)
+        m = Measurement(branch, th, rate, nb, comp)
         table.append(m)
         token = ";".join(f"{k}={v}" for k, v in sorted(branch.items())) or "-"
+        row = [token, str(th)]
         if tune_buckets:
-            log_rows.append(f"{token},{th},{nb},{rate:.4f}")
-        else:
-            log_rows.append(f"{token},{th},{rate:.4f}")
+            row.append(str(nb))
+        if tune_comp:
+            row.append(comp)
+        log_rows.append(",".join(row + [f"{rate:.4f}"]))
         if verbose:
             import sys
 
             buckets_txt = f" buckets={nb}" if tune_buckets else ""
+            comp_txt = f" wire={comp}" if tune_comp else ""
             print(f"  autotune: {branch} threshold={th >> 20}MiB"
-                  f"{buckets_txt} -> {rate:.2f} steps/s",
+                  f"{buckets_txt}{comp_txt} -> {rate:.2f} steps/s",
                   file=sys.stderr, flush=True)
         return m
 
     for branch in branches:
-        measured: dict[tuple[int, int], float] = {}
-        for th in thresholds:
-            for nb in bucket_grid:
-                measured[(th, nb)] = run(branch, th, nb).steps_per_s
-        lo, hi = min(thresholds), max(thresholds)
-        for _ in range(gp_rounds):
-            if tune_buckets:
-                nxt = _ei_suggest_joint(
-                    measured, (lo, hi), (min(bucket_grid), max(bucket_grid)))
-            else:
-                flat = {th: v for (th, _), v in measured.items()}
-                th_next = _ei_suggest(flat, lo, hi)
-                nxt = (th_next, 1) if th_next is not None else None
-            if nxt is None or nxt in measured:
-                break
-            measured[nxt] = run(branch, *nxt).steps_per_s
+        for comp in comp_grid:
+            measured: dict[tuple[int, int], float] = {}
+            for th in thresholds:
+                for nb in bucket_grid:
+                    measured[(th, nb)] = run(branch, th, nb,
+                                             comp).steps_per_s
+            lo, hi = min(thresholds), max(thresholds)
+            for _ in range(gp_rounds):
+                if tune_buckets:
+                    nxt = _ei_suggest_joint(
+                        measured, (lo, hi),
+                        (min(bucket_grid), max(bucket_grid)))
+                else:
+                    flat = {th: v for (th, _), v in measured.items()}
+                    th_next = _ei_suggest(flat, lo, hi)
+                    nxt = (th_next, 1) if th_next is not None else None
+                if nxt is None or nxt in measured:
+                    break
+                measured[nxt] = run(branch, *nxt, comp).steps_per_s
 
     table.sort(key=lambda m: -m.steps_per_s)
     if log_path:
         with open(log_path, "w") as f:
+            cols = ["branch", "fusion_threshold"]
             if tune_buckets:
-                f.write("branch,fusion_threshold,num_buckets,steps_per_s\n")
-            else:
-                f.write("branch,fusion_threshold,steps_per_s\n")
+                cols.append("num_buckets")
+            if tune_comp:
+                cols.append("compression")
+            f.write(",".join(cols + ["steps_per_s"]) + "\n")
             f.write("\n".join(log_rows) + "\n")
     return TuneReport(best=table[0], table=table)

@@ -26,7 +26,13 @@ from __future__ import annotations
 
 from .aggregate import merge_snapshots  # noqa: F401
 from .exposition import MetricsServer, start_metrics_server  # noqa: F401
-from .overlap import last_plan, measure_overlap, record_plan  # noqa: F401
+from .overlap import (  # noqa: F401
+    last_plan,
+    last_wire_plan,
+    measure_overlap,
+    record_plan,
+    record_wire_plan,
+)
 from .registry import (  # noqa: F401
     Counter,
     Gauge,

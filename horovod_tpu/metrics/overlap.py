@@ -99,6 +99,50 @@ def last_plan() -> Optional[list]:
     return _last_plan
 
 
+# Latest wire-compression plan: (compression, [(orig_nbytes, compressed?,
+# wire_nbytes), ...]) in bucket-issue order (tests + snapshot annotations).
+_last_wire_plan: Optional[tuple] = None
+
+
+def record_wire_plan(compression: str, buckets: list) -> list:
+    """Record a fused_allreduce call's per-bucket wire-compression verdicts
+    (ISSUE 5). Runs at TRACE time, once per compile; the gauges describe the
+    PER-STEP wire cost of the latest compiled plan (counters would double
+    count across recompiles — the eager/native planes own the
+    ``horovod_wire_bytes_total`` counters, the compiled plane is static).
+
+    ``buckets``: [(orig_nbytes, compressed?, wire_nbytes), ...]."""
+    global _last_wire_plan
+    reg = registry()
+    wire_on = [(n, w) for n, c, w in buckets if c]
+    sent = sum(w for _, w in wire_on) + sum(
+        n for n, c, _ in buckets if not c)
+    saved = sum(n - w for n, w in wire_on)
+    reg.gauge(
+        "horovod_compiled_wire_bytes_per_step",
+        help="gradient bytes per step the latest compiled plan puts on the "
+             "wire (after per-bucket compression)").set(sent)
+    reg.gauge(
+        "horovod_compiled_wire_bytes_saved_per_step",
+        help="gradient bytes per step the wire dtype saves vs uncompressed "
+             "in the latest compiled plan").set(saved)
+    reg.gauge(
+        "horovod_compiled_wire_buckets",
+        help="buckets riding the compressed wire in the latest plan"
+    ).set(len(wire_on))
+    reg.set_info("wire_compression", {
+        "compression": compression, "buckets": len(buckets),
+        "compressed_buckets": len(wire_on)})
+    _last_wire_plan = (compression, list(buckets))
+    return buckets
+
+
+def last_wire_plan() -> Optional[tuple]:
+    """(compression, [(orig_nbytes, compressed?, wire_nbytes), ...]) of the
+    most recent fused_allreduce trace."""
+    return _last_wire_plan
+
+
 # --------------------------------------------------------------- trace parse
 
 

@@ -20,7 +20,7 @@ Differences by design:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -28,8 +28,10 @@ import numpy as np
 
 from . import collectives
 from .mesh import HVD_AXIS
-from ..common.config import DEFAULT_FUSION_THRESHOLD
+from ..common.config import (DEFAULT_COMPRESSION_MIN_BYTES,
+                             DEFAULT_FUSION_THRESHOLD, _env_int)
 from ..compat import axis_size
+from ..compression import compression_name, numpy_wire_dtype
 
 
 @dataclass(frozen=True)
@@ -195,6 +197,28 @@ def unfuse(buffers: Sequence, plan: FusionPlan):
     return jax.tree_util.tree_unflatten(plan.treedef, leaves)
 
 
+def wire_dtype_for_bucket(compression, dtype, nbytes: int, op,
+                          min_bytes: Optional[int] = None):
+    """Per-bucket wire-compression verdict for the compiled plane: the wire
+    dtype the bucket's collective should run at, or None to opt out.
+
+    Opt-outs (ISSUE 5): non-float buckets (casting ints corrupts), buckets
+    already at/below 2 bytes/element, buckets smaller than
+    HOROVOD_COMPRESSION_MIN_BYTES (the cast pair costs more than it saves,
+    and loss scalars keep full precision), and non-linear reductions
+    (PRODUCT rides an all-gather; MIN/MAX results are exact per element, so
+    they pass through uncompressed rather than silently losing bits)."""
+    if op not in (collectives.ReduceOp.SUM, collectives.ReduceOp.AVERAGE):
+        return None
+    if min_bytes is None:
+        min_bytes = _env_int("HOROVOD_COMPRESSION_MIN_BYTES",
+                             DEFAULT_COMPRESSION_MIN_BYTES)
+    if nbytes < min_bytes:
+        return None
+    wire = numpy_wire_dtype(compression_name(compression), dtype)
+    return jnp.dtype(wire) if wire is not None else None
+
+
 def fused_allreduce(
     tree,
     axis_name: str = HVD_AXIS,
@@ -206,10 +230,20 @@ def fused_allreduce(
     ici_axis: str = "ici",
     dcn_axis: str = "dcn",
     num_buckets: int = 1,
+    compression=None,
+    compression_min_bytes: Optional[int] = None,
 ):
     """The Horovod fast path: fuse → (compress) → one collective per bucket →
-    (decompress) → unfuse. ``compress``/``decompress`` are dtype casts from
-    horovod_tpu.compression (reference tensorflow/compression.py:FP16Compressor).
+    (decompress) → unfuse.
+
+    ``compression`` (a :class:`horovod_tpu.compression.Compressor`, a
+    HOROVOD_COMPRESSION name, or None) is the wire optimization: eligible
+    buckets are cast to the 16-bit wire dtype right before their collective
+    and cast back right after, halving the bytes every ``psum`` moves over
+    ICI/DCN (reference FP16Compressor semantics, applied per fused bucket
+    instead of per tensor). Eligibility is per bucket — see
+    :func:`wire_dtype_for_bucket`. The legacy ``compress``/``decompress``
+    callables are still honored for callers that pre-date the wire path.
 
     ``num_buckets > 1`` switches to the reverse-backward-order overlap plan
     (build_plan): K independent collectives, issued last-layer-first, each
@@ -238,13 +272,26 @@ def fused_allreduce(
     # bytes in issue order, buffer occupancy, planned overlap bound — in
     # the metrics registry. Runs at TRACE time (once per compile), so the
     # compiled hot path carries zero instrumentation cost.
-    from ..metrics import record_plan
+    from ..metrics import record_plan, record_wire_plan
 
     record_plan(plan, threshold)
     buffers = fuse(tree, plan)
     orig_dtypes = [buf.dtype for buf in buffers]
     if compress is not None:
         buffers = [compress(buf) for buf in buffers]
+    # Wire compression (ISSUE 5): per-bucket cast to the 16-bit wire dtype
+    # around the collective. Decided at trace time, so the hot path carries
+    # exactly one convert pair per eligible bucket and nothing else.
+    wire = [wire_dtype_for_bucket(compression, buf.dtype, int(buf.nbytes), op,
+                                  compression_min_bytes)
+            for buf in buffers]
+    record_wire_plan(
+        compression_name(compression),
+        [(int(b.nbytes), w is not None,
+          int(b.size) * (jnp.dtype(w).itemsize if w is not None else 0))
+         for b, w in zip(buffers, wire)])
+    buffers = [b.astype(w) if w is not None else b
+               for b, w in zip(buffers, wire)]
     if hierarchical:
         reduced = [
             collectives.hierarchical_allreduce(
@@ -254,6 +301,8 @@ def fused_allreduce(
         ]
     else:
         reduced = collectives.bucketed_allreduce(buffers, axis_name, op)
+    reduced = [r.astype(dt) if w is not None else r
+               for r, w, dt in zip(reduced, wire, orig_dtypes)]
     if decompress is not None:
         reduced = [decompress(r, dt) for r, dt in zip(reduced, orig_dtypes)]
     return unfuse(reduced, plan)

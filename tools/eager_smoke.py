@@ -11,9 +11,15 @@ re-submitted every step — and asserts the steady-state contract end to end:
 2. ring data plane: the peer ring is active and carries the tensor bytes —
    the coordinator relays exactly 0 tensor bytes for the allreduce path;
 3. correctness: every rank's reduced results are bitwise identical, and
-   equal to the star plane's for the same inputs (canonical chunk order).
+   equal to the star plane's for the same inputs (canonical chunk order);
+4. wire compression (ISSUE 5): a third world with HOROVOD_COMPRESSION=bf16
+   moves >= 2x fewer bytes per hop (horovod_wire_bytes_saved_total vs
+   horovod_wire_bytes_total), stays bitwise identical ACROSS ranks and
+   across planes (bf16 ring == bf16 star), and lands within 16-bit
+   tolerance of the analytic average — while the uncompressed worlds stay
+   exactly on the float64 reduction.
 
-Exits non-zero with a reason on any violation. Wall-clock budget: ~20 s.
+Exits non-zero with a reason on any violation. Wall-clock budget: ~30 s.
 """
 
 from __future__ import annotations
@@ -49,13 +55,20 @@ eng = PyEngine(Topology(rank, world, 0, 1, rank, world),
                Config(cycle_time_ms=1.0, stall_check_disable=True))
 try:
     digest = hashlib.sha256()
+    max_rel_err = 0.0
 
     def step(i):
+        global max_rel_err
         for t in range(tensors):
             out = eng.run("allreduce",
                           np.arange(512, dtype=np.float32) * (rank + 1) + i + t,
                           f"grad.{t}")
             digest.update(out.tobytes())
+            # Analytic truth: the rank-average of arange*(r+1)+i+t.
+            exp = (np.arange(512, dtype=np.float64) * (world + 1) / 2.0
+                   + i + t)
+            err = np.abs(out.astype(np.float64) - exp).max()
+            max_rel_err = max(max_rel_err, float(err / np.abs(exp).max()))
 
     for i in range(warmup):
         step(i)
@@ -73,6 +86,8 @@ try:
         "rank": rank,
         "hash": digest.hexdigest(),
         "ring_active": stats["ring_active"],
+        "compression": stats.get("compression", "none"),
+        "max_rel_err": max_rel_err,
         "window_hits": delta("horovod_engine_cache_hits_total"),
         "window_misses": delta("horovod_engine_cache_misses_total"),
         "window_full_requests": delta("horovod_engine_full_requests_total"),
@@ -80,6 +95,10 @@ try:
             'horovod_engine_data_bytes_total{plane="star"}', 0),
         "ring_bytes": snap1.get(
             'horovod_engine_data_bytes_total{plane="ring"}', 0),
+        "wire_bytes": snap1.get(
+            'horovod_wire_bytes_total{plane="eager"}', 0),
+        "wire_saved": snap1.get(
+            'horovod_wire_bytes_saved_total{plane="eager"}', 0),
     }), flush=True)
 finally:
     eng.shutdown()
@@ -99,7 +118,7 @@ def fail(msg: str) -> None:
     sys.exit(1)
 
 
-def run_world(ring: bool) -> list[dict]:
+def run_world(ring: bool, compression: str = "none") -> list[dict]:
     port = free_port()
     secret = secrets.token_hex(16)
     procs = []
@@ -112,6 +131,7 @@ def run_world(ring: bool) -> list[dict]:
             "HOROVOD_SECRET": secret,
             "HOROVOD_ENGINE": "python",
             "HOROVOD_RING_DATA_PLANE": "1" if ring else "0",
+            "HOROVOD_COMPRESSION": compression,
             "SMOKE_WARMUP": str(WARMUP_STEPS),
             "SMOKE_STEPS": str(STEPS),
             "SMOKE_TENSORS": str(TENSORS),
@@ -168,12 +188,42 @@ def main() -> int:
     if {r["hash"] for r in star} != {ring[0]["hash"]}:
         fail("star and ring planes disagree bitwise")
 
+    # 4. wire compression (ISSUE 5): >= 2x byte reduction, all ranks and
+    #    both planes bitwise identical under bf16, result within 16-bit
+    #    tolerance of the analytic average.
+    comp = run_world(ring=True, compression="bf16")
+    if len({r["hash"] for r in comp}) != 1:
+        fail("bf16 ring-plane results differ across ranks")
+    comp_star = run_world(ring=False, compression="bf16")
+    if {r["hash"] for r in comp_star} != {comp[0]["hash"]}:
+        fail("bf16 star and ring planes disagree bitwise")
+    if comp[0]["hash"] == ring[0]["hash"]:
+        fail("bf16 world produced the uncompressed hash (wire cast inert)")
+    for r in comp:
+        if r["wire_bytes"] <= 0:
+            fail(f"rank {r['rank']}: no compressed wire bytes counted")
+        reduction = (r["wire_bytes"] + r["wire_saved"]) / r["wire_bytes"]
+        if reduction < 2.0:
+            fail(f"rank {r['rank']}: wire byte reduction {reduction:.2f}x "
+                 "< 2x with bf16")
+        if r["max_rel_err"] > 0.02:
+            fail(f"rank {r['rank']}: bf16 result off by "
+                 f"{r['max_rel_err']:.3%} (> 2% tolerance)")
+    for r in ring + star:
+        if r["max_rel_err"] > 1e-6:
+            fail(f"rank {r['rank']}: UNCOMPRESSED result off by "
+                 f"{r['max_rel_err']} (compression=none must stay exact)")
+
     hits = sum(r["window_hits"] for r in ring)
     window = hits + sum(r["window_misses"] for r in ring)
+    reduction = (comp[0]["wire_bytes"] + comp[0]["wire_saved"]) \
+        / comp[0]["wire_bytes"]
     print(f"eager smoke OK: hit rate {hits}/{window} "
           f"({hits / window:.1%}), ring bytes/rank "
           f"{ring[0]['ring_bytes']:.0f}, star relay bytes 0, "
-          "star==ring bitwise")
+          f"star==ring bitwise; bf16 wire {reduction:.1f}x fewer bytes, "
+          f"max rel err {max(r['max_rel_err'] for r in comp):.2%}, "
+          "bf16 star==ring bitwise")
     return 0
 
 
