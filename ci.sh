@@ -38,7 +38,16 @@ PY
 
 echo "== bench smoke (tiny model, hard timeout: a hang fails fast, not rc=124 at the harness) =="
 HVD_BENCH_SMOKE=1 timeout -k 10 240 env JAX_PLATFORMS=cpu \
-  python bench.py --buckets-ab
+  python bench.py --buckets-ab | tee /tmp/hvd_bench_smoke.log
+
+echo "== perf gate (ISSUE 6: structured bench output vs BASELINE/history; then live-fire — a synthetic 20% regression of today's own numbers must FAIL the gate) =="
+python tools/perf_gate.py --current /tmp/hvd_bench_smoke.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric buckets_ab_images_per_sec --allow-missing-baseline
+python tools/perf_gate.py --current /tmp/hvd_bench_smoke.log --self-check
+
+echo "== trace smoke (2-proc with injected straggler: merged clock-aligned Perfetto trace, one trace ID across ranks, critical-path analyzer names rank+phase with >=80% attribution; perf-gate pass/fail fixtures) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/trace_smoke.py
 
 echo "== eager smoke (4-proc Python engine: steady-state cache hit rate >= 95%, ring data plane carrying the bytes, star==ring bitwise; bf16 wire >= 2x fewer bytes within tolerance) =="
 timeout -k 10 240 python tools/eager_smoke.py

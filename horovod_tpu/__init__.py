@@ -52,6 +52,7 @@ from .parallel.mesh import (  # noqa: F401
 from . import jax  # noqa: F401  (JAX is the required core framework)
 from . import metrics  # noqa: F401  (telemetry registry + stall watchdog)
 from . import elastic  # noqa: F401  (fault-tolerant re-scaling, ISSUE 3)
+from . import tracing  # noqa: F401  (hvd.tracing: pod-wide distributed tracing)
 from .utils import timeline  # noqa: F401  (hvd.timeline.trace two-pane profile)
 
 

@@ -93,6 +93,10 @@ def _load():
     lib.hvd_timeline_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.hvd_timeline_stop.restype = None
     lib.hvd_timeline_stop.argtypes = []
+    lib.hvd_trace_enabled.restype = ctypes.c_int
+    lib.hvd_trace_enabled.argtypes = []
+    lib.hvd_trace_drain.restype = ctypes.c_longlong
+    lib.hvd_trace_drain.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
     lib.hvd_shutdown.restype = None
     lib.hvd_enqueue.restype = ctypes.c_longlong
     lib.hvd_enqueue.argtypes = [
@@ -152,6 +156,10 @@ class NativeEngine:
         # Config(compression=...) behaves like every other field.
         os.environ["HOROVOD_COMPRESSION"] = str(
             getattr(config, "compression", "none") or "none")
+        # Distributed tracing (ISSUE 6): same env crossing as the knobs
+        # above (the C++ engine reads HOROVOD_TRACE_DIR at construction).
+        trace_dir = getattr(config, "trace_dir", "") or ""
+        os.environ["HOROVOD_TRACE_DIR"] = trace_dir
         err = ctypes.create_string_buffer(1024)
         timeline = config.timeline if topo.rank == 0 else ""
         pinned = getattr(config, "pinned", set())
@@ -192,6 +200,16 @@ class NativeEngine:
         # counters (horovod_native_*) remain the background-thread view —
         # this layer measures the caller-visible enqueue->synchronize time.
         self._pending: dict[int, tuple] = {}
+        # Distributed tracing: this rank's span recorder; the C++ core's
+        # spans (hvd_trace_drain) are appended through it so ONE writer owns
+        # the file. Drained on every metrics collection and at shutdown.
+        self._trace = None
+        self._trace_buf = None
+        if trace_dir:
+            from ..tracing import init_recorder
+
+            self._trace = init_recorder(trace_dir, topo.rank)
+            self._trace_buf = ctypes.create_string_buffer(1 << 20)
 
     def enqueue(self, op: str, array: np.ndarray, name: Optional[str] = None,
                 root_rank: int = 0, average: bool = True) -> int:
@@ -334,7 +352,34 @@ class NativeEngine:
         mirror self-heals from the coordinator's re-announcements."""
         self._lib.hvd_cache_flush()
 
+    def trace_drain(self) -> int:
+        """Move pending native span records into this rank's span file;
+        returns the number of drained lines. Safe no-op when tracing is off
+        or the engine is gone."""
+        if self._trace is None:
+            return 0
+        import json as _json
+
+        total = 0
+        while True:
+            n = int(self._lib.hvd_trace_drain(self._trace_buf,
+                                              len(self._trace_buf)))
+            if n <= 0:
+                break
+            for line in self._trace_buf.raw[:n].decode(
+                    errors="replace").splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    self._trace.emit_raw(_json.loads(line))
+                    total += 1
+                except ValueError:  # torn line: shed, never raise
+                    continue
+        return total
+
     def _collect_metrics(self, reg) -> None:
+        self.trace_drain()
         vals = self.metrics()
         if all(v < 0 for v in vals.values()):
             return  # engine already shut down
@@ -394,4 +439,7 @@ class NativeEngine:
         from ..metrics import registry as _metrics_registry
 
         _metrics_registry().unregister_collector(self._collect_metrics)
+        self.trace_drain()  # final spans, while the engine still answers
         self._lib.hvd_shutdown()
+        if self._trace is not None:
+            self._trace.flush()

@@ -285,6 +285,25 @@ void hvd_cache_flush() {
   if (eng) eng->cache_flush();
 }
 
+// ---- distributed tracing (ISSUE 6: spans drained into the rank's file) ----
+
+// 1 when HOROVOD_TRACE_DIR was set at engine construction, 0/-1 otherwise.
+int hvd_trace_enabled() {
+  auto eng = engine();
+  return eng ? (eng->trace_enabled() ? 1 : 0) : -1;
+}
+
+// Drain pending span records as newline-separated JSON objects (the span
+// schema of horovod_tpu/tracing/recorder.py) into buf. Returns bytes
+// written (0 = none pending, -1 = no engine); whole lines only, so a short
+// buffer just means "call again". The Python binding appends them to this
+// rank's spans-rank<k>.jsonl.
+long long hvd_trace_drain(char* buf, long long cap) {
+  auto eng = engine();
+  if (!eng) return -1;
+  return eng->trace_drain(buf, cap);
+}
+
 // Latest stall-warning text (empty when none). Returns the full text
 // length, so a short buffer is detectable; fills up to cap-1 bytes.
 int hvd_last_stall(char* buf, int cap) {

@@ -127,11 +127,88 @@ static void cast_from_wire(DataType wire, DataType to, const void* src,
 // widened whole buffers to f32 first, doubling DRAM and wire traffic for
 // exactly the dtypes a TPU shop uses (VERDICT r2 weak #3).
 
+// ------------------------------------------------------- distributed tracing
+// (ISSUE 6) Span records in the SAME JSON-lines schema the Python recorder
+// writes (tracing/recorder.py): the binding drains them via hvd_trace_drain
+// into the rank's span file. Timestamps are steady_clock ns — on Linux the
+// same CLOCK_MONOTONIC Python's time.monotonic_ns() reads, so spans from
+// both layers of one process share an axis with no conversion.
+
+uint64_t Engine::now_ns() {
+  return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string Engine::trace_tid(const Request& req) const {
+  return req.name + "#" + std::to_string(req.trace_seq);
+}
+
+static void json_escape_into(std::string& out, const std::string& s) {
+  for (char c : s) {
+    if (c == '"' || c == '\\') {
+      out.push_back('\\');
+      out.push_back(c);
+    } else if ((unsigned char)c < 0x20) {
+      out += "\\u0020";  // control bytes in tensor names: blank them
+    } else {
+      out.push_back(c);
+    }
+  }
+}
+
+void Engine::trace_span(const std::string& tid, const std::string& name,
+                        OpType op, const char* phase, uint64_t t0_ns,
+                        uint64_t t1_ns, uint64_t bytes) {
+  if (!trace_enabled_) return;
+  std::string line = "{\"tid\": \"";
+  json_escape_into(line, tid);
+  line += "\", \"rank\": " + std::to_string(topo_.rank);
+  line += ", \"name\": \"";
+  json_escape_into(line, name);
+  line += "\", \"op\": \"";
+  line += op_name(op);
+  line += "\", \"phase\": \"";
+  line += phase;
+  line += "\", \"t0\": " + std::to_string(t0_ns);
+  line += ", \"t1\": " + std::to_string(t1_ns);
+  if (bytes) line += ", \"bytes\": " + std::to_string(bytes);
+  line += ", \"engine\": \"native\"}";
+  std::lock_guard<std::mutex> g(trace_mu_);
+  // Bounded: a job that never drains (tracing enabled but no Python
+  // binding polling) must not grow without limit.
+  if (trace_q_.size() >= (1u << 16)) {
+    trace_dropped_++;
+    return;
+  }
+  trace_q_.push_back(std::move(line));
+}
+
+long long Engine::trace_drain(char* buf, long long cap) {
+  if (!buf || cap <= 1) return 0;
+  long long off = 0;
+  std::lock_guard<std::mutex> g(trace_mu_);
+  while (!trace_q_.empty()) {
+    const std::string& line = trace_q_.front();
+    if (off + (long long)line.size() + 2 > cap) break;
+    std::memcpy(buf + off, line.data(), line.size());
+    off += (long long)line.size();
+    buf[off++] = '\n';
+    trace_q_.pop_front();
+  }
+  buf[off] = '\0';
+  return off;
+}
+
 Engine::Engine(const Topology& topo, const EngineConfig& cfg)
     : topo_(topo), cfg_(cfg) {
   cycle_time_ms_ = cfg_.cycle_time_ms;
   fusion_threshold_ = (int64_t)cfg_.fusion_threshold;
   wire_dtype_ = wire_dtype_from_env();
+  {
+    const char* td = std::getenv("HOROVOD_TRACE_DIR");
+    trace_enabled_ = td && *td;
+  }
   if (!cfg_.timeline_path.empty() && topo_.rank == 0) {
     timeline_.init(cfg_.timeline_path, cfg_.timeline_mark_cycles);
   }
@@ -307,6 +384,15 @@ int64_t Engine::enqueue(OpType op, const std::string& name, DataType dtype,
       throw std::runtime_error(
           "Duplicate tensor name " + e.req.name +
           "; a name may only be used once until its collective completes");
+    }
+    if (trace_enabled_) {
+      // Trace ID at first enqueue: the k-th submission of this name —
+      // the deterministic counter every rank (and the Python engine)
+      // derives identically; trace_seq rides the wire for verification.
+      e.req.trace_seq = ++trace_seq_[e.req.name];
+      uint64_t t = now_ns();
+      trace_span(trace_tid(e.req), e.req.name, op, "enqueue", t, t,
+                 (uint64_t)e.data.size());
     }
     if (timeline_.healthy())
       timeline_.negotiate_start(e.req.name, op_name(op));
@@ -643,6 +729,10 @@ void Engine::complete_local(Entry& e) {
   res.data = std::move(e.data);
   if (timeline_.healthy()) timeline_.end(e.req.name);
   finish(e, Status::OK_(), std::move(res));
+  if (trace_enabled_) {
+    uint64_t t = now_ns();
+    trace_span(trace_tid(e.req), e.req.name, e.req.op, "done", t, t, 0);
+  }
 }
 
 void Engine::execute_list(const ResponseList& list) {
@@ -670,9 +760,20 @@ void Engine::execute_entry(const ResponseEntry& re) {
   }
   if (ents.empty()) return;
   auto exec_start = std::chrono::steady_clock::now();
+  uint64_t exec_start_ns = now_ns();
   for (auto& e : ents) {
     metrics_.negotiation_us += (uint64_t)std::chrono::duration_cast<
         std::chrono::microseconds>(exec_start - e.enqueued).count();
+    if (trace_enabled_ && re.kind != ResponseEntry::ERROR) {
+      // Negotiate span: enqueue -> execution directive. Finer wire/reduce
+      // splits live in the Python engine; here the execution span below
+      // covers the whole ring pass, which is the attribution the
+      // analyzer needs from the native plane.
+      uint64_t enq_ns = (uint64_t)std::chrono::duration_cast<
+          std::chrono::nanoseconds>(e.enqueued.time_since_epoch()).count();
+      trace_span(trace_tid(e.req), e.req.name, e.req.op, "negotiate",
+                 enq_ns, exec_start_ns, 0);
+    }
   }
   // Once a ring transport error happened, the peer byte streams may be
   // mid-message (ring.h carries no per-chunk framing by design): executing
@@ -715,6 +816,17 @@ void Engine::execute_entry(const ResponseEntry& re) {
   }
   if (timeline_.healthy()) {
     for (auto& e : ents) timeline_.end(e.req.name);
+  }
+  if (trace_enabled_ && re.kind != ResponseEntry::ERROR) {
+    // The entries were finish()ed above but remain valid in `ents` (only
+    // their data/result bytes moved): wire span = the ring execution,
+    // done point = completion, both keyed by the shared trace ID.
+    uint64_t t = now_ns();
+    for (auto& e : ents) {
+      trace_span(trace_tid(e.req), e.req.name, e.req.op, "wire",
+                 exec_start_ns, t, (uint64_t)e.req.nbytes());
+      trace_span(trace_tid(e.req), e.req.name, e.req.op, "done", t, t, 0);
+    }
   }
   metrics_.execution_us += (uint64_t)std::chrono::duration_cast<
       std::chrono::microseconds>(std::chrono::steady_clock::now() -

@@ -246,6 +246,17 @@ class Engine {
     return last_stall_;
   }
 
+  // ---- distributed tracing (ISSUE 6, docs/tracing.md) ----
+  // Span records accumulate as pre-formatted JSON lines (the same schema
+  // the Python recorder writes) in a bounded queue; the Python binding
+  // drains them through hvd_trace_drain into this rank's span file, so ONE
+  // writer owns the file whichever engine produced the span. Enabled by
+  // HOROVOD_TRACE_DIR (read once at construction, like the wire dtype).
+  bool trace_enabled() const { return trace_enabled_; }
+  // Copy up to cap-1 bytes of whole drained lines into buf (NUL-
+  // terminated); returns bytes written (0 = nothing pending).
+  long long trace_drain(char* buf, long long cap);
+
  private:
   struct Entry {
     Request req;
@@ -277,6 +288,18 @@ class Engine {
   void execute_alltoall(const ResponseEntry& re, Entry& ent);
   void finish(Entry& e, Status st, Response res);  // mark done + release name
   void fail_everything(const std::string& reason);
+
+  // Tracing internals: record one span (JSON line) under the bounded cap.
+  static uint64_t now_ns();
+  std::string trace_tid(const Request& req) const;
+  void trace_span(const std::string& tid, const std::string& name,
+                  OpType op, const char* phase, uint64_t t0_ns,
+                  uint64_t t1_ns, uint64_t bytes);
+  bool trace_enabled_ = false;
+  std::mutex trace_mu_;
+  std::deque<std::string> trace_q_;           // pending JSON lines
+  uint64_t trace_dropped_ = 0;                // shed past the cap
+  std::unordered_map<std::string, uint32_t> trace_seq_;  // loop/enqueue under qmu_
 
   // Non-empty after a ring transport failure: the peer streams may be
   // desynced (no per-chunk framing), so every later collective fails fast

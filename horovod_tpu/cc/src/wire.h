@@ -91,6 +91,14 @@ struct Request {
   std::string name;
   int32_t root_rank = 0;
   uint8_t average = 1;
+  // Distributed-tracing tag (ISSUE 6): the per-name submission counter the
+  // enqueueing rank derived this collective's trace ID ("<name>#<seq>")
+  // from. Deterministic and identical on every rank (a name is in flight
+  // at most once, and every rank submits it the same number of times), so
+  // cached ticks need no tag — this field lets the coordinator VERIFY the
+  // cross-rank agreement on full requests. Not part of the cache signature
+  // (cache.h cache_key): it changes per submission by construction.
+  uint32_t trace_seq = 0;
   std::vector<int64_t> shape;
 
   size_t elements() const {
@@ -109,6 +117,7 @@ struct Request {
     w.str(name);
     w.i32(root_rank);
     w.u8(average);
+    w.u32(trace_seq);
     w.u8((uint8_t)shape.size());
     for (auto d : shape) w.i64(d);
   }
@@ -121,6 +130,7 @@ struct Request {
     q.name = r.str();
     q.root_rank = r.i32();
     q.average = r.u8();
+    q.trace_seq = r.u32();
     uint8_t nd = r.u8();
     q.shape.resize(nd);
     for (int i = 0; i < nd; i++) q.shape[i] = r.i64();

@@ -234,6 +234,14 @@ def shutdown() -> None:
             except Exception as e:  # pragma: no cover
                 log("warning", f"engine shutdown failed: {e}")
             _state.engine = None
+        # Close this process's trace recorder (the engines only flush: the
+        # recorder outlives elastic engine rebuilds, but not the session).
+        try:
+            from ..tracing import close_recorder
+
+            close_recorder()
+        except Exception:  # pragma: no cover - tracing never blocks teardown
+            pass
         _state.mesh = None
         _state.topology = None
         _state.config = None

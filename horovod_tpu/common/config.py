@@ -188,6 +188,12 @@ class Config:
     compression_min_bytes: int = field(                   # HOROVOD_COMPRESSION_MIN_BYTES
         default_factory=lambda: max(0, _env_int(
             "HOROVOD_COMPRESSION_MIN_BYTES", DEFAULT_COMPRESSION_MIN_BYTES)))
+    # Distributed tracing (ISSUE 6, docs/tracing.md): non-empty directory
+    # enables per-rank span capture on every data plane. Env-aware default
+    # like compression above: workers constructed with Config(...) directly
+    # must still honor the launcher-exported HOROVOD_TRACE_DIR.
+    trace_dir: str = field(                               # HOROVOD_TRACE_DIR
+        default_factory=lambda: os.environ.get("HOROVOD_TRACE_DIR", ""))
     log_level: str = "warning"                            # HOROVOD_LOG_LEVEL
     log_hide_time: bool = False                           # HOROVOD_LOG_HIDE_TIME
     # Which env vars were explicitly pinned (autotuner must not override,

@@ -62,6 +62,10 @@ from ..compression import numpy_dtype_by_name, numpy_wire_dtype
 from .topology import Topology
 from ..metrics import StallInfo, StallWatchdog, registry as _metrics_registry
 from ..metrics.registry import DEFAULT_BYTE_BUCKETS
+from ..tracing import get_recorder as _trace_recorder
+from ..tracing import init_recorder as _trace_init
+from ..tracing import trace_id as _trace_id
+from ..tracing.clock import estimate_offset_ns as _estimate_offset_ns
 from ..utils.logging import log
 
 
@@ -263,7 +267,7 @@ class _PeerRing:
 
     def __init__(self, rank: int, world: int, next_ch, prev_ch,
                  next_sock, prev_sock, listener,
-                 on_bytes=None, on_wire=None) -> None:
+                 on_bytes=None, on_wire=None, tracer=None) -> None:
         self.rank = rank
         self.world = world
         self._next_ch = next_ch
@@ -274,6 +278,22 @@ class _PeerRing:
         # per compressed hop with the bytes actually sent and the bytes the
         # uncompressed plane would have sent minus that.
         self._on_wire = on_wire or (lambda w, s: None)
+        # Distributed tracing (ISSUE 6): `tracer` is the rank's span
+        # recorder; `trace_ctx` names the collective currently on the ring
+        # (set by the engine around each directive). The Channel io hooks
+        # time the hops at the socket layer — the send side runs on the
+        # sender thread, which is exactly the wire time, not queue time.
+        self._tracer = tracer
+        self.trace_ctx: Optional[dict] = None
+        if tracer is not None:
+            def _io(direction: str, nbytes: int, t0: int, t1: int) -> None:
+                ctx = self.trace_ctx
+                if ctx is not None:
+                    tracer.span(ctx["tid"], ctx["name"], "allreduce",
+                                "wire_send" if direction == "send"
+                                else "wire_recv", t0, t1, bytes=int(nbytes))
+            next_ch.io_hook = _io
+            prev_ch.io_hook = _io
         self.bytes_sent = 0
         self._err: Optional[Exception] = None
         self._sendq: "queue_mod.Queue" = queue_mod.Queue()
@@ -285,7 +305,8 @@ class _PeerRing:
 
     @classmethod
     def establish(cls, client: "_Client", topo, key: bytes, enabled: bool,
-                  on_bytes=None, on_wire=None, connect_timeout: float = 60.0):
+                  on_bytes=None, on_wire=None, tracer=None,
+                  connect_timeout: float = 60.0):
         """Negotiate and build the ring, or return None for the star.
 
         Every rank must reach the same verdict (a half-ring deadlocks), so
@@ -368,7 +389,7 @@ class _PeerRing:
                             pass
                 ring = cls(rank, world, nch, accepted["ch"], nsock,
                            accepted["sock"], listener, on_bytes=on_bytes,
-                           on_wire=on_wire)
+                           on_wire=on_wire, tracer=tracer)
                 ok = True
         except Exception as e:  # noqa: BLE001
             log("warning",
@@ -468,6 +489,11 @@ class _PeerRing:
         def csize(c):
             return bounds[c + 1] - bounds[c]
 
+        # Tracing: hop IO spans come from the Channel io hooks; the local
+        # reduction arithmetic is timed here so the analyzer can split wire
+        # time from reduce time per collective.
+        ctx = self.trace_ctx
+        trace = self._tracer if ctx is not None else None
         if wire_dtype is None:
             part = _acc_start(chunk((rank - 1) % world))
         else:
@@ -487,10 +513,15 @@ class _PeerRing:
                 # In-place on the received buffer (np.frombuffer over the
                 # recv bytearray is writable): same IEEE results as
                 # `recv + chunk`, one allocation+copy less per hop.
+                r0 = time.monotonic_ns() if trace else 0
                 part += chunk(c)
             else:
                 part = self._recv(wire_dtype, csize(c)).astype(wire_acc)
+                r0 = time.monotonic_ns() if trace else 0
                 part += chunk(c)
+            if trace:
+                trace.span(ctx["tid"], ctx["name"], "allreduce", "reduce",
+                           r0, time.monotonic_ns(), hop=s)
         mine = _acc_finish(part, average, world, arr.dtype)
         out = np.empty_like(flat)
         if wire_dtype is None:
@@ -585,6 +616,14 @@ class PyEngine:
         self._error_feedback = bool(
             getattr(config, "compression_error_feedback", False))
         self._residuals: dict[str, np.ndarray] = {}
+        # Distributed tracing (ISSUE 6, docs/tracing.md): per-rank span
+        # recorder + per-name submission counters — the counter makes the
+        # trace ID (<name>#<seq>) deterministic AND identical across ranks
+        # with zero wire bytes; the request `trace` field and ring-directive
+        # echo verify that agreement on the wire.
+        self._trace = _trace_init(
+            getattr(config, "trace_dir", "") or "", topo.rank)
+        self._trace_seq: dict[str, int] = {}
         # Telemetry (ISSUE 2 + this PR's steady-state counters).
         self._metrics = _metrics_registry()
         self._m_hits = self._metrics.counter(
@@ -643,11 +682,29 @@ class PyEngine:
             # confirm barriers and returns None when any rank fell back).
             want_ring = (topo.size > 2
                          and bool(getattr(config, "ring_data_plane", True)))
+            # Clock alignment for the trace (tracing/clock.py): estimate
+            # this rank's monotonic-clock offset to the coordinator over the
+            # control channel BEFORE any spans matter. Rank 0 IS the
+            # reference clock (offset 0). Never fatal: tracing degrades to
+            # per-host alignment if the probe fails.
+            if self._trace is not None and topo.rank != 0:
+                try:
+                    offset, err_ns = _estimate_offset_ns(
+                        self._client.clock_probe)
+                    self._trace.set_clock_offset(offset)
+                    log("debug",
+                        f"trace clock offset {offset} ns "
+                        f"(+/- {err_ns} ns) vs coordinator", rank=topo.rank)
+                except Exception as e:  # noqa: BLE001
+                    log("warning",
+                        f"trace clock probe failed ({e}); spans stay on "
+                        "the local clock", rank=topo.rank)
             self._ring = _PeerRing.establish(
                 self._client, topo, key, enabled=want_ring,
                 on_bytes=self._m_ring.inc,
                 on_wire=lambda w, s: (self._m_wire.inc(w),
-                                      self._m_wire_saved.inc(s)))
+                                      self._m_wire_saved.inc(s)),
+                tracer=self._trace)
         # Stall watchdog (ISSUE 2): keeps reporting even when the loop is
         # wedged inside a blocking exchange, names missing ranks on the
         # coordinator rank, and can escalate (HOROVOD_STALL_SHUTDOWN_TIME)
@@ -708,6 +765,16 @@ class PyEngine:
             if self._error_feedback:
                 self._residuals[name] = arr - deq
             arr = deq
+        tid = None
+        if self._trace is not None:
+            # Trace ID at first enqueue: the k-th submission of `name`. A
+            # name completes before it may be reused (duplicate-name guard
+            # below), and collective semantics mean every rank submits a
+            # name the same number of times — so this counter agrees across
+            # ranks without a handshake, cache ticks included.
+            seq = self._trace_seq.get(name, 0) + 1
+            self._trace_seq[name] = seq
+            tid = _trace_id(name, seq)
         entry = {
             "op": op,
             "array": arr,
@@ -718,6 +785,7 @@ class PyEngine:
             "t": time.monotonic(),
             "wire": wire_np,
             "wire_array": wire_arr,
+            "tid": tid,
         }
         with self._lock:
             if name in self._inflight:
@@ -734,8 +802,11 @@ class PyEngine:
         self._metrics.counter(
             "horovod_collectives_enqueued_total",
             help="collectives submitted to the eager engine", op=op).inc()
+        if tid is not None:
+            self._trace.point(tid, name, op, "enqueue",
+                              bytes=int(arr.nbytes))
         if self._timeline:
-            self._timeline.negotiate_start(name, op.upper())
+            self._timeline.negotiate_start(name, op.upper(), tid=tid)
         return handle
 
     def poll(self, handle: int) -> bool:
@@ -810,6 +881,11 @@ class PyEngine:
             self._coord.stop()
         if self._timeline:
             self._timeline.close()
+        if self._trace is not None:
+            # Flush, don't close: the process recorder is shared (a new
+            # engine after elastic reset re-points it; basics.shutdown owns
+            # the close) and the smoke harness reads the file right after.
+            self._trace.flush()
         # Fail outstanding callbacks (reference SHUT_DOWN_ERROR, operations.cc:263-268)
         with self._lock:
             for e in self._queue:
@@ -860,6 +936,12 @@ class PyEngine:
         with self._lock:
             self._inflight.discard(e["name"])
         op = e["op"]
+        if self._trace is not None and e.get("tid"):
+            # Central completion point = central trace point: every path
+            # (local, star, ring, error) lands here exactly once.
+            self._trace.point(e["tid"], e["name"], op, "done",
+                              ok=error is None,
+                              total_s=round(time.monotonic() - e["t"], 6))
         if error is None:
             self._metrics.counter(
                 "horovod_collectives_total",
@@ -890,7 +972,7 @@ class PyEngine:
         # the scatter of the whole array to the only rank.
         name, arr = e["name"], e["array"]
         if self._timeline:
-            self._timeline.start(name, e["op"].upper())
+            self._timeline.start(name, e["op"].upper(), tid=e.get("tid"))
             self._timeline.end(name)
         self._finish(e, None, arr)
 
@@ -943,6 +1025,7 @@ class PyEngine:
                     (self._m_hits if bit is not None else self._m_misses).inc()
                 else:
                     bit = self._mirror.peek(key)  # re-poll: no stats
+            e["cached"] = bit is not None
             if bit is not None:
                 bits |= 1 << bit
             else:
@@ -954,14 +1037,32 @@ class PyEngine:
                 }
                 if e.get("wire") is not None:
                     req["wire"] = str(e["wire"])
+                if e.get("tid") is not None:
+                    # Wire propagation of the trace ID (full requests only —
+                    # cached ticks carry no per-tensor fields by design; the
+                    # coordinator re-derives the ID from its own counter and
+                    # uses this tag to VERIFY cross-rank agreement).
+                    req["trace"] = e["tid"]
                 requests.append(req)
                 self._m_full.inc()
+        neg_t0 = (self._trace.now_ns() if self._trace is not None else 0)
         try:
             results = self._client.exchange(requests, arrays, bits=bits)
         except Exception as exc:
             for e in batch:
                 self._finish(e, HorovodInternalError(str(exc)), None)
             return
+        if self._trace is not None:
+            # One negotiate span per in-flight entry per tick: cached ticks
+            # classify as "cache", full-request ticks as "negotiation" in
+            # the critical-path analyzer. Re-polled entries accrue one span
+            # per tick, which is exactly the time they spent negotiating.
+            neg_t1 = self._trace.now_ns()
+            for e in batch:
+                if e.get("tid"):
+                    self._trace.span(
+                        e["tid"], e["name"], e["op"], "negotiate",
+                        neg_t0, neg_t1, cached=bool(e.get("cached")))
         self._m_exch.inc()
         data_bytes = sum(int(a.nbytes) for a in arrays.values())
         self._m_star.inc(data_bytes)
@@ -1005,6 +1106,16 @@ class PyEngine:
             if self._ring_error is not None:
                 self._finish(e, HorovodInternalError(self._ring_error), None)
                 continue
+            if self._trace is not None and e.get("tid"):
+                # Directive echo check: the coordinator's independently
+                # derived ID must match ours — a mismatch means the
+                # deterministic-counter contract broke somewhere.
+                echo = d.get("trace")
+                if echo is not None and echo != e["tid"]:
+                    log("warning",
+                        f"trace id mismatch for {e['name']}: local "
+                        f"{e['tid']} vs coordinator {echo}")
+                self._ring.trace_ctx = {"tid": e["tid"], "name": e["name"]}
             try:
                 out = self._ring.allreduce(e["array"], bool(d["average"]),
                                            wire_dtype=e.get("wire"))
@@ -1016,6 +1127,8 @@ class PyEngine:
                 self._finish(e, HorovodInternalError(self._ring_error), None)
             else:
                 self._finish(e, None, out)
+            finally:
+                self._ring.trace_ctx = None
 
     def _stall_source(self) -> list:
         """Watchdog view of this rank's in-flight queue (reference
@@ -1092,6 +1205,13 @@ class _Coordinator:
         self._ring_endpoints: dict[int, Optional[tuple[str, int]]] = {}
         self._ring_votes: dict[int, bool] = {}
         self._ring_seq = 0
+        # --- distributed tracing (ISSUE 6) ---
+        # The coordinator derives each collective's trace ID from its OWN
+        # per-name execution counter — the same deterministic sequence the
+        # ranks use at enqueue — so cached (bitvector) ticks need no trace
+        # bytes on the wire; full requests carry a `trace` tag that this
+        # side checks against the derivation.
+        self._trace_seq: dict[str, int] = {}
 
     def start(self) -> None:
         t = threading.Thread(target=self._accept_loop, name="hvd_coord_accept", daemon=True)
@@ -1134,6 +1254,12 @@ class _Coordinator:
                 elif kind == "ring_confirm":
                     _send_msg(conn, self._handle_ring_confirm(
                         msg["rank"], bool(msg["ok"])), self.key)
+                elif kind == "clock_probe":
+                    # Trace clock alignment (tracing/clock.py): answer with
+                    # this process's monotonic reading, nothing else — the
+                    # caller brackets the round trip and estimates its
+                    # offset to this (the reference) clock.
+                    _send_msg(conn, {"t": time.monotonic_ns()}, self.key)
                 elif kind == "bye":
                     return
         except (ConnectionError, EOFError, OSError):
@@ -1382,23 +1508,50 @@ class _Coordinator:
             return f"Mismatched root ranks for broadcast {name}"
         return None
 
+    def _trace_tid(self, name: str, reqs: list[dict]) -> Optional[str]:
+        """Trace ID for this execution: the coordinator's own per-name
+        counter, cross-checked against any `trace` tags the full requests
+        carried (cached ticks carry none — the derivation covers them)."""
+        rec = _trace_recorder()
+        if rec is None:
+            return None
+        seq = self._trace_seq.get(name, 0) + 1
+        self._trace_seq[name] = seq
+        tid = _trace_id(name, seq)
+        tagged = {r.get("trace") for r in reqs if r.get("trace")}
+        if tagged and (len(tagged) > 1 or tid not in tagged):
+            log("warning",
+                f"coordinator trace-id disagreement for {name}: derived "
+                f"{tid}, requests carried {sorted(tagged)}")
+            # The ranks' view wins for span keying (they already emitted
+            # spans under it); agreement failures are surfaced, not fatal.
+            tid = sorted(tagged)[0]
+        return tid
+
     def _execute(self, name: str, contributions: dict[int, tuple[dict, Optional[np.ndarray]]]):
         reqs = [contributions[r][0] for r in sorted(contributions)]
         op = reqs[0]["op"]
         err = self._validate(name, reqs)
         if err is not None:
             return (err, None)
+        tid = self._trace_tid(name, reqs)
         if self.ring_active and op == "allreduce":
             # Ring directive: every rank executes this allreduce against its
             # neighbours, in the global order this seq defines. The
-            # coordinator never touches the bytes.
+            # coordinator never touches the bytes. The directive echoes the
+            # trace ID so every rank can verify the shared derivation.
             seq = self._ring_seq
             self._ring_seq += 1
-            return (None, {"__ring__": True, "seq": seq,
-                           "average": bool(reqs[0]["average"])})
+            out = {"__ring__": True, "seq": seq,
+                   "average": bool(reqs[0]["average"])}
+            if tid is not None:
+                out["trace"] = tid
+            return (None, out)
         arrs = [contributions[r][1] for r in sorted(contributions)]
         if any(a is None for a in arrs):  # pragma: no cover - engine bug guard
             return (f"missing tensor bytes for star-plane {op} {name}", None)
+        rec = _trace_recorder() if tid is not None else None
+        red_t0 = rec.now_ns() if rec is not None else 0
         try:
             if op == "allreduce":
                 wire_name = reqs[0].get("wire")
@@ -1413,9 +1566,19 @@ class _Coordinator:
                     full = [a.astype(orig) for a in arrs]
                     red = _ring_order_reduce(full, reqs[0]["average"],
                                              wire_dtype=wire_np)
+                    if rec is not None:
+                        rec.span(tid, name, op, "reduce", red_t0,
+                                 rec.now_ns(), plane="star")
                     return (None, {"__wire__": red.astype(wire_np),
                                    "dtype": str(orig)})
-                return (None, _ring_order_reduce(arrs, reqs[0]["average"]))
+                red = _ring_order_reduce(arrs, reqs[0]["average"])
+                if rec is not None:
+                    # Star-plane reduction runs HERE (rank 0's process):
+                    # record it under the shared trace ID so the merged
+                    # trace shows where the arithmetic happened.
+                    rec.span(tid, name, op, "reduce", red_t0, rec.now_ns(),
+                             plane="star")
+                return (None, red)
             if op == "allgather":
                 return (None, np.concatenate(arrs, axis=0))
             if op == "broadcast":
@@ -1483,6 +1646,14 @@ class _Client:
             _send_msg(self.sock, {"kind": "ring_confirm", "rank": self.rank,
                                   "ok": bool(ok)}, self.key)
             return bool(_recv_msg(self.sock, self.key).get("active"))
+
+    def clock_probe(self) -> int:
+        """One NTP-style round trip: the coordinator's monotonic_ns reading
+        (tracing clock alignment; the caller brackets this call)."""
+        with self._lock:
+            _send_msg(self.sock, {"kind": "clock_probe", "rank": self.rank},
+                      self.key)
+            return int(_recv_msg(self.sock, self.key)["t"])
 
     def exchange(self, requests: list[dict], arrays: dict,
                  bits: int = 0) -> dict:

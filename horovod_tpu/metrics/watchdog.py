@@ -158,7 +158,16 @@ class StallWatchdog:
         rep = StallReport(time_unix_s=time.time(), rank=self.rank,
                           text=format_report(stalled, self.check_time_s),
                           stalled=stalled)
-        self.reg.set_info("stall_report", rep.to_dict())
+        rep_d = rep.to_dict()
+        # Critical-path enrichment (ISSUE 6, tracing/critical_path.py): when
+        # a trace analysis has published an attribution, attach it — the
+        # report then says not just WHO is missing but WHERE the blocked
+        # time has been going (compute skew vs negotiation vs wire vs
+        # reduce) for the ranks that are present.
+        attribution = self.reg.get_info("straggler_attribution")
+        if attribution:
+            rep_d["straggler_attribution"] = attribution
+        self.reg.set_info("stall_report", rep_d)
         if self.shutdown_time_s > 0 and self.on_abort is not None:
             for s in stalled:
                 if s.age_s > self.shutdown_time_s and s.name not in self._aborted:

@@ -290,17 +290,28 @@ def fused_allreduce(
         [(int(b.nbytes), w is not None,
           int(b.size) * (jnp.dtype(w).itemsize if w is not None else 0))
          for b, w in zip(buffers, wire)])
+    # Distributed tracing (ISSUE 6): annotate the bucket plan into the trace
+    # directory at TRACE time (once per compile — the compiled hot path
+    # carries zero instrumentation), and name-scope the collectives so the
+    # device profile's HLO ops carry the same bucket identity the pod trace
+    # shows. No-ops when HOROVOD_TRACE_DIR is unset.
+    from ..tracing import record_compiled_plan
+
+    record_compiled_plan(
+        plan.num_buckets, [int(b.nbytes) for b in buffers],
+        compression_name(compression), [w is not None for w in wire])
     buffers = [b.astype(w) if w is not None else b
                for b, w in zip(buffers, wire)]
-    if hierarchical:
-        reduced = [
-            collectives.hierarchical_allreduce(
-                buf, ici_axis=ici_axis, dcn_axis=dcn_axis,
-                average=(op == collectives.ReduceOp.AVERAGE))
-            for buf in buffers
-        ]
-    else:
-        reduced = collectives.bucketed_allreduce(buffers, axis_name, op)
+    with jax.named_scope(f"hvd_fused_allreduce_k{len(buffers)}"):
+        if hierarchical:
+            reduced = [
+                collectives.hierarchical_allreduce(
+                    buf, ici_axis=ici_axis, dcn_axis=dcn_axis,
+                    average=(op == collectives.ReduceOp.AVERAGE))
+                for buf in buffers
+            ]
+        else:
+            reduced = collectives.bucketed_allreduce(buffers, axis_name, op)
     reduced = [r.astype(dt) if w is not None else r
                for r, w, dt in zip(reduced, wire, orig_dtypes)]
     if decompress is not None:

@@ -83,6 +83,13 @@ class Channel:
 
     def __init__(self, sock: socket.socket, key: bytes, server: bool) -> None:
         self.sock = sock
+        # Distributed-tracing IO hook (ISSUE 6): when set, every RAW frame's
+        # wire time is reported as io_hook(direction, nbytes, t0_ns, t1_ns)
+        # with direction in {"send", "recv"}. Measured HERE — around the
+        # actual socket syscalls — because the eager ring decouples send via
+        # a queue+thread, so caller-side timing would measure the queue, not
+        # the wire. None (the default) costs one attribute check per frame.
+        self.io_hook = None
         if server:
             nonce = _secrets.token_bytes(_NONCE_LEN)
             sock.sendall(_MAGIC + nonce)
@@ -153,10 +160,16 @@ class Channel:
         view = memoryview(data).cast("B")
         mac = self._mac(self._send_dir.lower(), self._send_seq, view)
         self._send_seq += 1
+        hook = self.io_hook
+        t0 = time.monotonic_ns() if hook else 0
         self.sock.sendall(mac + struct.pack("!Q", len(view)))
         self.sock.sendall(view)
+        if hook:
+            hook("send", len(view), t0, time.monotonic_ns())
 
     def recv_bytes(self) -> bytearray:
+        hook = self.io_hook
+        t0 = time.monotonic_ns() if hook else 0
         digest = _recv_exact(self.sock, 32)
         (n,) = struct.unpack("!Q", _recv_exact(self.sock, 8))
         if n > MAX_PAYLOAD:
@@ -169,6 +182,8 @@ class Channel:
                 "HMAC digest mismatch: unauthenticated, replayed, or "
                 "reordered message")
         self._recv_seq += 1
+        if hook:
+            hook("recv", n, t0, time.monotonic_ns())
         return payload
 
 

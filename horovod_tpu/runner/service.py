@@ -106,6 +106,12 @@ class DriverService(BasicService):
             with self._cv:
                 self._metrics[req["rank"]] = req["snapshot"]
             return {"ok": True}
+        if kind == "clock_probe":
+            # Distributed-tracing clock alignment (tracing/clock.py): one
+            # NTP-style round trip — the caller brackets this response with
+            # its own monotonic readings and estimates its offset to the
+            # driver clock. Stateless, so it needs no lock.
+            return {"ok": True, "t": time.monotonic_ns()}
         return {"ok": False, "error": f"unknown request {kind}"}
 
     # -- rank assignment (reference spark/__init__.py:143-152)
@@ -512,6 +518,17 @@ class TaskAgent:
         self.client.request({"kind": "metrics",
                              "rank": int(os.environ["HOROVOD_RANK"]),
                              "snapshot": snapshot()})
+
+    def estimate_clock_offset_ns(self, rounds: int = 8) -> tuple[int, int]:
+        """(offset_ns, error_bound_ns) of the DRIVER clock relative to this
+        worker's monotonic clock — the runner-level trace alignment path for
+        multi-host pods (tracing/clock.py; single-host ranks usually align
+        over the engine coordinator channel instead)."""
+        from ..tracing.clock import estimate_offset_ns
+
+        return estimate_offset_ns(
+            lambda: self.client.request({"kind": "clock_probe"})["t"],
+            rounds=rounds)
 
     @staticmethod
     def _final_snapshot() -> Optional[dict]:

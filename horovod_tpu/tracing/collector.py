@@ -1,0 +1,148 @@
+"""Trace collector: merge per-rank span logs into ONE Perfetto-loadable
+Chrome trace, clock-aligned to the coordinator (rank 0).
+
+Input: a trace directory of ``spans-rank<k>.jsonl`` files (recorder.py) —
+on a single host every rank writes into the same directory; on a multi-host
+pod, copy each host's files into one place first (docs/tracing.md). Each
+file's meta line carries that rank's clock offset to the coordinator
+(clock.py), so ``aligned = local + offset`` puts every span on one axis.
+
+Output (strict JSON, the Chrome trace-event format Perfetto and
+chrome://tracing both load): one *process* per rank, one *thread lane* per
+phase, complete ("X") events for spans and instant ("i") events for points,
+all timestamps in microseconds from the earliest span. Every event's args
+carry the trace ID, so searching one ID in the UI lights up the same
+allreduce's lifecycle on every rank — the pod-wide view the per-rank
+timeline (utils/timeline.py) cannot give.
+
+CLI:  python -m horovod_tpu.tracing.collector <trace_dir> [-o trace.json]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Optional
+
+# Stable lane ids per phase so every rank's track layout matches.
+_PHASE_LANES = {"enqueue": 0, "negotiate": 1, "cache_tick": 1, "wire": 2,
+                "wire_send": 2, "wire_recv": 3, "reduce": 4, "done": 5}
+_LANE_NAMES = {0: "enqueue", 1: "negotiate", 2: "wire send", 3: "wire recv",
+               4: "reduce", 5: "done"}
+
+
+def load_spans(trace_dir: str) -> tuple[list[dict], dict[int, dict]]:
+    """Read every rank's span file, apply its meta clock offset, and return
+    (spans, meta_by_rank). Span ``t0``/``t1`` are ALIGNED ns after this.
+    Unparseable lines are skipped (a crashed rank may leave a torn tail);
+    a missing meta line degrades to offset 0 rather than dropping the rank.
+    """
+    spans: list[dict] = []
+    metas: dict[int, dict] = {}
+    for path in sorted(glob.glob(os.path.join(trace_dir, "spans-rank*.jsonl"))):
+        offset = 0
+        rank = None
+        pending: list[dict] = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("meta"):
+                    # last meta wins (the offset estimate lands after the
+                    # recorder opens, re-announced as a later meta line)
+                    offset = int(rec.get("clock_offset_ns", 0))
+                    rank = rec.get("rank", rank)
+                    metas[int(rec["rank"])] = rec
+                    continue
+                pending.append(rec)
+        for rec in pending:
+            rec["t0"] = int(rec.get("t0", 0)) + offset
+            rec["t1"] = int(rec.get("t1", rec.get("t0", 0))) + offset
+            spans.append(rec)
+    return spans, metas
+
+
+def build_trace(spans: list[dict], metas: Optional[dict] = None) -> dict:
+    """Chrome trace-event JSON object from ALIGNED spans."""
+    events: list[dict] = []
+    ranks = sorted({int(s.get("rank", 0)) for s in spans})
+    for r in ranks:
+        events.append({"name": "process_name", "ph": "M", "pid": r, "tid": 0,
+                       "args": {"name": f"rank {r}"}})
+        for lane, lname in sorted(_LANE_NAMES.items()):
+            events.append({"name": "thread_name", "ph": "M", "pid": r,
+                           "tid": lane, "args": {"name": lname}})
+    t_base = min((s["t0"] for s in spans), default=0)
+    for s in spans:
+        phase = str(s.get("phase", "?"))
+        lane = _PHASE_LANES.get(phase, 1)
+        ts_us = (s["t0"] - t_base) / 1000.0
+        dur_us = max(0.0, (s["t1"] - s["t0"]) / 1000.0)
+        args = {k: v for k, v in s.items()
+                if k not in ("t0", "t1", "rank", "phase")}
+        ev = {"name": f"{phase} {s.get('name', '')}".strip(), "cat": phase,
+              "pid": int(s.get("rank", 0)), "tid": lane,
+              "ts": round(ts_us, 3), "args": args}
+        if s["t1"] > s["t0"]:
+            ev["ph"] = "X"
+            ev["dur"] = round(dur_us, 3)
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        events.append(ev)
+    out = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if metas:
+        out["metadata"] = {
+            "ranks": sorted(metas),
+            "clock_offsets_ns": {str(r): m.get("clock_offset_ns", 0)
+                                 for r, m in sorted(metas.items())},
+        }
+    return out
+
+
+def merge_trace(trace_dir: str, out_path: Optional[str] = None) -> dict:
+    """Merge a trace directory into one Chrome trace; write it to
+    ``out_path`` (default ``<trace_dir>/trace.json``) and return it."""
+    spans, metas = load_spans(trace_dir)
+    trace = build_trace(spans, metas)
+    path = out_path or os.path.join(trace_dir, "trace.json")
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return trace
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Merge per-rank span logs into one Perfetto trace")
+    ap.add_argument("trace_dir")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default <trace_dir>/trace.json)")
+    ap.add_argument("--critical-path", action="store_true",
+                    help="also print the critical-path attribution summary")
+    args = ap.parse_args(argv)
+    spans, metas = load_spans(args.trace_dir)
+    if not spans:
+        print(f"no spans under {args.trace_dir}")
+        return 1
+    trace = build_trace(spans, metas)
+    path = args.out or os.path.join(args.trace_dir, "trace.json")
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    print(f"merged {len(spans)} spans from {len(metas)} ranks -> {path}")
+    if args.critical_path:
+        from .critical_path import analyze, format_summary
+
+        print(format_summary(analyze(spans)))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - CLI entry
+    raise SystemExit(main())

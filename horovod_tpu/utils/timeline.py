@@ -11,6 +11,13 @@ HOROVOD_TIMELINE_MARK_CYCLES (timeline.h:93 MarkCycleStart).
 
 On-device time is XLA's domain: pair this host-side timeline with the JAX/TPU
 profiler (jax.profiler.trace) for kernel-level spans.
+
+Span-schema upgrade (ISSUE 6, docs/tracing.md): the emitters accept an
+optional ``tid`` — the pod-wide trace ID minted at enqueue — and attach it
+as ``args.trace_id`` on the Chrome events, so this per-rank timeline can be
+joined against the merged pod trace (horovod_tpu/tracing) by ID. Fully
+backward compatible: with ``tid=None`` (the default) the events are
+byte-identical to the pre-tracing schema.
 """
 
 from __future__ import annotations
@@ -69,10 +76,17 @@ class Timeline:
         except queue.Full:  # drop rather than block the hot path
             self._dropped.inc()
 
-    def negotiate_start(self, name: str, op: str) -> None:
+    @staticmethod
+    def _with_tid(ev: dict, tid) -> dict:
+        if tid is not None:
+            ev["args"] = {"trace_id": tid}
+        return ev
+
+    def negotiate_start(self, name: str, op: str, tid=None) -> None:
         pid = self._pid(name)
-        self._emit({"name": f"NEGOTIATE_{op}", "ph": "B", "pid": pid, "tid": 0,
-                    "ts": self._ts_us()})
+        self._emit(self._with_tid(
+            {"name": f"NEGOTIATE_{op}", "ph": "B", "pid": pid, "tid": 0,
+             "ts": self._ts_us()}, tid))
 
     def negotiate_rank_ready(self, name: str, rank: int) -> None:
         pid = self._pid(name)
@@ -83,10 +97,12 @@ class Timeline:
         pid = self._pid(name)
         self._emit({"name": "", "ph": "E", "pid": pid, "tid": 0, "ts": self._ts_us()})
 
-    def start(self, name: str, op: str) -> None:
+    def start(self, name: str, op: str, tid=None) -> None:
         self.negotiate_end(name)
         pid = self._pid(name)
-        self._emit({"name": op, "ph": "B", "pid": pid, "tid": 0, "ts": self._ts_us()})
+        self._emit(self._with_tid(
+            {"name": op, "ph": "B", "pid": pid, "tid": 0,
+             "ts": self._ts_us()}, tid))
 
     def activity_start(self, name: str, activity: str) -> None:
         pid = self._pid(name)

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""CI smoke for distributed tracing + the perf gate (ISSUE 6; ci.sh).
+
+1. Spawns a 2-process eager world with HOROVOD_TRACE_DIR set and an
+   INJECTED straggler: rank 1 sleeps ``INJECT_S`` before each of its last
+   ``INJECT_STEPS`` enqueues (compute skew, the commonest real straggler).
+2. Merges the per-rank span logs into one clock-aligned Chrome/Perfetto
+   trace and checks it strictly: valid JSON, spans from BOTH ranks, and a
+   single trace ID linking each allreduce's spans across the ranks.
+3. Runs the critical-path analyzer and asserts it attributes >= 80% of the
+   injected delay to rank 1 in the compute_skew phase — the acceptance
+   contract of docs/tracing.md.
+4. Perf-gate legs: the gate must PASS a run against its own baseline and
+   FAIL a synthetic 20% throughput regression (fixture JSON, then the
+   --self-check live-fire mode ci.sh also runs against real bench output).
+
+Exits non-zero with a reason on any violation. Wall-clock budget: ~15 s.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import socket
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORLD = 2
+STEPS = 6
+INJECT_S = 0.3
+INJECT_STEPS = 3
+SLOW_RANK = 1
+
+WORKER = r"""
+import os, sys, time
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.common.engine import PyEngine
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.topology import Topology
+
+rank = int(os.environ["HOROVOD_RANK"])
+world = int(os.environ["HOROVOD_SIZE"])
+steps = int(os.environ["SMOKE_STEPS"])
+inject_s = float(os.environ["SMOKE_INJECT_S"])
+inject_steps = int(os.environ["SMOKE_INJECT_STEPS"])
+slow_rank = int(os.environ["SMOKE_SLOW_RANK"])
+
+topo = Topology(rank=rank, size=world, local_rank=rank, local_size=world,
+                cross_rank=0, cross_size=1)
+eng = PyEngine(topo, Config(cycle_time_ms=2.0, stall_check_disable=True))
+for i in range(steps):
+    if rank == slow_rank and i >= steps - inject_steps:
+        time.sleep(inject_s)
+    out = eng.run("allreduce", np.full(2048, float(rank + 1), np.float32),
+                  f"grad.{i}")
+    assert abs(float(out[0]) - (world + 1) / 2.0) < 1e-6, float(out[0])
+eng.shutdown()
+print("OK", rank)
+"""
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def fail(msg: str) -> None:
+    print(f"trace smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run_world(trace_dir: str) -> None:
+    port = free_port()
+    secret = secrets.token_hex(16)
+    procs = []
+    for rank in range(WORLD):
+        env = dict(os.environ)
+        env.update({
+            "HVD_REPO": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(WORLD),
+            "HOROVOD_COORD_ADDR": f"127.0.0.1:{port}",
+            "HOROVOD_SECRET": secret,
+            "HOROVOD_TRACE_DIR": trace_dir,
+            "SMOKE_STEPS": str(STEPS),
+            "SMOKE_INJECT_S": str(INJECT_S),
+            "SMOKE_INJECT_STEPS": str(INJECT_STEPS),
+            "SMOKE_SLOW_RANK": str(SLOW_RANK),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    for rank, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=90)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            fail(f"rank {rank} timed out:\n{err[-3000:]}")
+        if p.returncode != 0:
+            fail(f"rank {rank} exited rc={p.returncode}:\n{err[-3000:]}")
+
+
+def check_trace(trace_dir: str) -> None:
+    from horovod_tpu.tracing import analyze, export_gauges, load_spans, \
+        merge_trace
+
+    merge_trace(trace_dir)
+    trace_path = os.path.join(trace_dir, "trace.json")
+    with open(trace_path) as f:
+        trace = json.load(f)   # strict parse straight off disk
+    events = trace.get("traceEvents")
+    if not (isinstance(events, list) and events):
+        fail("merged trace has no traceEvents array")
+    pids = {e.get("pid") for e in events if e.get("ph") in ("X", "i")}
+    if not {0, 1} <= pids:
+        fail(f"merged trace lacks spans from both ranks (pids={pids})")
+    for e in events:
+        if e.get("ph") == "X" and ("ts" not in e or "dur" not in e):
+            fail(f"malformed complete event: {e}")
+
+    spans, metas = load_spans(trace_dir)
+    if sorted(metas) != [0, 1]:
+        fail(f"expected meta records for ranks 0 and 1, got {sorted(metas)}")
+    by_tid: dict = {}
+    for s in spans:
+        by_tid.setdefault(s["tid"], set()).add(s["rank"])
+    both = [t for t, r in by_tid.items() if r == {0, 1}]
+    if len(both) < STEPS:
+        fail(f"only {len(both)}/{STEPS} trace IDs link both ranks: "
+             f"{by_tid}")
+    if any(not t.startswith("grad.") for t in by_tid):
+        fail(f"unexpected trace IDs: {sorted(by_tid)}")
+
+    report = analyze(spans)
+    export_gauges(report)
+    injected = INJECT_S * INJECT_STEPS
+    strag = report.get("straggler")
+    if not strag:
+        fail(f"analyzer found no straggler: {report['phase_seconds']}")
+    if strag["rank"] != SLOW_RANK:
+        fail(f"analyzer blamed rank {strag['rank']}, injected rank "
+             f"{SLOW_RANK}: {report['skew_seconds_by_rank']}")
+    if strag["phase"] != "compute_skew":
+        fail(f"analyzer blamed phase {strag['phase']!r}, expected "
+             f"compute_skew: {report['phase_seconds']}")
+    attributed = report["skew_seconds_by_rank"].get(SLOW_RANK, 0.0)
+    if attributed < 0.8 * injected:
+        fail(f"only {attributed:.3f}s of the injected {injected:.3f}s "
+             f"attributed to rank {SLOW_RANK} (< 80%)")
+    # The watchdog-facing info blob must be published for report enrichment.
+    from horovod_tpu.metrics import registry
+
+    if not registry().get_info("straggler_attribution"):
+        fail("straggler_attribution info not published to the registry")
+    print(f"trace smoke: straggler rank {strag['rank']} / {strag['phase']}, "
+          f"{attributed:.3f}s of {injected:.3f}s injected attributed "
+          f"({attributed / injected * 100:.0f}%), "
+          f"{len(events)} trace events")
+
+
+def check_perf_gate(tmp: str) -> None:
+    gate = os.path.join(REPO, "tools", "perf_gate.py")
+    base = os.path.join(tmp, "gate_baseline.json")
+    good = os.path.join(tmp, "gate_good.json")
+    bad = os.path.join(tmp, "gate_bad.json")
+    rec = {"metric": "resnet50_images_per_sec", "value": 1000.0,
+           "unit": "img/s"}
+    with open(base, "w") as f:
+        json.dump(rec, f)
+    with open(good, "w") as f:
+        json.dump(rec, f)
+    with open(bad, "w") as f:
+        json.dump(dict(rec, value=800.0), f)   # exactly -20%
+
+    def run(args):
+        return subprocess.run([sys.executable, gate] + args,
+                              capture_output=True, text=True).returncode
+
+    if run(["--current", good, "--baseline", base]) != 0:
+        fail("perf gate rejected a run identical to its baseline")
+    if run(["--current", bad, "--baseline", base]) == 0:
+        fail("perf gate passed a 20% throughput regression")
+    if run(["--current", good, "--self-check"]) != 0:
+        fail("perf gate --self-check did not detect the synthetic "
+             "regression")
+    print("trace smoke: perf gate passes baseline, fails -20%, "
+          "self-check OK")
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="hvd_trace_smoke_")
+    trace_dir = os.path.join(tmp, "trace")
+    run_world(trace_dir)
+    check_trace(trace_dir)
+    check_perf_gate(tmp)
+    print("trace smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
