@@ -535,8 +535,18 @@ def eager_worker_main() -> None:
     per_rank_mb = float(os.environ.get("HVD_EAGER_MB", "32"))
     iters = int(os.environ.get("HVD_EAGER_ITERS", "3"))
     neg_ops = int(os.environ.get("HVD_EAGER_NEG_OPS", "64"))
-    eng = PyEngine(Topology(rank, world, 0, 1, rank, world),
-                   Config(cycle_time_ms=1.0, stall_check_disable=True))
+    # HVD_EAGER_LOCAL_SIZE > 1: lay the world out as a simulated
+    # hosts x ranks-per-host grid (blocked, like the launcher assigns) —
+    # the --hier-ab topology. Default stays the historical one-rank-per-
+    # host world.
+    lsz = max(1, int(os.environ.get("HVD_EAGER_LOCAL_SIZE", "1")))
+    topo = (Topology(rank, world, rank % lsz, lsz, rank // lsz, world // lsz)
+            if lsz > 1 else Topology(rank, world, 0, 1, rank, world))
+    from horovod_tpu.common.config import _env_bool
+    eng = PyEngine(topo,
+                   Config(cycle_time_ms=1.0, stall_check_disable=True,
+                          hierarchical_allreduce=_env_bool(
+                              "HOROVOD_HIERARCHICAL_ALLREDUCE")))
     try:
         # HVD_EAGER_DTYPE: float64 (default, the historical --eager payload)
         # or float32 (--compression-ab: gradients are f32, and the wire
@@ -615,6 +625,13 @@ def eager_worker_main() -> None:
                 'horovod_engine_data_bytes_total{plane="star"}', 0),
             "ring_bytes": snap1.get(
                 'horovod_engine_data_bytes_total{plane="ring"}', 0),
+            # Per-fabric-tier data-plane bytes (ISSUE 7): what --hier-ab
+            # asserts the 1/local_size cross cut on.
+            "plane": stats.get("plane", "star"),
+            "tier_local_bytes": snap1.get(
+                'horovod_wire_bytes_total{tier="local"}', 0),
+            "tier_cross_bytes": snap1.get(
+                'horovod_wire_bytes_total{tier="cross"}', 0),
         }), flush=True)
     finally:
         eng.shutdown()
@@ -720,6 +737,74 @@ def eager_main() -> None:
         "cached_window_control_bytes_per_exchange": round(
             r0["window_control_bytes"] / max(r0["window_exchanges"], 1), 1),
         "star_relay_bytes_in_ring_mode": r0["star_bytes"],
+    })
+    budget.emit(out)
+
+
+def hier_ab_main() -> None:
+    """bench.py --hier-ab: A/B the hierarchical fabric-aware eager plane
+    (ISSUE 7) on a simulated 2-host x 2-rank grid.
+
+    Two 4-proc Python-engine worlds move the same per-rank payload: the
+    FLAT peer ring (hierarchical off — host-boundary neighbours carry the
+    whole stream) vs the TWO-LEVEL plane (intra-host reduce-scatter →
+    per-chunk leaders ring across hosts → intra-host allgather). The
+    headline value is the worst-rank cross-host byte reduction
+    (flat/hier, target ~local_size·(N-1)/N / ((C-1)/C) ≈ 3x on 2x2 — the
+    ratio tools/hier_smoke.py gates at >= 1/0.35), with throughput and
+    correctness riding along. One JSON line, always (budget watchdog)."""
+    budget = _Budget.install("hier_ab_cross_byte_reduction", "x")
+    world = int(os.environ.get("HVD_EAGER_WORLD", "4"))
+    lsz = max(2, int(os.environ.get("HVD_EAGER_LOCAL_SIZE", "2")))
+    if _smoke_on():
+        os.environ.setdefault("HVD_EAGER_MB", "1")
+        os.environ.setdefault("HVD_EAGER_ITERS", "3")
+        os.environ.setdefault("HVD_EAGER_NEG_OPS", "16")
+    grid_env = {"HOROVOD_RING_DATA_PLANE": "1",
+                "HVD_EAGER_DTYPE": "float32",
+                "HVD_EAGER_LOCAL_SIZE": str(lsz)}
+    stage_s = min(max(budget.remaining() / 2 - 10, 30), 240)
+    budget.stage("flat-grid")
+    flat = _spawn_eager_world(
+        world, dict(grid_env, HOROVOD_HIERARCHICAL_ALLREDUCE="0"), stage_s)
+    budget.stage("hier-grid")
+    hier = _spawn_eager_world(
+        world, dict(grid_env, HOROVOD_HIERARCHICAL_ALLREDUCE="1"), stage_s)
+    out = {"metric": "hier_ab_cross_byte_reduction", "value": 0.0,
+           "unit": "x", "world": world, "local_size": lsz,
+           "hosts": world // lsz, "smoke": _smoke_on(),
+           "payload_mb_per_rank": float(os.environ.get("HVD_EAGER_MB", "32")),
+           "iters": int(os.environ.get("HVD_EAGER_ITERS", "3"))}
+    if flat is None or hier is None:
+        out.update({"partial": True,
+                    "reason": "a bench world failed or timed out",
+                    "flat_ok": flat is not None, "hier_ok": hier is not None})
+        budget.emit(out)
+        return
+    flat_cross = max(r["tier_cross_bytes"] for r in flat)
+    hier_cross = max(r["tier_cross_bytes"] for r in hier)
+    flat_mbs = min(r["payload_mb_s"] for r in flat)
+    hier_mbs = min(r["payload_mb_s"] for r in hier)
+    out.update({
+        "value": round(flat_cross / max(hier_cross, 1), 3),
+        "hier_plane_active": all(r["plane"] == "hier" for r in hier),
+        "flat_plane": flat[0]["plane"],
+        "flat_worst_rank_cross_bytes": int(flat_cross),
+        "hier_worst_rank_cross_bytes": int(hier_cross),
+        "cross_byte_ratio": round(hier_cross / max(flat_cross, 1), 4),
+        "flat_payload_mb_s": round(flat_mbs, 2),
+        "hier_payload_mb_s": round(hier_mbs, 2),
+        "hier_vs_flat_speedup": round(hier_mbs / max(flat_mbs, 1e-9), 3),
+        # Correctness riding along: every rank of each world agrees
+        # bitwise, the analytic truth holds, and the steady-state cache
+        # is unaffected by the plane swap.
+        "flat_ranks_agree": len({r["payload_hash"] for r in flat}) == 1,
+        "hier_ranks_agree": len({r["payload_hash"] for r in hier}) == 1,
+        "hier_max_rel_err": max(r["payload_max_rel_err"] for r in hier),
+        "hier_cache_hit_rate": round(
+            hier[0]["window_hits"] / max(
+                hier[0]["window_hits"] + hier[0]["window_misses"], 1), 4),
+        "star_relay_bytes_in_hier_mode": hier[0]["star_bytes"],
     })
     budget.emit(out)
 
@@ -836,6 +921,8 @@ def main() -> None:
         return eager_main()
     if "--compression-ab" in sys.argv:
         return compression_ab_main()
+    if "--hier-ab" in sys.argv:
+        return hier_ab_main()
 
     # Arm the watchdog BEFORE the first jax import: on a degraded platform
     # backend init itself can wedge (the BENCH_r05 signature), and the

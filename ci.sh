@@ -52,6 +52,16 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/trace_smoke.py
 echo "== eager smoke (4-proc Python engine: steady-state cache hit rate >= 95%, ring data plane carrying the bytes, star==ring bitwise; bf16 wire >= 2x fewer bytes within tolerance) =="
 timeout -k 10 240 python tools/eager_smoke.py
 
+echo "== hier smoke (simulated 2-host x 2-rank grid: two-level plane active, worst-rank cross-host bytes <= 0.35x flat, flat==hier==star bitwise incl. bf16, cache hit rate unchanged) =="
+timeout -k 10 240 python tools/hier_smoke.py
+
+echo "== hier A/B bench + gate (ISSUE 7: cross-byte reduction metric must exist and clear the 2.5x floor — CI fails if a change silently re-inflates DCN traffic) =="
+HVD_BENCH_SMOKE=1 timeout -k 10 240 python bench.py --hier-ab | tee /tmp/hvd_hier_ab.log
+python tools/perf_gate.py --current /tmp/hvd_hier_ab.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric hier_ab_cross_byte_reduction \
+  --min-abs hier_ab_cross_byte_reduction=2.5 --allow-missing-baseline
+
 echo "== metrics smoke (2-proc train, stall check + exposition; snapshot vs docs/metrics_schema.json, timeline JSON shape) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_smoke.py
 

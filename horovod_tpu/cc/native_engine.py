@@ -193,6 +193,7 @@ class NativeEngine:
         # them the DELTA since its previous scrape.
         self._cache_last = {"cache_hits": 0, "cache_misses": 0}
         self._wire_last = {"wire_bytes": 0, "wire_bytes_saved": 0}
+        self._tier_last = {"total": 0, "cross": 0}
         # handle -> (op, nbytes, enqueue time): feeds the SAME per-op
         # count/bytes/latency series the Python engine emits
         # (horovod_collective_*), so dashboards read one surface no matter
@@ -421,6 +422,33 @@ class NativeEngine:
                     reg.counter(series, help=hlp,
                                 plane="native").inc(v - last)
                 self._wire_last[native] = max(v, last)
+        # Per-fabric-tier wire bytes (ISSUE 7): the native ring stats split
+        # total vs cross-host bytes; the deltas feed the SAME
+        # horovod_wire_bytes_total{tier=...} series the Python engine's
+        # data plane increments directly, so the hier A/B reads one
+        # surface whichever engine is active.
+        try:
+            total = int(self._lib.hvd_ring_bytes_sent())
+            cross = int(self._lib.hvd_ring_cross_bytes_sent())
+        except Exception:  # pragma: no cover - engine gone mid-scrape
+            total = cross = -1
+        if total >= 0 and cross >= 0:
+            d_total = total - self._tier_last["total"]
+            d_cross = cross - self._tier_last["cross"]
+            if d_cross > 0:
+                reg.counter(
+                    "horovod_wire_bytes_total",
+                    help="eager data-plane bytes sent per fabric tier "
+                         "(local = same host, cross = host boundary)",
+                    tier="cross").inc(d_cross)
+            if d_total - d_cross > 0:
+                reg.counter(
+                    "horovod_wire_bytes_total",
+                    help="eager data-plane bytes sent per fabric tier "
+                         "(local = same host, cross = host boundary)",
+                    tier="local").inc(d_total - d_cross)
+            self._tier_last["total"] = max(total, self._tier_last["total"])
+            self._tier_last["cross"] = max(cross, self._tier_last["cross"])
         stall = self.last_stall()
         if stall:
             reg.set_info("stall_report", {

@@ -188,6 +188,17 @@ class Config:
     compression_min_bytes: int = field(                   # HOROVOD_COMPRESSION_MIN_BYTES
         default_factory=lambda: max(0, _env_int(
             "HOROVOD_COMPRESSION_MIN_BYTES", DEFAULT_COMPRESSION_MIN_BYTES)))
+    # Fabric-aware compiled plane (ISSUE 7, docs/hierarchical.md): a wire
+    # dtype and a bucket-size cap applied to the DCN (cross-host) tier of
+    # the hierarchical ladder only. Empty dcn_compression inherits the
+    # global HOROVOD_COMPRESSION; dcn_fusion_threshold 0 means no separate
+    # DCN cap. Env-aware defaults for the same reason as the fields above.
+    dcn_compression: str = field(                         # HOROVOD_DCN_COMPRESSION
+        default_factory=lambda: os.environ.get(
+            "HOROVOD_DCN_COMPRESSION", "").lower())
+    dcn_fusion_threshold: int = field(                    # HOROVOD_DCN_FUSION_THRESHOLD
+        default_factory=lambda: max(0, _env_int(
+            "HOROVOD_DCN_FUSION_THRESHOLD", 0)))
     # Distributed tracing (ISSUE 6, docs/tracing.md): non-empty directory
     # enables per-rank span capture on every data plane. Env-aware default
     # like compression above: workers constructed with Config(...) directly

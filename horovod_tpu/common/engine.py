@@ -201,12 +201,20 @@ def _acc_finish(acc: np.ndarray, average: bool, world: int,
 
 
 def _ring_order_reduce(arrs: list[np.ndarray], average: bool,
-                       wire_dtype=None) -> np.ndarray:
+                       wire_dtype=None, grid=None) -> np.ndarray:
     """Canonical allreduce reduction, shared by the star relay and the peer
     ring: chunk c accumulates contributions starting at rank (c+1) % world
     in ring order — exactly the order the ring reduce-scatter performs —
     so the two data planes (and cold vs cached negotiations) produce
     BITWISE-IDENTICAL results.
+
+    ``grid=(L, C)`` switches to the HIERARCHICAL canonical order (ISSUE 7):
+    ``arrs`` indexed by blocked global rank (rank = cross*L + local), each
+    element reduced as host-subtotals-then-hosts exactly the way the
+    two-level plane's local-RS → cross-ring → local-AG ladder computes it
+    (see ``_grid_order_reduce``). ``grid=(1, world)`` and ``grid=(world,
+    1)`` both degenerate to this flat order bitwise — the single-host
+    degeneracy the hier tests pin.
 
     ``wire_dtype`` (HOROVOD_COMPRESSION) simulates the compressed ring's
     wire hops exactly: every partial sum is rounded to the wire dtype
@@ -218,6 +226,8 @@ def _ring_order_reduce(arrs: list[np.ndarray], average: bool,
     lossless relative to the per-hop 16-bit rounding and half the cast/add
     cost of the float64 path; contributions were quantized at enqueue, so
     viewing them at f32 drops no information either."""
+    if grid is not None:
+        return _grid_order_reduce(arrs, average, wire_dtype, grid)
     world = len(arrs)
     shape, dtype = arrs[0].shape, arrs[0].dtype
     flats = [np.ascontiguousarray(a).ravel() for a in arrs]
@@ -247,168 +257,229 @@ def _ring_order_reduce(arrs: list[np.ndarray], average: bool,
     return out.reshape(shape)
 
 
+def _grid_order_reduce(arrs: list[np.ndarray], average: bool,
+                       wire_dtype, grid: tuple) -> np.ndarray:
+    """Hierarchical canonical order (the ``grid=`` branch of
+    :func:`_ring_order_reduce`): the exact fold the two-level data plane
+    performs, as pure numpy.
+
+    Per local chunk l (an L-way split of the flat buffer): every host folds
+    its members' contributions in local ring order starting at member
+    (l+1) % L — the intra-host reduce-scatter; then per cross subchunk k
+    (a C-way split of chunk l) the host subtotals fold in cross ring order
+    starting at host (k+1) % C — the leaders ring. The fixed (l+1)/(k+1)
+    leader starts are the ring lockstep's natural fold starts, so the wire
+    plane reproduces this order hop for hop. Compression rounds exactly
+    where the wire does: before every add on both levels (partials travel
+    at the wire dtype) and once on the finished value (the allgather hop).
+    """
+    L, C = int(grid[0]), int(grid[1])
+    world = L * C
+    if len(arrs) != world:
+        raise ValueError(f"grid {grid} needs {world} arrays, got {len(arrs)}")
+    shape, dtype = arrs[0].shape, arrs[0].dtype
+    flats = [np.ascontiguousarray(a).ravel() for a in arrs]
+    n = flats[0].size
+    lb = _chunk_bounds(n, L)
+    out = np.empty(n, dtype=dtype)
+    if wire_dtype is not None:
+        acc_dt = np.dtype(np.float32)
+        flats = [f if f.dtype == acc_dt else f.astype(acc_dt) for f in flats]
+    for l in range(L):
+        lo, hi = lb[l], lb[l + 1]
+        # Stage 1: per-host subtotals of local chunk l (intra-host RS fold).
+        start = (l + 1) % L
+        partials = []
+        for c in range(C):
+            x = flats[c * L + start][lo:hi]
+            acc = x if wire_dtype is not None else _acc_start(x)
+            for k in range(1, L):
+                if wire_dtype is not None:
+                    acc = acc.astype(wire_dtype).astype(acc_dt)
+                acc = acc + flats[c * L + (start + k) % L][lo:hi]
+            partials.append(acc)
+        # Stage 2: fold the host subtotals per cross subchunk (leaders ring).
+        cb = _chunk_bounds(hi - lo, C)
+        for k in range(C):
+            s, e = cb[k], cb[k + 1]
+            cstart = (k + 1) % C
+            acc = partials[cstart][s:e]
+            for j in range(1, C):
+                if wire_dtype is not None:
+                    acc = acc.astype(wire_dtype).astype(acc_dt)
+                acc = acc + partials[(cstart + j) % C][s:e]
+            fin = _acc_finish(acc, average, world, dtype)
+            if wire_dtype is not None:
+                fin = fin.astype(wire_dtype).astype(dtype)
+            out[lo + s:lo + e] = fin
+    return out.reshape(shape)
+
+
+# ---------------------------------------------------- fabric topology planning
+
+def plan_grid(coords: dict) -> Optional[dict]:
+    """Validate a world's host coordinates as a homogeneous blocked grid and
+    return the two-level plan, or None when the ladder cannot run.
+
+    ``coords``: rank -> (local_rank, local_size, cross_rank, cross_size).
+    Requirements (the Python mirror of the native ``analyze_hier``,
+    cc/src/engine.cc): L > 1 and C > 1, identical (L, C) on every rank,
+    every (cross, local) cell covered exactly once, and the BLOCKED rank
+    map rank == cross*L + local — the eager plane's chunk ownership and the
+    canonical grid reduce order both index by it. Deterministic over the
+    same map, so every rank reaches the same verdict (an asymmetric verdict
+    would deadlock ring establishment)."""
+    if not coords:
+        return None
+    ranks = sorted(coords)
+    l0, L, c0, C = coords[ranks[0]]
+    if L <= 1 or C <= 1 or len(ranks) != L * C:
+        return None
+    if ranks != list(range(L * C)):
+        return None
+    for r in ranks:
+        lr, ls, cr, cs = coords[r]
+        if ls != L or cs != C:
+            return None
+        if not (0 <= lr < L and 0 <= cr < C):
+            return None
+        if r != cr * L + lr:
+            return None
+    return {"L": L, "C": C,
+            # rank r's ring peers: host members in local order, and the
+            # ranks sharing r's local slot in cross order.
+            "local_group": lambda r: [(r // L) * L + i for i in range(L)],
+            "cross_group": lambda r: [c * L + (r % L) for c in range(C)]}
+
+
 # ----------------------------------------------------------- peer ring plane
 
-class _PeerRing:
-    """Authenticated peer-to-peer TCP ring for the Python engine's allreduce
-    data plane (reduce-scatter + allgather, the shape of the native ring.h
-    and the reference's NCCL ring, operations.cc:1221-1446).
+def _connect_ring(listener, my_pos: int, size: int, endpoints: list,
+                  ring_key: bytes, tag: str, connect_timeout: float):
+    """Build one ring's neighbour links: connect to the next member, accept
+    from the previous, verify the authenticated hello names this ring and
+    these positions. ``endpoints[pos] = (host, port)``. Returns
+    ``(next_ch, prev_ch, next_sock, prev_sock)``.
+
+    Shared by the flat peer ring and both levels of the hierarchical plane;
+    the ``tag`` rides the hello so a connection misrouted between the flat /
+    local / cross listeners is rejected instead of silently pairing the
+    wrong rings (the channels are also keyed per ring purpose, so the
+    frames would not authenticate anyway — the tag turns that into a
+    readable error)."""
+    from ..runner.network import Channel
+
+    nxt, prv = (my_pos + 1) % size, (my_pos - 1) % size
+    accepted: dict = {}
+
+    def _accept():
+        try:
+            conn, _ = listener.accept()
+            conn.settimeout(connect_timeout)
+            ch = Channel(conn, ring_key, server=True)
+            hello = ch.recv()
+            if (hello.get("hello") != prv or hello.get("to") != my_pos
+                    or hello.get("ring", tag) != tag):
+                raise ConnectionError(
+                    f"{tag} ring accept: expected member {prv}, got {hello}")
+            ch.send({"ok": 1})
+            accepted["ch"], accepted["sock"] = ch, conn
+        except Exception as e:  # noqa: BLE001
+            accepted["err"] = e
+
+    t = threading.Thread(target=_accept, daemon=True)
+    t.start()
+    nhost, nport = endpoints[nxt]
+    deadline = time.monotonic() + connect_timeout
+    nsock = None
+    while True:
+        try:
+            nsock = socket.create_connection(
+                (nhost, nport), timeout=connect_timeout)
+            break
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.1)
+    nsock.settimeout(connect_timeout)
+    nch = Channel(nsock, ring_key, server=False)
+    nch.send({"hello": my_pos, "to": nxt, "ring": tag})
+    if nch.recv().get("ok") != 1:
+        raise ConnectionError(f"{tag} ring connect: bad ack from next")
+    t.join(timeout=connect_timeout)
+    if "ch" not in accepted:
+        raise accepted.get(
+            "err", ConnectionError(f"{tag} ring accept timed out"))
+    # Generous steady-state deadline: a dead peer still wakes us (RST); a
+    # healthy-but-slow one must not.
+    for s_ in (nsock, accepted["sock"]):
+        s_.settimeout(600.0)
+        s_.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # MB-scale chunk hops with default (~200 KiB) buffers cost dozens
+        # of sender/receiver context-switch pairs per hop — pure overhead
+        # when ranks share cores.
+        for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+            try:
+                s_.setsockopt(socket.SOL_SOCKET, opt, 4 << 20)
+            except OSError:  # pragma: no cover - cap by sysctl
+                pass
+    return nch, accepted["ch"], nsock, accepted["sock"]
+
+
+class _RingLinks:
+    """One ring's pair of neighbour channels plus a dedicated sender thread.
 
     Links ride :class:`horovod_tpu.runner.network.Channel` — the repo's
     session-keyed, sequence-numbered HMAC framing — under a purpose-bound
     subkey of the job secret, so a captured ring frame neither replays nor
-    authenticates on the coordinator channel. A dedicated sender thread
-    decouples send from recv (both neighbours push ~equal bytes per step;
-    blocking sends back-to-back would deadlock once chunks exceed the
-    socket buffers).
-    """
+    authenticates on the coordinator channel (or on another ring). The
+    sender thread decouples send from recv (both neighbours push ~equal
+    bytes per step; blocking sends back-to-back would deadlock once chunks
+    exceed the socket buffers).
+
+    Every link carries a fabric-tier tag (``local`` = same host, ``cross``
+    = the link crosses a host boundary): sends bill
+    ``horovod_wire_bytes_total{tier=...}`` through ``on_tier`` and the
+    tracing io hooks stamp wire spans with the tier — the per-fabric
+    accounting the hierarchical A/B and the straggler report read."""
 
     _STOP = object()
 
-    def __init__(self, rank: int, world: int, next_ch, prev_ch,
-                 next_sock, prev_sock, listener,
-                 on_bytes=None, on_wire=None, tracer=None) -> None:
-        self.rank = rank
-        self.world = world
+    def __init__(self, next_ch, prev_ch, socks, owner,
+                 next_tier: str = "local", prev_tier: str = "local") -> None:
         self._next_ch = next_ch
         self._prev_ch = prev_ch
-        self._socks = [next_sock, prev_sock, listener]
-        self._on_bytes = on_bytes or (lambda n: None)
-        # on_wire(wire_bytes, saved_bytes): compression telemetry — called
-        # per compressed hop with the bytes actually sent and the bytes the
-        # uncompressed plane would have sent minus that.
-        self._on_wire = on_wire or (lambda w, s: None)
-        # Distributed tracing (ISSUE 6): `tracer` is the rank's span
-        # recorder; `trace_ctx` names the collective currently on the ring
-        # (set by the engine around each directive). The Channel io hooks
-        # time the hops at the socket layer — the send side runs on the
-        # sender thread, which is exactly the wire time, not queue time.
-        self._tracer = tracer
-        self.trace_ctx: Optional[dict] = None
-        if tracer is not None:
-            def _io(direction: str, nbytes: int, t0: int, t1: int) -> None:
-                ctx = self.trace_ctx
-                if ctx is not None:
-                    tracer.span(ctx["tid"], ctx["name"], "allreduce",
-                                "wire_send" if direction == "send"
-                                else "wire_recv", t0, t1, bytes=int(nbytes))
-            next_ch.io_hook = _io
-            prev_ch.io_hook = _io
+        self._socks = list(socks)
+        self._owner = owner
+        self.next_tier = next_tier
+        self.prev_tier = prev_tier
         self.bytes_sent = 0
         self._err: Optional[Exception] = None
         self._sendq: "queue_mod.Queue" = queue_mod.Queue()
+        if owner._tracer is not None:
+            # Distributed tracing (ISSUE 6 + this PR's tier split): the
+            # owner plane's `trace_ctx` names the collective currently on
+            # the wire; the Channel io hooks time the hops at the socket
+            # layer — the send side runs on the sender thread, which is
+            # exactly the wire time, not queue time. Each hook closes over
+            # ITS link's tier, so wire_send/wire_recv spans say which
+            # fabric carried the bytes.
+            def _hook(tier):
+                def _io(direction: str, nbytes: int, t0: int, t1: int):
+                    ctx = owner.trace_ctx
+                    if ctx is not None:
+                        owner._tracer.span(
+                            ctx["tid"], ctx["name"], "allreduce",
+                            "wire_send" if direction == "send"
+                            else "wire_recv", t0, t1, bytes=int(nbytes),
+                            tier=tier)
+                return _io
+
+            next_ch.io_hook = _hook(next_tier)
+            prev_ch.io_hook = _hook(prev_tier)
         self._sender = threading.Thread(
             target=self._send_loop, name="hvd_ring_send", daemon=True)
         self._sender.start()
-
-    # -- establishment ------------------------------------------------------
-
-    @classmethod
-    def establish(cls, client: "_Client", topo, key: bytes, enabled: bool,
-                  on_bytes=None, on_wire=None, tracer=None,
-                  connect_timeout: float = 60.0):
-        """Negotiate and build the ring, or return None for the star.
-
-        Every rank must reach the same verdict (a half-ring deadlocks), so
-        activation is two coordinator barriers: ``ring_hello`` gathers the
-        listener endpoints (a rank with the plane disabled reports so, and
-        everyone falls back), ``ring_confirm`` gathers per-rank connect
-        success — the plane is active only when ALL ranks connected.
-        """
-        from ..runner.network import Channel, derive_key
-
-        rank, world = topo.rank, topo.size
-        listener = None
-        ok = False
-        ring = None
-        ring_key = derive_key(key, b"eager-ring")
-        try:
-            if enabled:
-                listener = socket.create_server(("0.0.0.0", 0), backlog=4)
-                listener.settimeout(connect_timeout)
-                port = listener.getsockname()[1]
-                host = client.local_host()
-            else:
-                host, port = "", 0
-            peers = client.ring_hello(host, port, enabled=enabled)
-            if peers is not None:
-                nxt, prv = (rank + 1) % world, (rank - 1) % world
-                accepted: dict = {}
-
-                def _accept():
-                    try:
-                        conn, _ = listener.accept()
-                        conn.settimeout(connect_timeout)
-                        ch = Channel(conn, ring_key, server=True)
-                        hello = ch.recv()
-                        if (hello.get("hello") != prv
-                                or hello.get("to") != rank):
-                            raise ConnectionError(
-                                f"ring accept: expected rank {prv}, got "
-                                f"{hello}")
-                        ch.send({"ok": 1})
-                        accepted["ch"], accepted["sock"] = ch, conn
-                    except Exception as e:  # noqa: BLE001
-                        accepted["err"] = e
-
-                t = threading.Thread(target=_accept, daemon=True)
-                t.start()
-                nhost, nport = peers[nxt]
-                deadline = time.monotonic() + connect_timeout
-                nsock = None
-                while True:
-                    try:
-                        nsock = socket.create_connection(
-                            (nhost, nport), timeout=connect_timeout)
-                        break
-                    except OSError:
-                        if time.monotonic() >= deadline:
-                            raise
-                        time.sleep(0.1)
-                nsock.settimeout(connect_timeout)
-                nch = Channel(nsock, ring_key, server=False)
-                nch.send({"hello": rank, "to": nxt})
-                if nch.recv().get("ok") != 1:
-                    raise ConnectionError("ring connect: bad ack from next")
-                t.join(timeout=connect_timeout)
-                if "ch" not in accepted:
-                    raise accepted.get(
-                        "err", ConnectionError("ring accept timed out"))
-                # Generous steady-state deadline: a dead peer still wakes us
-                # (RST); a healthy-but-slow one must not.
-                for s_ in (nsock, accepted["sock"]):
-                    s_.settimeout(600.0)
-                    s_.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    # MB-scale chunk hops with default (~200 KiB) buffers
-                    # cost dozens of sender/receiver context-switch pairs
-                    # per hop — pure overhead when ranks share cores.
-                    for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
-                        try:
-                            s_.setsockopt(socket.SOL_SOCKET, opt, 4 << 20)
-                        except OSError:  # pragma: no cover - cap by sysctl
-                            pass
-                ring = cls(rank, world, nch, accepted["ch"], nsock,
-                           accepted["sock"], listener, on_bytes=on_bytes,
-                           on_wire=on_wire, tracer=tracer)
-                ok = True
-        except Exception as e:  # noqa: BLE001
-            log("warning",
-                f"peer ring data plane unavailable on rank {rank} ({e}); "
-                "falling back to the star relay")
-            ok = False
-        active = client.ring_confirm(ok)
-        if active and ring is not None:
-            return ring
-        if ring is not None:
-            ring.close()
-        elif listener is not None:
-            try:
-                listener.close()
-            except OSError:
-                pass
-        return None
-
-    # -- data movement ------------------------------------------------------
 
     def _send_loop(self) -> None:
         while True:
@@ -421,7 +492,7 @@ class _PeerRing:
                 self._err = e
                 return
 
-    def _send(self, arr: np.ndarray) -> None:
+    def send(self, arr: np.ndarray) -> None:
         # Raw-buffer frame (Channel.send_bytes): the receiver derives shape
         # and dtype from protocol position, so the chunk bytes skip pickle
         # entirely — on a CPU-bound host that is ~45% of the per-byte cost.
@@ -432,10 +503,12 @@ class _PeerRing:
         # PEP-3118 buffer format, so memoryview(arr) inside send_bytes
         # would raise; the byte view is dtype-agnostic and free.
         self._sendq.put(arr.view(np.uint8))
-        self.bytes_sent += int(arr.nbytes)
-        self._on_bytes(int(arr.nbytes))
+        n = int(arr.nbytes)
+        self.bytes_sent += n
+        self._owner._on_bytes(n)
+        self._owner._on_tier(n, self.next_tier)
 
-    def _recv(self, dtype, count: int) -> np.ndarray:
+    def recv(self, dtype, count: int) -> np.ndarray:
         if self._err is not None:
             raise ConnectionError(f"ring sender failed: {self._err}")
         buf = self._prev_ch.recv_bytes()
@@ -445,6 +518,59 @@ class _PeerRing:
                 f"ring frame size {len(buf)} != expected {expected}")
         return np.frombuffer(buf, dtype=dtype) if count else \
             np.empty(0, dtype=dtype)
+
+    def close(self) -> None:
+        self._sendq.put(self._STOP)
+        # Drain before closing: a rank finishes its allreduce the moment the
+        # last frame ARRIVES, but its own final send (which the next
+        # neighbour still needs) may sit in the queue — closing the socket
+        # now would destroy it and fail the neighbour with "peer closed".
+        # FIFO order means the _STOP is reached only after every pending
+        # frame hit the kernel; the bounded join keeps shutdown from
+        # hanging on a peer that already died mid-send.
+        self._sender.join(timeout=10.0)
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class _PeerRing:
+    """Authenticated peer-to-peer TCP ring for the Python engine's allreduce
+    data plane (reduce-scatter + allgather, the shape of the native ring.h
+    and the reference's NCCL ring, operations.cc:1221-1446). The FLAT plane:
+    one ring over all N ranks; cross-host links (host-boundary neighbours)
+    are tier-tagged so the hier A/B can measure what this plane ships over
+    the slow fabric. See :class:`_HierPlane` for the two-level ladder."""
+
+    def __init__(self, rank: int, world: int, next_ch, prev_ch,
+                 next_sock, prev_sock, listener,
+                 on_bytes=None, on_wire=None, on_tier=None, tracer=None,
+                 next_tier: str = "local", prev_tier: str = "local") -> None:
+        self.rank = rank
+        self.world = world
+        self._on_bytes = on_bytes or (lambda n: None)
+        # on_wire(wire_bytes, saved_bytes): compression telemetry — called
+        # per compressed hop with the bytes actually sent and the bytes the
+        # uncompressed plane would have sent minus that.
+        self._on_wire = on_wire or (lambda w, s: None)
+        self._on_tier = on_tier or (lambda n, t: None)
+        self._tracer = tracer
+        self.trace_ctx: Optional[dict] = None
+        self._links = _RingLinks(next_ch, prev_ch,
+                                 [next_sock, prev_sock, listener], self,
+                                 next_tier=next_tier, prev_tier=prev_tier)
+
+    @property
+    def bytes_sent(self) -> int:
+        return self._links.bytes_sent
+
+    def _send(self, arr: np.ndarray) -> None:
+        self._links.send(arr)
+
+    def _recv(self, dtype, count: int) -> np.ndarray:
+        return self._links.recv(dtype, count)
 
     def allreduce(self, arr: np.ndarray, average: bool,
                   wire_dtype=None) -> np.ndarray:
@@ -550,12 +676,319 @@ class _PeerRing:
         return out.reshape(arr.shape)
 
     def close(self) -> None:
-        self._sendq.put(self._STOP)
-        for s in self._socks:
+        self._links.close()
+
+
+class _HierPlane:
+    """Two-level, fabric-aware eager allreduce plane (ISSUE 7 tentpole; the
+    Python mirror of the native ladder in cc/src/engine.cc
+    ``allreduce_buffer`` and upstream HOROVOD_HIERARCHICAL_ALLREDUCE):
+
+    1. intra-host ring reduce-scatter among co-located ranks — local rank l
+       ends holding local chunk l reduced across this host (loopback
+       traffic only);
+    2. cross-host ring allreduce of chunk l among the ranks sharing local
+       slot l — each local rank is its host's LEADER for its own chunk, so
+       L leaders rings run in parallel, each carrying 1/local_size of the
+       payload over the slow fabric (2·(B/L)·(C-1)/C cross bytes per rank
+       vs the flat boundary rank's 2·B·(N-1)/N);
+    3. intra-host ring allgather redistributes the finished chunks.
+
+    The fold order — fixed leader starts (l+1) % L locally, (k+1) % C
+    across hosts, per-hop wire-dtype rounding exactly where the flat ring
+    rounds — is the canonical grid order of ``_ring_order_reduce(grid=...)``,
+    so results are deterministic, identical across ranks, and reproducible
+    by the pure-numpy oracle (cold == cached, and == the star executor run
+    under the same grid order)."""
+
+    def __init__(self, topo, on_bytes=None, on_wire=None, on_tier=None,
+                 tracer=None) -> None:
+        self.topo = topo
+        self.rank, self.world = topo.rank, topo.size
+        self.L, self.C = topo.local_size, topo.cross_size
+        self._on_bytes = on_bytes or (lambda n: None)
+        self._on_wire = on_wire or (lambda w, s: None)
+        self._on_tier = on_tier or (lambda n, t: None)
+        self._tracer = tracer
+        self.trace_ctx: Optional[dict] = None
+        self._local: Optional[_RingLinks] = None
+        self._cross: Optional[_RingLinks] = None
+        self._listeners: list = []
+
+    @property
+    def bytes_sent(self) -> int:
+        return ((self._local.bytes_sent if self._local else 0)
+                + (self._cross.bytes_sent if self._cross else 0))
+
+    def _connect(self, key: bytes, peers: dict, local_listener,
+                 cross_listener, connect_timeout: float) -> None:
+        from ..runner.network import derive_key
+
+        # Owned immediately: a failure between the two ring builds must
+        # still close both listeners through close().
+        self._listeners = [local_listener, cross_listener]
+        t = self.topo
+        # Intra-host ring: my host's members in local-rank order. Every
+        # link is same-host by construction (tier "local").
+        lgroup = [t.cross_rank * self.L + i for i in range(self.L)]
+        lends = [(peers[r]["host"], peers[r]["local_port"]) for r in lgroup]
+        nch, pch, ns, ps = _connect_ring(
+            local_listener, t.local_rank, self.L, lends,
+            derive_key(key, b"eager-ring-local"), "local", connect_timeout)
+        self._local = _RingLinks(nch, pch, [ns, ps, local_listener], self,
+                                 next_tier="local", prev_tier="local")
+        # Cross-host leaders ring: the ranks sharing my local slot, in
+        # cross-rank order. Every link crosses hosts by construction
+        # (tier "cross") — this is the ONLY stage that touches the slow
+        # fabric, carrying 1/local_size of the bytes.
+        xgroup = [c * self.L + t.local_rank for c in range(self.C)]
+        xends = [(peers[r]["host"], peers[r]["cross_port"]) for r in xgroup]
+        nch, pch, ns, ps = _connect_ring(
+            cross_listener, t.cross_rank, self.C, xends,
+            derive_key(key, b"eager-ring-cross"), "cross", connect_timeout)
+        self._cross = _RingLinks(nch, pch, [ns, ps, cross_listener], self,
+                                 next_tier="cross", prev_tier="cross")
+
+    def allreduce(self, arr: np.ndarray, average: bool,
+                  wire_dtype=None) -> np.ndarray:
+        """Two-level ring allreduce, bitwise-identical to
+        ``_ring_order_reduce(..., grid=(L, C))``.
+
+        Uncompressed: stage-1/2 partials travel at accumulator width
+        (float64 for floating dtypes); finished chunks circulate at native
+        width. Compressed (HOROVOD_COMPRESSION): every hop on BOTH fabrics
+        carries wire-dtype payloads — partials are rounded per hop and
+        accumulated in f32 (native ring.h parity, the same rounding chain
+        as the grid oracle), and the finished chunk is rounded once so
+        every rank stores the identical wire-representable value."""
+        arr = np.ascontiguousarray(arr)
+        L, C, world = self.L, self.C, self.world
+        l, c = self.topo.local_rank, self.topo.cross_rank
+        flat = arr.ravel()
+        lb = _chunk_bounds(flat.size, L)
+        acc_dt = _acc_start(flat[:0]).dtype
+        if wire_dtype is not None:
+            wire_acc = np.dtype(np.float32)
+            work = flat if flat.dtype == wire_acc else flat.astype(wire_acc)
+        else:
+            work = flat
+
+        def lchunk(i):
+            return work[lb[i]:lb[i + 1]]
+
+        def lsize(i):
+            return lb[i + 1] - lb[i]
+
+        ctx = self.trace_ctx
+        trace = self._tracer if ctx is not None else None
+
+        def _reduce_span(t0, tier, hop):
+            if trace:
+                trace.span(ctx["tid"], ctx["name"], "allreduce", "reduce",
+                           t0, time.monotonic_ns(), tier=tier, hop=hop)
+
+        # -- stage 1: intra-host reduce-scatter (fold start (i+1) % L) ----
+        if wire_dtype is None:
+            part = _acc_start(lchunk((l - 1) % L))
+        else:
+            part = lchunk((l - 1) % L)
+        for s in range(1, L):
+            if wire_dtype is None:
+                self._local.send(part)
+            else:
+                w = part.astype(wire_dtype)
+                self._local.send(w)
+                self._on_wire(
+                    int(w.nbytes),
+                    int(w.size) * int(acc_dt.itemsize) - int(w.nbytes))
+            i = (l - s - 1) % L
+            if wire_dtype is None:
+                part = self._local.recv(acc_dt, lsize(i))
+            else:
+                part = self._local.recv(wire_dtype, lsize(i)).astype(wire_acc)
+            r0 = time.monotonic_ns() if trace else 0
+            part += lchunk(i)
+            _reduce_span(r0, "local", s)
+        # `part` = this host's subtotal of local chunk l, accumulator width.
+
+        # -- stage 2: leaders ring allreduce of chunk l across hosts ------
+        nl = int(part.size)
+        cb = _chunk_bounds(nl, C)
+
+        def cchunk(i):
+            return part[cb[i]:cb[i + 1]]
+
+        def csz(i):
+            return cb[i + 1] - cb[i]
+
+        cpart = cchunk((c - 1) % C)
+        for s in range(1, C):
+            if wire_dtype is None:
+                self._cross.send(cpart)
+            else:
+                w = cpart.astype(wire_dtype)
+                self._cross.send(w)
+                self._on_wire(
+                    int(w.nbytes),
+                    int(w.size) * int(acc_dt.itemsize) - int(w.nbytes))
+            i = (c - s - 1) % C
+            if wire_dtype is None:
+                cpart = self._cross.recv(acc_dt, csz(i))
+            else:
+                cpart = self._cross.recv(wire_dtype, csz(i)).astype(wire_acc)
+            r0 = time.monotonic_ns() if trace else 0
+            cpart += cchunk(i)
+            _reduce_span(r0, "cross", s)
+        mine = _acc_finish(cpart, average, world, arr.dtype)
+        fin_l = np.empty(nl, dtype=arr.dtype)
+        native_itemsize = int(arr.dtype.itemsize)
+        if wire_dtype is None:
+            fin_l[cb[c]:cb[c + 1]] = mine
+            cur = mine
+            for s in range(1, C):
+                self._cross.send(cur)
+                i = (c - s) % C
+                cur = self._cross.recv(arr.dtype, csz(i))
+                fin_l[cb[i]:cb[i + 1]] = cur
+        else:
+            # Final rounding (the allgather hop): every rank — owner
+            # included — stores the identical wire-representable value;
+            # forwarding the wire bytes verbatim keeps it that way.
+            cur_w = mine.astype(wire_dtype)
+            fin_l[cb[c]:cb[c + 1]] = cur_w.astype(arr.dtype)
+            for s in range(1, C):
+                self._cross.send(cur_w)
+                self._on_wire(
+                    int(cur_w.nbytes),
+                    int(cur_w.size * native_itemsize - cur_w.nbytes))
+                i = (c - s) % C
+                cur_w = self._cross.recv(wire_dtype, csz(i))
+                fin_l[cb[i]:cb[i + 1]] = cur_w.astype(arr.dtype)
+
+        # -- stage 3: intra-host allgather of finished local chunks -------
+        out = np.empty_like(flat)
+        out[lb[l]:lb[l + 1]] = fin_l
+        if wire_dtype is None:
+            cur = fin_l
+            for s in range(1, L):
+                self._local.send(cur)
+                i = (l - s) % L
+                cur = self._local.recv(arr.dtype, lsize(i))
+                out[lb[i]:lb[i + 1]] = cur
+        else:
+            cur_w = fin_l.astype(wire_dtype)  # exact: values wire-representable
+            for s in range(1, L):
+                self._local.send(cur_w)
+                self._on_wire(
+                    int(cur_w.nbytes),
+                    int(cur_w.size * native_itemsize - cur_w.nbytes))
+                i = (l - s) % L
+                cur_w = self._local.recv(wire_dtype, lsize(i))
+                out[lb[i]:lb[i + 1]] = cur_w.astype(arr.dtype)
+        return out.reshape(arr.shape)
+
+    def close(self) -> None:
+        for links in (self._local, self._cross):
+            if links is not None:
+                links.close()
+        for li in self._listeners:
             try:
-                s.close()
+                li.close()
             except OSError:
                 pass
+
+
+def establish_data_plane(client: "_Client", topo, key: bytes, config,
+                         on_bytes=None, on_wire=None, on_tier=None,
+                         tracer=None, connect_timeout: float = 60.0):
+    """Negotiate and build this rank's eager data plane: the two-level
+    hierarchical plane (HOROVOD_HIERARCHICAL_ALLREDUCE on a multi-host
+    grid), the flat peer ring (PR 4), or None for the star relay.
+
+    Every rank must reach the same verdict (a half-plane deadlocks), so
+    activation is two coordinator barriers: ``ring_hello`` gathers every
+    rank's listener endpoints + host coordinates + hierarchical willingness
+    and answers with ONE plane verdict for the whole world (hier only when
+    every rank wants it and the coordinates form a homogeneous blocked
+    grid); ``ring_confirm`` gathers per-rank connect success — the plane is
+    active only when ALL ranks connected, else everyone falls back to the
+    star together."""
+    from ..runner.network import derive_key
+
+    rank, world = topo.rank, topo.size
+    enabled = world > 2 and bool(getattr(config, "ring_data_plane", True))
+    hier_want = bool(getattr(config, "hierarchical_allreduce", False))
+    grid_ok = topo.local_size > 1 and topo.cross_size > 1
+    if hier_want and world > 1 and not (enabled and grid_ok):
+        # Mirror the native engine's loud fallback (VERDICT r3: a silently
+        # ignored knob): say WHY the ladder cannot run here.
+        why = ("the ring data plane is disabled or the world is too small"
+               if not enabled else
+               "the topology is not a multi-host grid (need local_size>1 "
+               "and cross_size>1)")
+        log("warning",
+            f"HOROVOD_HIERARCHICAL_ALLREDUCE=1 but {why}; using the flat "
+            "eager plane", rank=rank)
+    offer_hier = enabled and hier_want and grid_ok
+    listeners: dict = {}
+    plane = None
+    ok = False
+    try:
+        info = {"enabled": enabled, "hier": offer_hier,
+                "local_rank": topo.local_rank, "local_size": topo.local_size,
+                "cross_rank": topo.cross_rank, "cross_size": topo.cross_size,
+                "host": "", "port": 0, "local_port": 0, "cross_port": 0}
+        if enabled:
+            for name in (("flat", "port"),
+                         *((("local", "local_port"), ("cross", "cross_port"))
+                           if offer_hier else ())):
+                li = socket.create_server(("0.0.0.0", 0), backlog=4)
+                li.settimeout(connect_timeout)
+                listeners[name[0]] = li
+                info[name[1]] = li.getsockname()[1]
+            info["host"] = client.local_host()
+        resp = client.ring_hello(info)
+        peers = resp.get("peers")
+        verdict = resp.get("plane")
+        if peers is not None and verdict == "hier":
+            plane = _HierPlane(topo, on_bytes=on_bytes, on_wire=on_wire,
+                               on_tier=on_tier, tracer=tracer)
+            plane._connect(key, peers, listeners.pop("local"),
+                           listeners.pop("cross"), connect_timeout)
+            ok = True
+        elif peers is not None:
+            nxt, prv = (rank + 1) % world, (rank - 1) % world
+            ends = [(peers[r]["host"], peers[r]["port"])
+                    for r in range(world)]
+            nch, pch, ns, ps = _connect_ring(
+                listeners["flat"], rank, world, ends,
+                derive_key(key, b"eager-ring"), "flat", connect_timeout)
+            tier = {True: "cross", False: "local"}
+            plane = _PeerRing(
+                rank, world, nch, pch, ns, ps, listeners.pop("flat"),
+                on_bytes=on_bytes, on_wire=on_wire, on_tier=on_tier,
+                tracer=tracer,
+                next_tier=tier[peers[nxt]["cross_rank"] != topo.cross_rank],
+                prev_tier=tier[peers[prv]["cross_rank"] != topo.cross_rank])
+            ok = True
+    except Exception as e:  # noqa: BLE001
+        log("warning",
+            f"peer data plane unavailable on rank {rank} ({e}); "
+            "falling back to the star relay")
+        ok = False
+    active = client.ring_confirm(ok) if world > 1 else False
+    # Unused listeners (the flat one under a hier verdict, or everything on
+    # failure/fallback) must not leak.
+    for li in listeners.values():
+        try:
+            li.close()
+        except OSError:
+            pass
+    if active and plane is not None:
+        return plane
+    if plane is not None:
+        plane.close()
+    return None
 
 
 # ------------------------------------------------------------------ engine
@@ -569,13 +1002,14 @@ class PyEngine:
     def __init__(self, topo: Topology, config: Config) -> None:
         self.topo = topo
         self.config = config
-        if config.hierarchical_allreduce or config.hierarchical_allgather:
-            # Only the native engine implements the two-level rings; a silent
-            # no-op here was VERDICT r3 weak #3.
+        if config.hierarchical_allgather:
+            # The Python engine implements the hierarchical ALLREDUCE plane
+            # (ISSUE 7); the two-stage allgather remains native-only — keep
+            # that knob's no-op loud (VERDICT r3 weak #3).
             log("warning",
-                "HOROVOD_HIERARCHICAL_* is implemented by the native engine "
-                "only; the Python fallback engine runs flat collectives "
-                "(set HOROVOD_ENGINE=native to honor the knob)")
+                "HOROVOD_HIERARCHICAL_ALLGATHER is implemented by the "
+                "native engine only; the Python engine runs flat "
+                "allgathers (set HOROVOD_ENGINE=native to honor the knob)")
         self.handles = HandleManager()
         self._shutdown = threading.Event()
         self._wake = threading.Event()   # wake-on-enqueue (adaptive cycle)
@@ -656,6 +1090,17 @@ class PyEngine:
             "horovod_wire_bytes_saved_total",
             help="bytes the compressed wire avoided sending vs the "
                  "uncompressed plane", plane="eager")
+        # Per-fabric-tier wire accounting (ISSUE 7): every byte the eager
+        # data plane puts on a link, billed to that link's fabric — local
+        # (same host: shm/loopback) vs cross (the host boundary / DCN).
+        # The hier A/B and tools/hier_smoke.py assert the 1/local_size
+        # cross-byte cut on exactly these series.
+        self._m_tier = {
+            t: self._metrics.counter(
+                "horovod_wire_bytes_total",
+                help="eager data-plane bytes sent per fabric tier "
+                     "(local = same host, cross = host boundary)", tier=t)
+            for t in ("local", "cross")}
         if topo.size > 1:
             addr = os.environ.get("HOROVOD_COORD_ADDR")
             if not addr:
@@ -677,11 +1122,6 @@ class PyEngine:
                                            cache_capacity=cache_cap)
                 self._coord.start()
             self._client = _Client(host, int(port), topo.rank, key=key)
-            # Ring data plane: worlds of 3+ only (a 2-world ring IS the star
-            # shape), every rank must agree (establish() runs the hello +
-            # confirm barriers and returns None when any rank fell back).
-            want_ring = (topo.size > 2
-                         and bool(getattr(config, "ring_data_plane", True)))
             # Clock alignment for the trace (tracing/clock.py): estimate
             # this rank's monotonic-clock offset to the coordinator over the
             # control channel BEFORE any spans matter. Rank 0 IS the
@@ -699,11 +1139,17 @@ class PyEngine:
                     log("warning",
                         f"trace clock probe failed ({e}); spans stay on "
                         "the local clock", rank=topo.rank)
-            self._ring = _PeerRing.establish(
-                self._client, topo, key, enabled=want_ring,
+            # Data plane: worlds of 3+ only (a 2-world ring IS the star
+            # shape), every rank must agree (establish_data_plane runs the
+            # hello + confirm barriers and returns None when any rank fell
+            # back). On a multi-host grid with the knob set, the flat peer
+            # ring is replaced by the two-level hierarchical plane.
+            self._ring = establish_data_plane(
+                self._client, topo, key, config,
                 on_bytes=self._m_ring.inc,
                 on_wire=lambda w, s: (self._m_wire.inc(w),
                                       self._m_wire_saved.inc(s)),
+                on_tier=lambda n, t: self._m_tier[t].inc(n),
                 tracer=self._trace)
         # Stall watchdog (ISSUE 2): keeps reporting even when the loop is
         # wedged inside a blocking exchange, names missing ranks on the
@@ -841,6 +1287,10 @@ class PyEngine:
         out = {
             "enabled": self._mirror is not None,
             "ring_active": self._ring is not None,
+            # Which data plane carries allreduce bytes: the two-level
+            # ladder, the flat peer ring, or the rank-0 star relay.
+            "plane": ("hier" if isinstance(self._ring, _HierPlane)
+                      else "ring" if self._ring is not None else "star"),
             "compression": self._compression,
             # `is not None`, not truthiness: CacheMirror defines __len__,
             # so a freshly-flushed (empty) mirror is falsy.
@@ -1181,7 +1631,21 @@ class _Coordinator:
         if not self.key:
             raise HorovodInternalError(
                 "coordinator requires a shared HOROVOD_SECRET key")
-        self.server = socket.create_server((host, port), backlog=world + 4, reuse_port=False)
+        # Brief bind retry: an elastic re-rendezvous rebuilds the
+        # coordinator on the SAME address moments after the previous
+        # generation's server closed — lingering accepted sockets can hold
+        # the port for a beat (EADDRINUSE despite SO_REUSEADDR). A dead
+        # port stays dead past the window and still raises.
+        deadline = time.monotonic() + 15.0
+        while True:
+            try:
+                self.server = socket.create_server(
+                    (host, port), backlog=world + 4, reuse_port=False)
+                break
+            except OSError as e:
+                if e.errno != 98 or time.monotonic() >= deadline:  # EADDRINUSE
+                    raise
+                time.sleep(0.2)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._lock = threading.Lock()
@@ -1202,9 +1666,13 @@ class _Coordinator:
         self._tombstones: dict[int, tuple[tuple, dict, set]] = {}
         # --- ring data plane negotiation ---
         self.ring_active = False
-        self._ring_endpoints: dict[int, Optional[tuple[str, int]]] = {}
+        self._ring_endpoints: dict[int, Optional[dict]] = {}
+        self._ring_plane: Optional[str] = None   # "flat" | "hier" verdict
         self._ring_votes: dict[int, bool] = {}
         self._ring_seq = 0
+        # Result-bearing responses currently between claim and socket write
+        # (the stop() drain waits on this as well as on unclaimed results).
+        self._owed = 0
         # --- distributed tracing (ISSUE 6) ---
         # The coordinator derives each collective's trace ID from its OWN
         # per-name execution counter — the same deterministic sequence the
@@ -1218,7 +1686,21 @@ class _Coordinator:
         t.start()
         self._threads.append(t)
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout: float = 5.0) -> None:
+        # A star-plane result is delivered on each rank's NEXT poll, so at
+        # the moment rank 0's own collective completes, peers may not have
+        # claimed theirs yet — tearing the coordinator down now (followed by
+        # process exit) fails those ranks with "peer closed" while their
+        # result sits computed in self._results. Drain first: wait until
+        # every computed result has been claimed by every rank AND every
+        # claimed response has actually hit the socket. Bounded, because a
+        # dead peer never claims.
+        deadline = time.monotonic() + drain_timeout
+        with self._cv:
+            while ((self._results or self._owed)
+                   and not self._stop.is_set()
+                   and time.monotonic() < deadline):
+                self._cv.wait(timeout=0.02)
         self._stop.set()
         with self._cv:
             self._cv.notify_all()
@@ -1246,11 +1728,16 @@ class _Coordinator:
                     out = self._handle_exchange(
                         msg["rank"], msg["requests"], msg["arrays"],
                         msg.get("bits", 0))
-                    _send_msg(conn, out, self.key)
+                    try:
+                        _send_msg(conn, out, self.key)
+                    finally:
+                        if out["results"]:
+                            with self._cv:
+                                self._owed -= 1
+                                self._cv.notify_all()
                 elif kind == "ring_hello":
                     _send_msg(conn, self._handle_ring_hello(
-                        msg["rank"], msg["host"], msg["port"],
-                        msg.get("enabled", True)), self.key)
+                        msg["rank"], msg.get("info") or {}), self.key)
                 elif kind == "ring_confirm":
                     _send_msg(conn, self._handle_ring_confirm(
                         msg["rank"], bool(msg["ok"])), self.key)
@@ -1274,10 +1761,15 @@ class _Coordinator:
 
     # -- ring negotiation barriers
 
-    def _handle_ring_hello(self, rank: int, host: str, port: int,
-                           enabled: bool) -> dict:
+    def _handle_ring_hello(self, rank: int, info: dict) -> dict:
+        """Data-plane registration barrier. Gathers every rank's endpoints
+        + host coordinates + hierarchical willingness, then answers ONE
+        plane verdict for the whole world: ``hier`` when every rank offered
+        the two-level plane and the coordinates form a homogeneous blocked
+        grid (plan_grid — the Python analyze_hier), ``flat`` when every
+        rank has the ring enabled, peers None otherwise (star)."""
         with self._cv:
-            self._ring_endpoints[rank] = (host, port) if enabled else None
+            self._ring_endpoints[rank] = info if info.get("enabled") else None
             self._cv.notify_all()
             deadline = time.monotonic() + 120.0
             while (len(self._ring_endpoints) < self.world
@@ -1287,7 +1779,25 @@ class _Coordinator:
             if (len(self._ring_endpoints) < self.world
                     or any(v is None for v in self._ring_endpoints.values())):
                 return {"peers": None}
-            return {"peers": dict(self._ring_endpoints)}
+            if self._ring_plane is None:
+                # Compute the verdict exactly once over the complete map;
+                # every waiter returns the same answer (an asymmetric
+                # verdict would deadlock establishment).
+                infos = self._ring_endpoints
+                plane = "flat"
+                if all(i.get("hier") for i in infos.values()):
+                    coords = {r: (i.get("local_rank", 0),
+                                  i.get("local_size", 1),
+                                  i.get("cross_rank", r),
+                                  i.get("cross_size", self.world))
+                              for r, i in infos.items()}
+                    if (plan_grid(coords) is not None
+                            and all(i.get("local_port") and i.get("cross_port")
+                                    for i in infos.values())):
+                        plane = "hier"
+                self._ring_plane = plane
+            return {"peers": dict(self._ring_endpoints),
+                    "plane": self._ring_plane}
 
     def _handle_ring_confirm(self, rank: int, ok: bool) -> dict:
         with self._cv:
@@ -1465,6 +1975,10 @@ class _Coordinator:
                     if len(self._claimed[n]) == self.world:
                         del self._results[n]
                         del self._claimed[n]
+            if out:
+                # Owed until _serve's send completes — stop()'s drain must
+                # not declare victory between the claim and the write.
+                self._owed += 1
             return {"results": out, "assign": assign,
                     "evict": self._drain_evictions(rank)}
 
@@ -1631,14 +2145,15 @@ class _Client:
         listener (native Client::local_host analog)."""
         return self.sock.getsockname()[0]
 
-    def ring_hello(self, host: str, port: int, enabled: bool = True):
-        """Registration barrier for the peer ring: returns the rank-indexed
-        endpoint map, or None when any rank has the plane disabled."""
+    def ring_hello(self, info: dict) -> dict:
+        """Registration barrier for the eager data plane: ships this rank's
+        endpoints + host coordinates + hierarchical willingness, returns
+        ``{"peers": {rank: info} | None, "plane": "flat" | "hier"}`` — the
+        coordinator's single world-wide plane verdict."""
         with self._lock:
             _send_msg(self.sock, {"kind": "ring_hello", "rank": self.rank,
-                                  "host": host, "port": port,
-                                  "enabled": enabled}, self.key)
-            return _recv_msg(self.sock, self.key).get("peers")
+                                  "info": dict(info)}, self.key)
+            return _recv_msg(self.sock, self.key)
 
     def ring_confirm(self, ok: bool) -> bool:
         """Connect-success barrier: True only when EVERY rank connected."""

@@ -73,15 +73,59 @@ def _resolved_compression(compression):
     return Compression.by_name(Config.from_env().compression)
 
 
+def _resolved_hierarchical(hierarchical, op, ici_axis: str,
+                           dcn_axis: str) -> bool:
+    """Resolve the previously-dormant HOROVOD_HIERARCHICAL_ALLREDUCE knob
+    for the compiled plane (ISSUE 7): ``None`` reads the env — the same
+    knob both eager engines honor — so one env var flips every data plane
+    onto the two-level ladder.
+
+    The env-resolved verdict degrades LOUDLY to the flat allreduce when the
+    ladder cannot serve the call (non-SUM/AVERAGE reductions — the ladder
+    is a sum machine, mirroring fusion.py's guard — or a mesh without the
+    ('dcn','ici') axes, e.g. the plain 1-D 'hvd' mesh). An EXPLICIT
+    ``hierarchical=True`` argument keeps raising in fusion.py instead:
+    the caller asked for the ladder by hand and deserves the error."""
+    explicit = hierarchical is not None
+    if hierarchical is None:
+        hierarchical = Config.from_env().hierarchical_allreduce
+    if not hierarchical:
+        return False
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        if explicit:
+            return True   # fusion.py raises its clear SUM/AVERAGE-only error
+        from ..utils.logging import log
+
+        log("warning",
+            f"hierarchical allreduce supports SUM/AVERAGE only; running "
+            f"{op.name} on the flat allreduce")
+        return False
+    if not explicit and (fusion._axis_size(ici_axis) is None
+                         or fusion._axis_size(dcn_axis) is None):
+        from ..utils.logging import log
+
+        log("warning",
+            "HOROVOD_HIERARCHICAL_ALLREDUCE=1 but the active mesh has no "
+            f"({dcn_axis!r}, {ici_axis!r}) axes (use "
+            "horovod_tpu.parallel.mesh.hierarchical_mesh); running the "
+            "flat allreduce")
+        return False
+    return True
+
+
 def allreduce_gradients(
     grads,
     axis_name: str = HVD_AXIS,
     op: ReduceOp = ReduceOp.AVERAGE,
     compression: type[Compressor] | None = None,
     fusion_threshold: int | None = None,
-    hierarchical: bool = False,
+    hierarchical: bool | None = None,
     num_buckets: int | None = None,
     compression_min_bytes: int | None = None,
+    ici_axis: str = "ici",
+    dcn_axis: str = "dcn",
+    dcn_compression=None,
+    dcn_threshold: int | None = None,
 ):
     """Fused allreduce of a gradient pytree (the DistributedOptimizer hot
     path). ``fusion_threshold=None`` reads HOROVOD_FUSION_THRESHOLD (default
@@ -91,10 +135,17 @@ def allreduce_gradients(
     overlap communication with the rest of the backward pass);
     ``compression=None`` reads HOROVOD_COMPRESSION (eligible buckets are
     cast to the 16-bit wire dtype around their psum — half the wire bytes;
-    see docs/compression.md for the per-bucket opt-outs)."""
+    see docs/compression.md for the per-bucket opt-outs);
+    ``hierarchical=None`` reads HOROVOD_HIERARCHICAL_ALLREDUCE (ISSUE 7:
+    each bucket rides the psum_scatter(ici) → psum(dcn) → all_gather(ici)
+    ladder on a ('dcn','ici') mesh, with ``dcn_compression`` /
+    ``dcn_threshold`` tiering the wire dtype and bucket size for the slow
+    fabric — docs/hierarchical.md)."""
     fusion_threshold = _resolved_threshold(fusion_threshold)
     num_buckets = _resolved_num_buckets(num_buckets)
     compression = _resolved_compression(compression)
+    hierarchical = _resolved_hierarchical(hierarchical, op, ici_axis,
+                                          dcn_axis)
 
     return fusion.fused_allreduce(
         grads,
@@ -102,9 +153,13 @@ def allreduce_gradients(
         threshold=fusion_threshold,
         op=op,
         hierarchical=hierarchical,
+        ici_axis=ici_axis,
+        dcn_axis=dcn_axis,
         num_buckets=num_buckets,
         compression=compression,
         compression_min_bytes=compression_min_bytes,
+        dcn_compression=dcn_compression,
+        dcn_threshold=dcn_threshold,
     )
 
 
@@ -114,10 +169,14 @@ def DistributedOptimizer(
     op: ReduceOp = ReduceOp.AVERAGE,
     compression: type[Compressor] | None = None,
     fusion_threshold: int | None = None,
-    hierarchical: bool = False,
+    hierarchical: bool | None = None,
     backward_passes_per_step: int = 1,
     num_buckets: int | None = None,
     compression_min_bytes: int | None = None,
+    ici_axis: str = "ici",
+    dcn_axis: str = "dcn",
+    dcn_compression=None,
+    dcn_threshold: int | None = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so that ``update()`` first averages gradients
     across the mesh axis, exactly where the reference wraps
@@ -142,6 +201,13 @@ def DistributedOptimizer(
     fp32 exponent range, so no loss scaling. The wire dtype joins the
     ``(fusion_threshold, num_buckets)`` joint autotune as a third dimension
     (``bench.py --compression-ab``). Full story: docs/compression.md.
+
+    ``hierarchical`` (or HOROVOD_HIERARCHICAL_ALLREDUCE) routes every
+    bucket over the two-level fabric ladder on a ``('dcn','ici')`` mesh,
+    with ``dcn_compression`` / ``dcn_threshold`` selecting the slow
+    fabric's wire dtype and bucket cap independently of the ICI tier — the
+    multi-pod configuration (docs/hierarchical.md). Joins the autotune as
+    the FOURTH dimension (``jax.autotune.tune(hierarchicals=...)``).
     """
 
     def update_fn(grads, state, params=None, **extra):
@@ -154,6 +220,10 @@ def DistributedOptimizer(
             hierarchical=hierarchical,
             num_buckets=num_buckets,
             compression_min_bytes=compression_min_bytes,
+            ici_axis=ici_axis,
+            dcn_axis=dcn_axis,
+            dcn_compression=dcn_compression,
+            dcn_threshold=dcn_threshold,
         )
         return optimizer.update(reduced, state, params, **extra)
 

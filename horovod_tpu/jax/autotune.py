@@ -53,6 +53,7 @@ class Measurement:
     steps_per_s: float
     num_buckets: int = 1
     compression: str = "none"
+    hierarchical: bool = False
 
     @property
     def config(self) -> dict:
@@ -60,6 +61,8 @@ class Measurement:
                "num_buckets": self.num_buckets}
         if self.compression != "none":
             out["compression"] = self.compression
+        if self.hierarchical:
+            out["hierarchical"] = True
         return out
 
 
@@ -72,21 +75,27 @@ class TuneReport:
         """Human-readable measured knob curve for docs/logs."""
         with_buckets = any(m.num_buckets != 1 for m in self.table)
         with_comp = any(m.compression != "none" for m in self.table)
+        with_hier = any(m.hierarchical for m in self.table)
         head = "branch | fusion_threshold | "
         if with_buckets:
             head += "num_buckets | "
         if with_comp:
             head += "compression | "
+        if with_hier:
+            head += "ladder | "
         lines = [head + "steps/s"]
         for m in sorted(self.table,
                         key=lambda m: (str(m.branch), m.fusion_threshold,
-                                       m.num_buckets, m.compression)):
+                                       m.num_buckets, m.compression,
+                                       m.hierarchical)):
             b = ",".join(f"{k}={v}" for k, v in sorted(m.branch.items())) or "-"
             mid = f"{m.fusion_threshold >> 20} MiB | "
             if with_buckets:
                 mid += f"{m.num_buckets} | "
             if with_comp:
                 mid += f"{m.compression} | "
+            if with_hier:
+                mid += ("hier | " if m.hierarchical else "flat | ")
             lines.append(f"{b} | {mid}{m.steps_per_s:.2f}")
         return "\n".join(lines)
 
@@ -215,6 +224,7 @@ def tune(step_factory: Callable[..., Callable[[], None]],
          branches: Optional[Sequence[dict]] = None,
          num_buckets: Optional[Sequence[int]] = None,
          compressions: Optional[Sequence[str]] = None,
+         hierarchicals: Optional[Sequence[bool]] = None,
          warmup: int = 2, iters: int = 5, reps: int = 3,
          gp_rounds: int = 2, log_path: Optional[str] = None,
          verbose: bool = False) -> TuneReport:
@@ -245,26 +255,40 @@ def tune(step_factory: Callable[..., Callable[[], None]],
     treats its hierarchical categoricals beside the numeric knobs. The
     factory is then called with an extra ``compression=`` kwarg (a
     HOROVOD_COMPRESSION name).
+
+    ``hierarchicals``: a grid of ladder choices (e.g. ``(False, True)``)
+    joins as the FOURTH joint dimension (ISSUE 7) — categorical like the
+    wire dtype, explored exhaustively, with the continuous (threshold,
+    buckets) GP/EI refinement run per (compression, hierarchical) branch.
+    This is the compiled-plane mirror of the native ParameterManager's
+    hier_allreduce categorical (cc/src/autotuner.h): the tuner decides
+    per PLATFORM whether the two-level ladder pays, instead of trusting
+    the env knob. The factory is then called with an extra
+    ``hierarchical=`` kwarg (bool).
     """
     branches = list(branches) if branches is not None else [{}]
     tune_buckets = num_buckets is not None
     bucket_grid = tuple(num_buckets) if tune_buckets else (1,)
     tune_comp = compressions is not None
     comp_grid = tuple(compressions) if tune_comp else ("none",)
+    tune_hier = hierarchicals is not None
+    hier_grid = tuple(hierarchicals) if tune_hier else (False,)
     table: list[Measurement] = []
     log_rows = []
 
     def run(branch: dict, th: int, nb: int = 1,
-            comp: str = "none") -> Measurement:
+            comp: str = "none", hier: bool = False) -> Measurement:
         kw = dict(branch)
         if tune_buckets:
             kw["num_buckets"] = nb
         if tune_comp:
             kw["compression"] = comp
+        if tune_hier:
+            kw["hierarchical"] = hier
         made = step_factory(fusion_threshold=th, **kw)
         step, sync = made if isinstance(made, tuple) else (made, None)
         rate = measure_steps_per_s(step, warmup, iters, reps, sync=sync)
-        m = Measurement(branch, th, rate, nb, comp)
+        m = Measurement(branch, th, rate, nb, comp, hier)
         table.append(m)
         token = ";".join(f"{k}={v}" for k, v in sorted(branch.items())) or "-"
         row = [token, str(th)]
@@ -272,37 +296,43 @@ def tune(step_factory: Callable[..., Callable[[], None]],
             row.append(str(nb))
         if tune_comp:
             row.append(comp)
+        if tune_hier:
+            row.append("hier" if hier else "flat")
         log_rows.append(",".join(row + [f"{rate:.4f}"]))
         if verbose:
             import sys
 
             buckets_txt = f" buckets={nb}" if tune_buckets else ""
             comp_txt = f" wire={comp}" if tune_comp else ""
+            hier_txt = (" ladder=hier" if hier else " ladder=flat") \
+                if tune_hier else ""
             print(f"  autotune: {branch} threshold={th >> 20}MiB"
-                  f"{buckets_txt}{comp_txt} -> {rate:.2f} steps/s",
+                  f"{buckets_txt}{comp_txt}{hier_txt} -> {rate:.2f} steps/s",
                   file=sys.stderr, flush=True)
         return m
 
     for branch in branches:
         for comp in comp_grid:
-            measured: dict[tuple[int, int], float] = {}
-            for th in thresholds:
-                for nb in bucket_grid:
-                    measured[(th, nb)] = run(branch, th, nb,
-                                             comp).steps_per_s
-            lo, hi = min(thresholds), max(thresholds)
-            for _ in range(gp_rounds):
-                if tune_buckets:
-                    nxt = _ei_suggest_joint(
-                        measured, (lo, hi),
-                        (min(bucket_grid), max(bucket_grid)))
-                else:
-                    flat = {th: v for (th, _), v in measured.items()}
-                    th_next = _ei_suggest(flat, lo, hi)
-                    nxt = (th_next, 1) if th_next is not None else None
-                if nxt is None or nxt in measured:
-                    break
-                measured[nxt] = run(branch, *nxt, comp).steps_per_s
+            for hier in hier_grid:
+                measured: dict[tuple[int, int], float] = {}
+                for th in thresholds:
+                    for nb in bucket_grid:
+                        measured[(th, nb)] = run(branch, th, nb, comp,
+                                                 hier).steps_per_s
+                lo, hi = min(thresholds), max(thresholds)
+                for _ in range(gp_rounds):
+                    if tune_buckets:
+                        nxt = _ei_suggest_joint(
+                            measured, (lo, hi),
+                            (min(bucket_grid), max(bucket_grid)))
+                    else:
+                        flat = {th: v for (th, _), v in measured.items()}
+                        th_next = _ei_suggest(flat, lo, hi)
+                        nxt = (th_next, 1) if th_next is not None else None
+                    if nxt is None or nxt in measured:
+                        break
+                    measured[nxt] = run(branch, *nxt, comp,
+                                        hier).steps_per_s
 
     table.sort(key=lambda m: -m.steps_per_s)
     if log_path:
@@ -312,6 +342,8 @@ def tune(step_factory: Callable[..., Callable[[], None]],
                 cols.append("num_buckets")
             if tune_comp:
                 cols.append("compression")
+            if tune_hier:
+                cols.append("ladder")
             f.write(",".join(cols + ["steps_per_s"]) + "\n")
             f.write("\n".join(log_rows) + "\n")
     return TuneReport(best=table[0], table=table)

@@ -143,6 +143,51 @@ def last_wire_plan() -> Optional[tuple]:
     return _last_wire_plan
 
 
+# Latest fabric-tier plan of the hierarchical compiled path (ISSUE 7):
+# {"hierarchical": bool, "ici_wire": str, "dcn_wire": str, "ici_size": int,
+#  "bytes_per_step": {"ici": n, "dcn": n}, "buckets": int}.
+_last_tier_plan: Optional[dict] = None
+
+
+def record_tier_plan(hierarchical: bool, ici_wire: str, dcn_wire: str,
+                     ici_size: int, bucket_bytes: list,
+                     dcn_bucket_bytes: list) -> dict:
+    """Record the latest fused_allreduce call's per-fabric-tier plan
+    (trace time, once per compile — same reasoning as record_wire_plan).
+
+    ``bucket_bytes``: per-bucket bytes each device moves over ICI (the
+    reduce-scatter/all-gather stages, at the ICI wire dtype);
+    ``dcn_bucket_bytes``: per-bucket bytes each device moves over DCN (the
+    cross-host psum carries 1/ici_size of the bucket, at the DCN wire
+    dtype). For a flat plan the DCN list is empty and ``hierarchical`` is
+    False — the gauges always say which ladder the trace compiled."""
+    global _last_tier_plan
+    reg = registry()
+    plan = {"hierarchical": bool(hierarchical), "ici_wire": ici_wire,
+            "dcn_wire": dcn_wire, "ici_size": int(ici_size),
+            "buckets": len(bucket_bytes),
+            "bytes_per_step": {"ici": int(sum(bucket_bytes)),
+                               "dcn": int(sum(dcn_bucket_bytes))}}
+    reg.gauge(
+        "horovod_compiled_hierarchical",
+        help="1 when the latest compiled plan rides the two-level "
+             "(ici, dcn) ladder, 0 for the flat allreduce").set(
+        1.0 if hierarchical else 0.0)
+    for tier, total in plan["bytes_per_step"].items():
+        reg.gauge(
+            "horovod_compiled_tier_bytes_per_step",
+            help="gradient bytes per step per device the latest compiled "
+                 "plan moves over each fabric tier", tier=tier).set(total)
+    reg.set_info("compiled_tier_plan", plan)
+    _last_tier_plan = plan
+    return plan
+
+
+def last_tier_plan() -> Optional[dict]:
+    """The most recent fused_allreduce trace's fabric-tier plan."""
+    return _last_tier_plan
+
+
 # --------------------------------------------------------------- trace parse
 
 

@@ -164,17 +164,29 @@ def hierarchical_allreduce(
     ici_axis: str = ICI_AXIS,
     dcn_axis: str = DCN_AXIS,
     average: bool = True,
+    dcn_wire_dtype=None,
 ):
     """Two-level allreduce: ReduceScatter over ICI → Allreduce over DCN →
     AllGather over ICI (reference operations.cc:1284-1436). DCN traffic is
     1/ici_size of the flat allreduce — the same bandwidth win the reference's
     NCCL+MPI ladder buys on RoCE clusters.
 
+    ``dcn_wire_dtype`` (ISSUE 7 per-fabric-tier wire dtype): cast the
+    already-scattered shard to this dtype around the cross-host ``psum``
+    only — the slow fabric carries 16-bit payloads while both ICI stages
+    stay at full width. Combined with the 1/ici_size scatter this is where
+    the multi-pod bytes go from B to B/(2·ici_size) per device.
+
     Requires dim 0 divisible by the ici axis size; callers fuse into flat
     buffers padded to the axis size (fusion.py handles this).
     """
     scattered = lax.psum_scatter(x, ici_axis, scatter_dimension=0, tiled=True)
+    orig = scattered.dtype
+    if dcn_wire_dtype is not None and jnp.dtype(dcn_wire_dtype) != orig:
+        scattered = scattered.astype(dcn_wire_dtype)
     reduced = lax.psum(scattered, dcn_axis)
+    if reduced.dtype != orig:
+        reduced = reduced.astype(orig)
     out = lax.all_gather(reduced, ici_axis, axis=0, tiled=True)
     if average:
         out = out / (axis_size(ici_axis) * axis_size(dcn_axis))

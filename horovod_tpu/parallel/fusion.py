@@ -19,6 +19,7 @@ Differences by design:
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
@@ -232,6 +233,8 @@ def fused_allreduce(
     num_buckets: int = 1,
     compression=None,
     compression_min_bytes: Optional[int] = None,
+    dcn_compression=None,
+    dcn_threshold: Optional[int] = None,
 ):
     """The Horovod fast path: fuse → (compress) → one collective per bucket →
     (decompress) → unfuse.
@@ -248,7 +251,17 @@ def fused_allreduce(
     ``num_buckets > 1`` switches to the reverse-backward-order overlap plan
     (build_plan): K independent collectives, issued last-layer-first, each
     becoming schedulable as soon as its bucket's gradients exist — the knob
-    the A/B bench and the autotuner drive (HOROVOD_NUM_BUCKETS)."""
+    the A/B bench and the autotuner drive (HOROVOD_NUM_BUCKETS).
+
+    Fabric-aware tiering (ISSUE 7, ``hierarchical=True`` only):
+    ``dcn_compression`` picks a wire dtype for the cross-host psum alone —
+    full width on ICI, 16-bit on DCN (None inherits HOROVOD_DCN_COMPRESSION
+    from the env, which itself defaults to the global ``compression``);
+    ``dcn_threshold`` caps the bytes any one bucket ships over DCN (the
+    ladder scatters 1/ici_size of the bucket cross-host, so the effective
+    bucket cap becomes ``dcn_threshold * ici_size``; None reads
+    HOROVOD_DCN_FUSION_THRESHOLD, 0 = no separate cap). The per-tier plan
+    lands in trace-time gauges (metrics.record_tier_plan)."""
     pad_to = 1
     if hierarchical and op not in (collectives.ReduceOp.SUM,
                                    collectives.ReduceOp.AVERAGE):
@@ -267,6 +280,15 @@ def fused_allreduce(
             raise ValueError(
                 f"hierarchical fusion needs the size of axis {ici_axis!r}: "
                 f"call inside shard_map/pmap or under `with mesh:`")
+        # Per-fabric-tier bucket sizing: cap what any single bucket ships
+        # over the slow fabric. A bucket's DCN shard is nbytes/ici_size, so
+        # a DCN cap of D bounds bucket bytes at D*ici_size — composed with
+        # the plain threshold as a min (both remain hard caps).
+        if dcn_threshold is None:
+            dcn_threshold = _env_int("HOROVOD_DCN_FUSION_THRESHOLD", 0)
+        if dcn_threshold and dcn_threshold > 0:
+            cap = int(dcn_threshold) * int(pad_to)
+            threshold = min(threshold, cap) if threshold > 0 else cap
     plan = build_plan(tree, threshold, pad_to=pad_to, num_buckets=num_buckets)
     # Telemetry (ISSUE 2): record the bucket geometry — count, per-bucket
     # bytes in issue order, buffer occupancy, planned overlap bound — in
@@ -302,13 +324,42 @@ def fused_allreduce(
         compression_name(compression), [w is not None for w in wire])
     buffers = [b.astype(w) if w is not None else b
                for b, w in zip(buffers, wire)]
+    # Per-fabric-tier wire dtype (ISSUE 7): the DCN psum of the hierarchical
+    # ladder may run at its own (usually narrower) wire dtype. Computed
+    # against the AS-SHIPPED buffer dtype — a bucket already cast to a
+    # 16-bit ICI wire opts out (nothing narrower to gain), and all the
+    # per-bucket opt-outs of wire_dtype_for_bucket apply unchanged.
+    dcn_wire = [None] * len(buffers)
+    if hierarchical:
+        if dcn_compression is None:
+            dcn_compression = (os.environ.get("HOROVOD_DCN_COMPRESSION", "")
+                               or compression)
+        dcn_wire = [wire_dtype_for_bucket(dcn_compression, buf.dtype,
+                                          int(buf.nbytes), op,
+                                          compression_min_bytes)
+                    for buf in buffers]
+    from ..metrics import record_tier_plan
+
+    record_tier_plan(
+        hierarchical,
+        ici_wire=compression_name(compression),
+        dcn_wire=(compression_name(dcn_compression) if hierarchical
+                  else ""),
+        ici_size=pad_to,
+        bucket_bytes=[int(b.nbytes) for b in buffers],
+        dcn_bucket_bytes=[
+            (int(b.size) // pad_to) * int(jnp.dtype(dw).itemsize
+                                          if dw is not None
+                                          else b.dtype.itemsize)
+            for b, dw in zip(buffers, dcn_wire)] if hierarchical else [])
     with jax.named_scope(f"hvd_fused_allreduce_k{len(buffers)}"):
         if hierarchical:
             reduced = [
                 collectives.hierarchical_allreduce(
                     buf, ici_axis=ici_axis, dcn_axis=dcn_axis,
-                    average=(op == collectives.ReduceOp.AVERAGE))
-                for buf in buffers
+                    average=(op == collectives.ReduceOp.AVERAGE),
+                    dcn_wire_dtype=dw)
+                for buf, dw in zip(buffers, dcn_wire)
             ]
         else:
             reduced = collectives.bucketed_allreduce(buffers, axis_name, op)

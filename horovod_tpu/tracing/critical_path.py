@@ -59,6 +59,7 @@ def analyze(spans: list[dict]) -> dict:
             by_tid[s["tid"]].append(s)
 
     phase_ns = dict.fromkeys(PHASES, 0)
+    wire_tier_ns: dict[str, int] = defaultdict(int)
     skew_by_rank: dict[int, int] = defaultdict(int)
     wait_by_rank: dict[int, int] = defaultdict(int)
     per_tid: dict[str, dict] = {}
@@ -80,6 +81,15 @@ def analyze(spans: list[dict]) -> dict:
             cat = _category(s)
             if cat:
                 cat_spans[cat][r].append((s["t0"], s["t1"]))
+        # Fabric-tier split of wire time (ISSUE 7): tier-tagged wire spans
+        # (local = same host, cross = the host boundary) accumulate
+        # separately so the report can say WHICH fabric is slow.
+        tier_spans: dict[str, dict[int, list]] = defaultdict(
+            lambda: defaultdict(list))
+        for s in tspans:
+            if _category(s) == "wire" and s.get("tier"):
+                tier_spans[str(s["tier"])][int(s.get("rank", 0))].append(
+                    (s["t0"], s["t1"]))
         entry: dict = {"ranks": sorted(set(enq) | set(done))}
         gate = None
         if len(enq) >= 2:
@@ -114,6 +124,11 @@ def analyze(spans: list[dict]) -> dict:
                 crit = max(cat_ns[cat].values())
                 phase_ns[cat] += crit
                 entry[f"{cat}_s"] = crit / 1e9
+        for tier, by_rank in tier_spans.items():
+            crit = max(sum(t1 - t0 for t0, t1 in iv)
+                       for iv in by_rank.values())
+            wire_tier_ns[tier] += crit
+            entry[f"wire_{tier}_s"] = crit / 1e9
         if enq and done:
             entry["total_s"] = (max(done.values()) - min(enq.values())) / 1e9
         per_tid[tid] = entry
@@ -126,6 +141,11 @@ def analyze(spans: list[dict]) -> dict:
         "collectives": len(by_tid),
         "multi_rank_collectives": n_multi,
         "phase_seconds": {p: phase_ns[p] / 1e9 for p in PHASES},
+        # Which fabric the wire time went to (tier-tagged spans only; the
+        # star plane and pre-ISSUE-7 traces have no tier tags, so this may
+        # cover less than phase_seconds["wire"]).
+        "wire_seconds_by_tier": {t: v / 1e9
+                                 for t, v in sorted(wire_tier_ns.items())},
         "dominant_phase": dominant,
         "skew_seconds_by_rank": {int(r): v / 1e9
                                  for r, v in sorted(skew_by_rank.items())},
@@ -146,6 +166,12 @@ def analyze(spans: list[dict]) -> dict:
                       else dominant),
             "share_of_blocked": s_ns / total_ns,
         }
+        if report["straggler"]["phase"] == "wire" and wire_tier_ns:
+            # Name WHICH fabric is slow: the intra-host plane or the
+            # cross-host boundary (docs/troubleshooting.md "my cross-pod
+            # allreduce is slow").
+            report["straggler"]["fabric"] = max(
+                wire_tier_ns, key=lambda t: (wire_tier_ns[t], t))
     else:
         report["straggler"] = None
     return report
@@ -165,6 +191,11 @@ def export_gauges(report: dict, reg=None) -> None:
                   help="blocked seconds attributed to each collective "
                        "lifecycle phase (tracing/critical_path.py)",
                   phase=phase).set(secs)
+    for tier, secs in report.get("wire_seconds_by_tier", {}).items():
+        reg.gauge("horovod_critical_path_wire_seconds",
+                  help="wire-phase blocked seconds split by fabric tier "
+                       "(local = intra-host, cross = host boundary)",
+                  tier=tier).set(secs)
     strag = report.get("straggler")
     reg.gauge("horovod_straggler_rank",
               help="rank attributed the most compute skew (-1 = none)"
@@ -196,10 +227,13 @@ def format_summary(report: dict) -> str:
              f"({report['multi_rank_collectives']} multi-rank):"]
     for p in PHASES:
         lines.append(f"  {p:<13} {report['phase_seconds'][p] * 1e3:9.2f} ms")
+    for tier, secs in report.get("wire_seconds_by_tier", {}).items():
+        lines.append(f"    wire[{tier}] {secs * 1e3:9.2f} ms")
     strag = report.get("straggler")
     if strag:
+        fabric = f", {strag['fabric']} fabric" if strag.get("fabric") else ""
         lines.append(
-            f"  straggler: rank {strag['rank']} ({strag['phase']}, "
+            f"  straggler: rank {strag['rank']} ({strag['phase']}{fabric}, "
             f"{strag['seconds'] * 1e3:.2f} ms, "
             f"{strag['share_of_blocked'] * 100:.0f}% of blocked time)")
     else:

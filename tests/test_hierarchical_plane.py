@@ -1,0 +1,510 @@
+"""Hierarchical fabric-aware allreduce (ISSUE 7) — the eager two-level
+plane, the grid canonical order, and the compiled per-tier path.
+
+Coverage map (the ISSUE's test satellite):
+- topology detection units: host grouping / leader-ring membership
+  determinism (plan_grid — the Python analyze_hier);
+- the extended oracle: ``_ring_order_reduce(grid=...)`` degenerates to the
+  flat order bitwise at L=1 / C=1, matches the exact mean on
+  exactly-summable payloads, and mirrors the per-hop compression rounding;
+- 4-proc 2-host worlds: flat == hier == star bitwise (with and without
+  bf16 compression + error feedback; free-form payloads additionally pin
+  the hier plane to the grid oracle bit for bit);
+- elastic-style reset: tear the engine down mid-job and re-rendezvous — the
+  rebuilt world re-establishes the two-level plane;
+- single-host degeneracy: the hier knob on a non-grid topology keeps the
+  PR 4 flat ring (and says so), with zero extra listeners;
+- compiled plane: per-tier bucket sizing + wire dtype recorded in
+  trace-time gauges; the joint autotune's fourth dimension.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.engine import (
+    _grid_order_reduce,
+    _ring_order_reduce,
+    plan_grid,
+)
+from launch_util import launch_world  # noqa: E402
+
+pytestmark = pytest.mark.engine
+
+
+# ------------------------------------------------------- topology detection
+
+def test_plan_grid_accepts_blocked_grid():
+    plan = plan_grid({r: (r % 2, 2, r // 2, 2) for r in range(4)})
+    assert plan is not None and plan["L"] == 2 and plan["C"] == 2
+    # Host grouping and leader-ring membership are pure functions of the
+    # blocked map — every rank derives the identical rings.
+    assert plan["local_group"](0) == [0, 1]
+    assert plan["local_group"](3) == [2, 3]
+    assert plan["cross_group"](0) == [0, 2]
+    assert plan["cross_group"](3) == [1, 3]
+
+
+def test_plan_grid_bigger_geometry():
+    plan = plan_grid({r: (r % 4, 4, r // 4, 3) for r in range(12)})
+    assert plan is not None and (plan["L"], plan["C"]) == (4, 3)
+    assert plan["local_group"](6) == [4, 5, 6, 7]
+    assert plan["cross_group"](6) == [2, 6, 10]
+
+
+@pytest.mark.parametrize("coords", [
+    {r: (r, 4, 0, 1) for r in range(4)},          # single host (C=1)
+    {r: (0, 1, r, 4) for r in range(4)},          # one rank per host (L=1)
+    {0: (0, 2, 0, 2), 1: (1, 2, 0, 2), 2: (0, 2, 1, 2)},   # missing cell
+    # non-blocked rank map: rank != cross*L + local
+    {0: (0, 2, 0, 2), 1: (0, 2, 1, 2), 2: (1, 2, 0, 2), 3: (1, 2, 1, 2)},
+    # heterogeneous local_size
+    {0: (0, 2, 0, 2), 1: (1, 2, 0, 2), 2: (0, 3, 1, 2), 3: (1, 2, 1, 2)},
+])
+def test_plan_grid_rejects_non_grids(coords):
+    assert plan_grid(coords) is None
+
+
+# ------------------------------------------------------------- grid oracle
+
+def test_grid_oracle_degenerates_to_flat_bitwise():
+    """grid=(1, N) and grid=(N, 1) are the flat ring order bit for bit —
+    the single-host degeneracy, on free-form payloads."""
+    rng = np.random.default_rng(3)
+    arrs = [rng.standard_normal(997).astype(np.float32)
+            * np.float32(10.0) ** rng.integers(-3, 3) for _ in range(4)]
+    flat = _ring_order_reduce(arrs, True)
+    np.testing.assert_array_equal(flat, _ring_order_reduce(arrs, True, grid=(1, 4)))
+    np.testing.assert_array_equal(flat, _ring_order_reduce(arrs, True, grid=(4, 1)))
+
+
+def test_grid_oracle_degenerates_to_flat_compressed():
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    rng = np.random.default_rng(4)
+    pre = [rng.standard_normal(513).astype(bf16).astype(np.float32)
+           for _ in range(4)]
+    flat = _ring_order_reduce(pre, True, wire_dtype=bf16)
+    np.testing.assert_array_equal(
+        flat, _ring_order_reduce(pre, True, wire_dtype=bf16, grid=(1, 4)))
+    np.testing.assert_array_equal(
+        flat, _ring_order_reduce(pre, True, wire_dtype=bf16, grid=(4, 1)))
+
+
+def test_grid_oracle_matches_exact_mean():
+    """On payloads whose sums are exact in the accumulator, every fold
+    order agrees with the true mean — and the 2x2 grid order is such an
+    order."""
+    rng = np.random.default_rng(5)
+    arrs = [rng.integers(-50, 50, 1013).astype(np.float32) for _ in range(4)]
+    exact = np.mean([a.astype(np.float64) for a in arrs], axis=0)
+    out = _ring_order_reduce(arrs, True, grid=(2, 2))
+    np.testing.assert_array_equal(out, exact.astype(np.float32))
+    np.testing.assert_array_equal(
+        _ring_order_reduce(arrs, False, grid=(2, 2)),
+        (exact * 4).astype(np.float32))
+
+
+def test_grid_oracle_is_the_nested_fold():
+    """Pin the documented order on a size-1 payload: host subtotals first
+    (local fold), then hosts in cross order — distinguishable from the
+    flat left fold with rounding-sensitive values."""
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    vals = [1.0, 1.0 + 2 ** -8, 3.0, 5.0]        # bf16 rounding bites
+    arrs = [np.array([v], dtype=np.float32) for v in vals]
+
+    def r(x):
+        return np.array([x], np.float32).astype(bf16).astype(np.float32)[0]
+
+    # chunk l=0, subchunk k=0: local folds start at member (0+1)%2=1,
+    # cross fold starts at host (0+1)%2=1.
+    p_h0 = r(vals[1]) + vals[0]
+    p_h1 = r(vals[3]) + vals[2]
+    expect = r(r(r(p_h1) + p_h0) / 4.0)
+    out = _ring_order_reduce(arrs, True, wire_dtype=bf16, grid=(2, 2))
+    assert out[0] == np.float32(expect)
+
+
+def test_grid_oracle_integer_exact():
+    arrs = [np.full(7, r + 1, dtype=np.int64) for r in range(4)]
+    np.testing.assert_array_equal(
+        _ring_order_reduce(arrs, False, grid=(2, 2)),
+        np.full(7, 10, np.int64))
+
+
+# ------------------------------------------------- 4-proc two-host worlds
+
+GRID_WORKER = textwrap.dedent("""
+    import hashlib, json, os, sys
+    sys.path.insert(0, os.environ["HVD_REPO"])
+    import numpy as np
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.common.engine import PyEngine, _ring_order_reduce
+    from horovod_tpu.common.topology import Topology
+    from horovod_tpu import metrics as hvd_metrics
+
+    rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+    L = int(os.environ.get("T_LOCAL", "2"))
+    hier = os.environ.get("T_HIER", "0") == "1"
+    ring = os.environ.get("T_RING", "1") == "1"
+    comp = os.environ.get("HOROVOD_COMPRESSION", "none")
+    ef = os.environ.get("HOROVOD_COMPRESSION_ERROR_FEEDBACK", "0") == "1"
+    topo = Topology(rank, world, rank % L, L, rank // L, world // L)
+    eng = PyEngine(topo, Config(cycle_time_ms=1.0, stall_check_disable=True,
+                                ring_data_plane=ring,
+                                hierarchical_allreduce=hier))
+    try:
+        rng = np.random.default_rng(11)
+        # Every rank derives ALL payloads from the shared seed, so it can
+        # run the canonical grid oracle locally for the bitwise pin.
+        payloads = [[(rng.standard_normal(611) * (r + 1)).astype(np.float32)
+                     for r in range(world)] for _ in range(3)]
+        digest = hashlib.sha256()
+        oracle_ok = True
+        for i, tick in enumerate(payloads):
+            out = eng.run("allreduce", tick[rank], f"g.{i % 2}")
+            digest.update(out.tobytes())
+            if hier and comp == "none" and not ef:
+                oracle = _ring_order_reduce(tick, True, grid=(L, world // L))
+                oracle_ok = oracle_ok and bool(np.array_equal(out, oracle))
+        snap = hvd_metrics.registry().snapshot()["counters"]
+        stats = eng.cache_stats()
+        print(json.dumps({
+            "rank": rank, "plane": stats["plane"],
+            "hash": digest.hexdigest(), "oracle_ok": oracle_ok,
+            "tier_local": snap.get('horovod_wire_bytes_total{tier="local"}', 0),
+            "tier_cross": snap.get('horovod_wire_bytes_total{tier="cross"}', 0),
+            "star_bytes": snap.get(
+                'horovod_engine_data_bytes_total{plane="star"}', 0),
+        }))
+    finally:
+        eng.shutdown()
+""")
+
+
+def _grid_world(hier: bool, ring: bool = True, extra=None, world: int = 4):
+    env = {"HOROVOD_ENGINE": "python", "T_HIER": "1" if hier else "0",
+           "T_RING": "1" if ring else "0"}
+    env.update(extra or {})
+    return [r["out"] for r in launch_world(world, GRID_WORKER,
+                                           extra_env=env)]
+
+
+def test_hier_plane_matches_grid_oracle_and_cuts_cross_bytes():
+    """Free-form payloads: the two-level plane must reproduce the grid
+    oracle bit for bit on every rank, agree across ranks, keep the
+    coordinator at zero tensor bytes, and spend <= 0.35x the flat ring's
+    worst-rank cross-host bytes."""
+    hier = _grid_world(hier=True)
+    flat = _grid_world(hier=False)
+    assert all(o["plane"] == "hier" for o in hier), hier
+    assert all(o["plane"] == "ring" for o in flat), flat
+    assert all(o["oracle_ok"] for o in hier), "hier plane != grid oracle"
+    assert len({o["hash"] for o in hier}) == 1
+    assert all(o["star_bytes"] == 0 for o in hier + flat)
+    flat_cross = max(o["tier_cross"] for o in flat)
+    hier_cross = max(o["tier_cross"] for o in hier)
+    assert flat_cross > 0
+    assert hier_cross <= 0.35 * flat_cross, (hier_cross, flat_cross)
+    # free-form f32 payloads of this size sum exactly in the f64
+    # accumulator, so the planes agree bitwise here too
+    assert {o["hash"] for o in flat} == {hier[0]["hash"]}
+
+
+def test_flat_hier_star_bitwise_with_bf16_and_error_feedback():
+    """Exactly-summable payloads (integer-valued, partial sums < 256 =
+    bf16's exact range): flat == hier == star bitwise, uncompressed AND
+    compressed, with error feedback enabled on the compressed worlds
+    (exact quantization leaves zero residuals — the wiring must not
+    disturb the stream)."""
+    script = GRID_WORKER.replace(
+        "(rng.standard_normal(611) * (r + 1)).astype(np.float32)",
+        "((rng.integers(0, 16, 611) + r).astype(np.float32))")
+    def worlds(extra):
+        outs = {}
+        for name, env in {
+            "flat": {"T_HIER": "0"}, "hier": {"T_HIER": "1"},
+            "star": {"T_HIER": "0", "T_RING": "0"},
+        }.items():
+            e = {"HOROVOD_ENGINE": "python", "T_RING": "1"}
+            e.update(env)
+            e.update(extra)
+            outs[name] = [r["out"] for r in launch_world(4, script,
+                                                         extra_env=e)]
+        return outs
+
+    plain = worlds({})
+    assert all(o["plane"] == "hier" for o in plain["hier"])
+    hashes = {name: {o["hash"] for o in outs}
+              for name, outs in plain.items()}
+    assert all(len(h) == 1 for h in hashes.values()), hashes
+    assert hashes["flat"] == hashes["hier"] == hashes["star"], hashes
+
+    comp = worlds({"HOROVOD_COMPRESSION": "bf16",
+                   "HOROVOD_COMPRESSION_ERROR_FEEDBACK": "1"})
+    chashes = {name: {o["hash"] for o in outs}
+               for name, outs in comp.items()}
+    assert all(len(h) == 1 for h in chashes.values()), chashes
+    assert chashes["flat"] == chashes["hier"] == chashes["star"], chashes
+
+
+def test_elastic_reset_re_rendezvous():
+    """The hvd.elastic reset path tears the engine down and rebuilds it
+    against a fresh coordinator: the rebuilt world must re-establish the
+    two-level plane and stay correct — generation 2 is not a degraded
+    flat world. (Production resets are fenced by the elastic driver's
+    rendezvous barrier before any engine rebuild; this in-process rebuild
+    has no driver, so each generation gets its own pre-agreed coordinator
+    port — a fast rank must not connect into the dying generation's
+    listener.)"""
+    from launch_util import free_port
+
+    script = textwrap.dedent("""
+        import json, os, sys
+        sys.path.insert(0, os.environ["HVD_REPO"])
+        import numpy as np
+        from horovod_tpu.common.config import Config
+        from horovod_tpu.common.engine import PyEngine
+        from horovod_tpu.common.topology import Topology
+
+        rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+        topo = Topology(rank, world, rank % 2, 2, rank // 2, world // 2)
+        ports = os.environ["T_GEN_PORTS"].split(",")
+        planes, oks = [], []
+        for gen in range(2):
+            os.environ["HOROVOD_COORD_ADDR"] = f"127.0.0.1:{ports[gen]}"
+            eng = PyEngine(topo, Config(cycle_time_ms=1.0,
+                                        stall_check_disable=True,
+                                        hierarchical_allreduce=True))
+            try:
+                out = eng.run("allreduce", np.full(257, float(rank + 1),
+                                                   np.float32), f"gen{gen}",
+                              average=False)
+                oks.append(bool(np.allclose(out, 10.0)))
+                planes.append(eng.cache_stats()["plane"])
+            finally:
+                eng.shutdown()
+        print(json.dumps({"planes": planes, "oks": oks}))
+    """)
+    ports = f"{free_port()},{free_port()}"
+    for r in launch_world(4, script,
+                          extra_env={"HOROVOD_ENGINE": "python",
+                                     "T_GEN_PORTS": ports},
+                          timeout=240):
+        assert r["out"]["planes"] == ["hier", "hier"], r["out"]
+        assert all(r["out"]["oks"]), r["out"]
+
+
+def test_single_host_degeneracy_keeps_flat_ring():
+    """The knob on a non-grid topology (4 ranks, one host) must keep the
+    PR 4 flat ring — same plane, loud warning, no hier listeners."""
+    script = textwrap.dedent("""
+        import json, os, sys
+        sys.path.insert(0, os.environ["HVD_REPO"])
+        import numpy as np
+        from horovod_tpu.common.config import Config
+        from horovod_tpu.common.engine import PyEngine, _HierPlane
+        from horovod_tpu.common.topology import Topology
+
+        rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+        topo = Topology(rank, world, rank, world, 0, 1)   # one host
+        eng = PyEngine(topo, Config(cycle_time_ms=1.0,
+                                    stall_check_disable=True,
+                                    hierarchical_allreduce=True))
+        try:
+            out = eng.run("allreduce", np.full(64, float(rank)), "g",
+                          average=False)
+            print(json.dumps({
+                "ok": bool(np.allclose(out, sum(range(world)))),
+                "plane": eng.cache_stats()["plane"],
+                "is_hier": isinstance(eng._ring, _HierPlane),
+            }))
+        finally:
+            eng.shutdown()
+    """)
+    for r in launch_world(4, script, extra_env={"HOROVOD_ENGINE": "python"},
+                          check=False):
+        assert r["rc"] == 0, r["stderr"][-2000:]
+        assert r["out"]["ok"] is True
+        assert r["out"]["plane"] == "ring"
+        assert r["out"]["is_hier"] is False
+        assert "using the flat eager plane" in r["stderr"], (
+            "non-grid fallback must warn, not silently ignore the knob")
+
+
+def test_hier_wire_spans_carry_tier(tmp_path):
+    """Tracing satellite: the hier plane's wire_send/wire_recv spans are
+    tier-tagged, and the critical-path analyzer splits wire time by fabric."""
+    script = GRID_WORKER.replace("stall_check_disable=True,",
+                                 "stall_check_disable=True, "
+                                 "trace_dir=os.environ['T_TRACE'],")
+    out_dir = tmp_path / "trace"
+    outs = [r["out"] for r in launch_world(
+        4, script, extra_env={"HOROVOD_ENGINE": "python", "T_HIER": "1",
+                              "T_TRACE": str(out_dir)})]
+    assert all(o["plane"] == "hier" for o in outs)
+    from horovod_tpu.tracing.collector import load_spans
+    from horovod_tpu.tracing.critical_path import analyze
+
+    spans, _ = load_spans(str(out_dir))
+    tiers = {s.get("tier") for s in spans
+             if s.get("phase") in ("wire_send", "wire_recv")}
+    assert tiers == {"local", "cross"}, tiers
+    report = analyze(spans)
+    by_tier = report["wire_seconds_by_tier"]
+    assert set(by_tier) == {"local", "cross"}
+    assert all(v >= 0 for v in by_tier.values())
+
+
+# ------------------------------------------------------------ compiled plane
+
+def test_compiled_per_tier_plan_gauges(mesh_2x4):
+    """hierarchical=True with a DCN wire dtype must record the per-tier
+    plan in trace-time gauges: dcn bytes = ici bytes / ici_size / 2 (the
+    1/L scatter times the 16-bit wire), hierarchical gauge = 1."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu.metrics as hvd_metrics
+    from horovod_tpu.compat import shard_map
+    from horovod_tpu.parallel import fusion
+
+    x = np.arange(8 * 4096, dtype=np.float32).reshape(8, 4096) / 3.0
+
+    def body(t):
+        (out,) = fusion.fused_allreduce(
+            [jnp.squeeze(t, 0)], threshold=1 << 20, hierarchical=True,
+            dcn_compression="bf16", compression_min_bytes=0)
+        return out[None]
+
+    f = shard_map(body, mesh=mesh_2x4, in_specs=P(("dcn", "ici")),
+                  out_specs=P(("dcn", "ici")))
+    out = np.asarray(jax.jit(f)(x))
+    plan = hvd_metrics.last_tier_plan()
+    assert plan["hierarchical"] is True
+    assert plan["dcn_wire"] == "bf16" and plan["ici_size"] == 4
+    ici = plan["bytes_per_step"]["ici"]
+    assert plan["bytes_per_step"]["dcn"] == ici // 4 // 2, plan
+    reg = hvd_metrics.registry().snapshot()["gauges"]
+    assert reg.get("horovod_compiled_hierarchical") == 1.0
+    assert reg.get(
+        'horovod_compiled_tier_bytes_per_step{tier="dcn"}') == ici // 8
+    # bf16 on the DCN hop only: within 16-bit tolerance of the true mean
+    exp = x.mean(axis=0)
+    scale = np.abs(exp).max()
+    assert np.abs(out[0] - exp).max() / scale < 2 ** -7
+
+
+def test_compiled_dcn_threshold_caps_buckets(mesh_2x4):
+    """dcn_threshold bounds the bytes any bucket ships cross-host: with a
+    cap of D the effective bucket cap is D*ici_size, so the plan splits
+    into more buckets than the uncapped one."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu.metrics as hvd_metrics
+    from horovod_tpu.compat import shard_map
+    from horovod_tpu.parallel import fusion
+
+    x = np.ones((8, 8192), dtype=np.float32)
+
+    def run(dcn_threshold):
+        def body(t):
+            outs = fusion.fused_allreduce(
+                [jnp.squeeze(t, 0)[:4096], jnp.squeeze(t, 0)[4096:]],
+                threshold=1 << 20, hierarchical=True,
+                dcn_threshold=dcn_threshold)
+            return jnp.concatenate(outs)[None]
+
+        f = shard_map(body, mesh=mesh_2x4, in_specs=P(("dcn", "ici")),
+                      out_specs=P(("dcn", "ici")))
+        jax.jit(f)(x).block_until_ready()
+        return hvd_metrics.last_tier_plan()
+
+    wide = run(None)
+    # 4096 f32 elements = 16 KiB per leaf; DCN shard = 4 KiB. A 2 KiB DCN
+    # cap forces each leaf's bucket (16 KiB > 2 KiB * ici_size=8 KiB) to
+    # stay unmerged.
+    capped = run(2 << 10)
+    assert capped["buckets"] >= wide["buckets"], (wide, capped)
+    assert capped["bytes_per_step"]["dcn"] <= wide["bytes_per_step"]["dcn"]
+    assert max(b for b in [capped["bytes_per_step"]["dcn"]]) >= 0
+
+
+def test_env_knob_reaches_compiled_plane(mesh_2x4, monkeypatch):
+    """Satellite 1: HOROVOD_HIERARCHICAL_ALLREDUCE=1 flows through
+    allreduce_gradients (no explicit argument) onto the ladder when the
+    mesh has the axes — and degrades loudly to flat on a 1-D mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu.jax as hvd_jax
+    import horovod_tpu.metrics as hvd_metrics
+    from horovod_tpu.compat import shard_map
+    from horovod_tpu.parallel.mesh import data_parallel_mesh
+
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    x = np.arange(8 * 512, dtype=np.float32).reshape(8, 512)
+
+    def body(t):
+        return hvd_jax.allreduce_gradients(jnp.squeeze(t, 0))[None]
+
+    f = shard_map(body, mesh=mesh_2x4, in_specs=P(("dcn", "ici")),
+                  out_specs=P(("dcn", "ici")))
+    out = np.asarray(jax.jit(f)(x))
+    assert hvd_metrics.last_tier_plan()["hierarchical"] is True
+    np.testing.assert_allclose(out[0], x.mean(axis=0), rtol=1e-5)
+
+    def body_flat(t):
+        return hvd_jax.allreduce_gradients(jnp.squeeze(t, 0),
+                                           axis_name="hvd")[None]
+
+    f2 = shard_map(body_flat, mesh=data_parallel_mesh(), in_specs=P("hvd"),
+                   out_specs=P("hvd"))
+    out2 = np.asarray(jax.jit(f2)(x))
+    assert hvd_metrics.last_tier_plan()["hierarchical"] is False
+    np.testing.assert_allclose(out2[0], x.mean(axis=0), rtol=1e-5)
+
+
+def test_autotune_fourth_dimension():
+    """jax.autotune.tune(hierarchicals=...): the ladder choice is explored
+    exhaustively beside (threshold, buckets, compression) and the winner's
+    config records it."""
+    from horovod_tpu.jax.autotune import tune
+
+    seen = []
+
+    def step_factory(fusion_threshold, num_buckets, compression,
+                     hierarchical):
+        seen.append((fusion_threshold, num_buckets, compression,
+                     hierarchical))
+        import time as _t
+
+        # The synthetic objective rewards the hierarchical branch.
+        delay = 0.0002 if hierarchical else 0.003
+
+        def run():
+            _t.sleep(delay)
+
+        return run
+
+    report = tune(step_factory, thresholds=(1 << 20,), num_buckets=(1, 2),
+                  compressions=("none",), hierarchicals=(False, True),
+                  warmup=0, iters=1, reps=1, gp_rounds=0)
+    assert {h for (_, _, _, h) in seen} == {False, True}
+    assert report.best.hierarchical is True
+    assert report.best.config.get("hierarchical") is True
+    assert "ladder" in report.knob_curve() or "hier" in report.knob_curve()

@@ -100,7 +100,8 @@ def best_baseline(metric: str, smoke: bool, baselines: list[dict]
 
 def run_gate(current: list[dict], baselines: list[dict], min_ratio: float,
              per_metric: dict, allow_missing: bool,
-             require: list[str]) -> int:
+             require: list[str], floors: Optional[dict] = None) -> int:
+    floors = floors or {}
     usable = [r for r in current if _usable(r)]
     partial = [r for r in current if r.get("partial")]
     for r in partial:
@@ -121,6 +122,18 @@ def run_gate(current: list[dict], baselines: list[dict], min_ratio: float,
     for rec in usable:
         metric = rec["metric"]
         cur = float(rec["value"])
+        # Absolute floors (--min-abs): for ratio-shaped metrics whose
+        # healthy value is a known constant — e.g. the hier-ab cross-byte
+        # reduction, where a future change silently re-inflating DCN
+        # traffic must fail CI even on a bootstrap run with no baseline.
+        if metric in floors:
+            floor = float(floors[metric])
+            verdict = "OK" if cur >= floor else "REGRESSION"
+            print(f"perf gate: {metric} = {cur:g} vs floor {floor:g} "
+                  f"-> {verdict}")
+            if cur < floor:
+                failures += 1
+            compared += 1
         ref = best_baseline(metric, _smoke_flag(rec), baselines)
         if ref is None:
             print(f"perf gate: {metric} = {cur:g} {rec.get('unit', '')} "
@@ -180,6 +193,13 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--per-metric", action="append", default=[],
                     metavar="METRIC=RATIO",
                     help="per-metric threshold override (repeatable)")
+    ap.add_argument("--min-abs", action="append", default=[],
+                    metavar="METRIC=VALUE",
+                    help="absolute floor: fail when the current value of "
+                         "METRIC drops below VALUE, baseline or not "
+                         "(repeatable; for ratio metrics with a known "
+                         "healthy constant, e.g. "
+                         "hier_ab_cross_byte_reduction=2.85)")
     ap.add_argument("--require-metric", action="append", default=[],
                     help="fail unless the current run reports this metric")
     ap.add_argument("--allow-missing-baseline", action="store_true",
@@ -206,6 +226,15 @@ def main(argv: Optional[list] = None) -> int:
             print(f"perf gate: ERROR — bad --per-metric {spec!r}",
                   file=sys.stderr)
             return 2
+    floors = {}
+    for spec in args.min_abs:
+        name, _, val = spec.partition("=")
+        try:
+            floors[name] = float(val)
+        except ValueError:
+            print(f"perf gate: ERROR — bad --min-abs {spec!r}",
+                  file=sys.stderr)
+            return 2
     baselines: list[dict] = []
     paths = list(args.baseline)
     for g in args.history:
@@ -214,7 +243,8 @@ def main(argv: Optional[list] = None) -> int:
         if os.path.exists(p):
             baselines.extend(load_records(p))
     return run_gate(current, baselines, args.min_ratio, per_metric,
-                    args.allow_missing_baseline, args.require_metric)
+                    args.allow_missing_baseline, args.require_metric,
+                    floors=floors)
 
 
 if __name__ == "__main__":
