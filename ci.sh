@@ -68,6 +68,9 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_smoke.py
 echo "== elastic smoke (3-proc train, kill one worker at step 5: survivors resume from last commit, dead slot blacklisted, resets in pod metrics) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/elastic_smoke.py
 
+echo "== chaos smoke (ISSUE 8 escalation ladder: injected delay absorbed by retries, link reset demotes ring->star bitwise-identically with 0 elastic resets then re-promotes, corrupt/drop frames rejected, killed rank escalates to exactly 1 elastic reset) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+
 echo "== fast tier (includes the launcher e2e: test_run_happy_path) =="
 python -m pytest tests/ -m fast -q
 

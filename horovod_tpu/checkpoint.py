@@ -31,6 +31,106 @@ def _ocp():
     return ocp
 
 
+# -- crash-consistent commits (ISSUE 8) --------------------------------------
+#
+# The elastic ladder's restore point is only as good as its worst write: a
+# worker killed mid-commit (exactly the fault the escalation ladder and the
+# chaos harness exercise) must never leave a half-written directory where the
+# last good checkpoint stood. So every save stages into a sibling temp
+# directory, fsyncs it, marks it complete (a sibling ``.ok`` file, written
+# after the data is durable), and swaps it into place with renames — the only
+# atomic primitive POSIX gives us for directories. Every crash window leaves
+# either the old checkpoint, or the new one, or a complete staged copy that
+# the next save()/restore() adopts (_heal_interrupted).
+
+
+def _fsync_tree(path: str) -> None:
+    """Best-effort fsync of every file and directory under ``path`` — the
+    rename below publishes the commit, so the data must be durable first.
+    Filesystems that reject directory fsync (some network mounts) are
+    tolerated: the rename ordering still bounds the damage to 'old or new'."""
+    for root, dirs, files in os.walk(path, topdown=False):
+        for name in files + [os.curdir]:
+            try:
+                fd = os.open(os.path.join(root, name) if name != os.curdir
+                             else root, os.O_RDONLY)
+            except OSError:
+                continue
+            try:
+                os.fsync(fd)
+            except OSError:
+                pass
+            finally:
+                os.close(fd)
+
+
+def _heal_interrupted(target: str) -> None:
+    """Adopt or discard leftovers of an interrupted commit next to
+    ``target``: a complete staged copy (``.tmp.* + .ok``) replaces a missing
+    target (the crash hit between the two swap renames); incomplete stages
+    and displaced old checkpoints (``.trash.*``) are deleted. Races between
+    ranks healing a shared filesystem are benign — every rename is wrapped,
+    and whoever wins leaves a valid target."""
+    import shutil
+
+    parent, base = os.path.split(target)
+    try:
+        names = os.listdir(parent or os.curdir)
+    except OSError:
+        return
+    stale: list[str] = []
+    for n in sorted(names):
+        p = os.path.join(parent, n)
+        if n.startswith(base + ".tmp.") and not n.endswith(".ok"):
+            if os.path.exists(p + ".ok") and not os.path.exists(target):
+                try:
+                    os.rename(p, target)
+                    os.unlink(p + ".ok")
+                    continue
+                except OSError:  # another rank adopted first
+                    pass
+            stale.append(p)
+        elif n.startswith(base + ".trash."):
+            stale.append(p)
+    for p in stale:
+        shutil.rmtree(p, ignore_errors=True)
+        try:
+            os.unlink(p + ".ok")
+        except OSError:
+            pass
+
+
+def _swap_into_place(tmp: str, target: str) -> None:
+    """Atomic publish: mark the staged copy complete, move any existing
+    checkpoint aside, rename the stage in, then clean up. A kill at ANY
+    point leaves a restorable state (the ``.ok`` marker makes the stage
+    adoptable during the brief no-target window)."""
+    import shutil
+
+    ok = tmp + ".ok"
+    with open(ok, "w") as f:
+        f.write("complete\n")
+        f.flush()
+        os.fsync(f.fileno())
+    trash = f"{target}.trash.{os.path.basename(tmp).rsplit('.', 1)[-1]}"
+    if os.path.exists(target):
+        os.rename(target, trash)
+    os.rename(tmp, target)
+    try:  # publish the renames before declaring the commit durable
+        fd = os.open(os.path.dirname(target) or os.curdir, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+    try:
+        os.unlink(ok)
+    except OSError:
+        pass
+    shutil.rmtree(trash, ignore_errors=True)
+
+
 def save(path: str, state: Any, step: Optional[int] = None, force: bool = True) -> None:
     """Write a checkpoint from rank 0 only; other ranks return immediately
     (reference contract: 'save checkpoints only on worker 0 to prevent other
@@ -55,8 +155,17 @@ def save(path: str, state: Any, step: Optional[int] = None, force: bool = True) 
         state = jax.tree_util.tree_map(
             lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
             state)
-        ckptr.save(target, state, force=force)
+        # Crash-consistent commit (ISSUE 8): stage next to the target, make
+        # it durable, then swap with atomic renames — a worker killed
+        # mid-commit can never corrupt the restore point the elastic ladder
+        # depends on. Also adopts/cleans leftovers of a previous kill.
+        _heal_interrupted(target)
+        os.makedirs(os.path.dirname(target) or os.curdir, exist_ok=True)
+        tmp = f"{target}.tmp.{os.getpid()}"
+        ckptr.save(tmp, state, force=True)
         ckptr.wait_until_finished()
+        _fsync_tree(tmp)
+        _swap_into_place(tmp, target)
     if basics.is_initialized() and basics.size() > 1:
         # barrier: everyone waits until rank 0's save completed
         basics.engine().run("allreduce", np.zeros(1), f"ckpt.barrier.{path}.{step}")
@@ -78,6 +187,11 @@ def restore(path: str, template: Any = None, step: Optional[int] = None,
     ckptr = ocp.StandardCheckpointer()
     target = os.path.join(os.path.abspath(path), f"step_{step}") \
         if step is not None else os.path.abspath(path)
+    if not os.path.exists(target):
+        # The writer may have been killed between the commit's two renames:
+        # adopt a complete staged copy if one is waiting (crash-consistent
+        # commits, ISSUE 8).
+        _heal_interrupted(target)
     state = ckptr.restore(target, template) if template is not None \
         else ckptr.restore(target)
     if verify:

@@ -56,6 +56,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from . import resilience
 from .config import Config, STALL_WARNING_TIME_S, _env_float
 from .response_cache import CacheMirror, ResponseCache, request_key
 from ..compression import numpy_dtype_by_name, numpy_wire_dtype
@@ -78,6 +79,12 @@ class TensorShapeMismatchError(HorovodInternalError):
     """Rank-divergent shape/dtype/op — the reference turns this into
     Response::ERROR delivered to every rank instead of a deadlock
     (ConstructResponse, operations.cc:321-523)."""
+
+
+# Error-string sentinel on coordinator results that must surface as a plain
+# HorovodInternalError (rung 3 of the escalation ladder — dead rank, needs
+# the elastic reset), not as a validation mismatch.
+_FATAL = "[reset] "
 
 
 # ---------------------------------------------------------------- wire helpers
@@ -104,19 +111,13 @@ def _send_msg(sock: socket.socket, obj: Any, key: bytes) -> int:
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytearray:
-    # recv_into a preallocated buffer: the naive bytes-+= loop re-copies the
-    # accumulated prefix on every ~64 KiB segment, which is quadratic on the
-    # MB-sized frames the data plane moves. Returns the bytearray itself —
-    # hmac and pickle.loads take buffers; a bytes() copy would be waste.
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
-    while got < n:
-        r = sock.recv_into(view[got:], n - got)
-        if not r:
-            raise ConnectionError("peer closed")
-        got += r
-    return buf
+    # resilience.recv_exact (ISSUE 8): preallocated recv_into (the bytes-+=
+    # loop is quadratic on MB frames) plus the transport ladder's retry
+    # rung — on sockets with a timeout, each idle deadline spends one
+    # HOROVOD_NETWORK_RETRIES attempt before the op fails; the coordinator
+    # server side accepts connections without a timeout and keeps blocking
+    # between ticks, exactly as before.
+    return resilience.recv_exact(sock, n)
 
 
 def _recv_msg(sock: socket.socket, key: bytes) -> Any:
@@ -376,7 +377,7 @@ def _connect_ring(listener, my_pos: int, size: int, endpoints: list,
         try:
             conn, _ = listener.accept()
             conn.settimeout(connect_timeout)
-            ch = Channel(conn, ring_key, server=True)
+            ch = Channel(conn, ring_key, server=True, scope="ring")
             hello = ch.recv()
             if (hello.get("hello") != prv or hello.get("to") != my_pos
                     or hello.get("ring", tag) != tag):
@@ -402,7 +403,7 @@ def _connect_ring(listener, my_pos: int, size: int, endpoints: list,
                 raise
             time.sleep(0.1)
     nsock.settimeout(connect_timeout)
-    nch = Channel(nsock, ring_key, server=False)
+    nch = Channel(nsock, ring_key, server=False, scope="ring")
     nch.send({"hello": my_pos, "to": nxt, "ring": tag})
     if nch.recv().get("ok") != 1:
         raise ConnectionError(f"{tag} ring connect: bad ack from next")
@@ -410,10 +411,14 @@ def _connect_ring(listener, my_pos: int, size: int, endpoints: list,
     if "ch" not in accepted:
         raise accepted.get(
             "err", ConnectionError(f"{tag} ring accept timed out"))
-    # Generous steady-state deadline: a dead peer still wakes us (RST); a
-    # healthy-but-slow one must not.
+    # Steady-state deadline from the transport policy (ISSUE 8): a stalled
+    # hop spends HOROVOD_NETWORK_RETRIES idle periods of this length
+    # (counted in horovod_transport_retries_total) before the link fails
+    # and the plane demotes — replacing the old flat 600 s hang that only
+    # the stall watchdog could interrupt. A dead peer still wakes us
+    # immediately (RST).
     for s_ in (nsock, accepted["sock"]):
-        s_.settimeout(600.0)
+        s_.settimeout(resilience.default_policy().timeout_s)
         s_.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # MB-scale chunk hops with default (~200 KiB) buffers cost dozens
         # of sender/receiver context-switch pairs per hop — pure overhead
@@ -1032,7 +1037,28 @@ class PyEngine:
         self._coord: Optional[_Coordinator] = None
         self._client: Optional[_Client] = None
         self._ring: Optional[_PeerRing] = None
-        self._ring_error: Optional[str] = None
+        # Transport-resilience ladder state (ISSUE 8, docs/eager-engine.md
+        # "Graded failure escalation"): a ring/hier link fault no longer
+        # latches a fatal error — the plane demotes to the star relay
+        # mid-run, the faulted collective replays there, and a cooldown
+        # probe (HOROVOD_PLANE_REPROMOTE_S) re-promotes once links hold.
+        self._plane_key: bytes = b""
+        self._plane_demote_seen = 0    # coordinator demote epoch applied
+        self._plane_reprobe_seen = 0   # coordinator re-promotion epoch applied
+        self._reestablish = False      # re-run plane establishment next cycle
+        # Last few finished ring-plane allreduce results, keyed by name and
+        # tagged with the directive's global seq: a link can die on the
+        # FINAL allgather hop, completing the collective on some ranks but
+        # not others — a survivor's retained copy answers the coordinator's
+        # redo request so failed ranks receive the identical bits without
+        # re-running anything. The seq tag matters: tensor NAMES recur
+        # every step, so an untagged copy from a previous execution would
+        # answer with stale bits (OrderedDict LRU, tiny).
+        from collections import OrderedDict
+
+        self._retained: "OrderedDict[str, tuple[int, np.ndarray]]" = \
+            OrderedDict()
+        self._retain_max = 16
         # Per-rank response-cache mirror (response_cache.py): follows the
         # coordinator's assign/evict announcements; capacity lives with the
         # coordinator authority.
@@ -1101,6 +1127,22 @@ class PyEngine:
                 help="eager data-plane bytes sent per fabric tier "
                      "(local = same host, cross = host boundary)", tier=t)
             for t in ("local", "cross")}
+        # Escalation-ladder telemetry (ISSUE 8): every rung is countable so
+        # "my ring keeps demoting" is a metrics query, not a log dig
+        # (docs/troubleshooting.md). horovod_transport_* live in
+        # common/resilience.py; the plane rungs live here.
+        self._m_demotions = self._metrics.counter(
+            "horovod_plane_demotions_total",
+            help="eager data-plane demotions to the star relay after a "
+                 "peer-link fault (rung 2 of the escalation ladder)")
+        self._m_repromotions = self._metrics.counter(
+            "horovod_plane_repromotions_total",
+            help="successful ring/hier re-promotions after the "
+                 "HOROVOD_PLANE_REPROMOTE_S cooldown")
+        self._m_plane = self._metrics.gauge(
+            "horovod_plane_current",
+            help="active eager data plane: 0 = star relay, 1 = flat peer "
+                 "ring, 2 = hierarchical two-level")
         if topo.size > 1:
             addr = os.environ.get("HOROVOD_COORD_ADDR")
             if not addr:
@@ -1143,14 +1185,11 @@ class PyEngine:
             # shape), every rank must agree (establish_data_plane runs the
             # hello + confirm barriers and returns None when any rank fell
             # back). On a multi-host grid with the knob set, the flat peer
-            # ring is replaced by the two-level hierarchical plane.
-            self._ring = establish_data_plane(
-                self._client, topo, key, config,
-                on_bytes=self._m_ring.inc,
-                on_wire=lambda w, s: (self._m_wire.inc(w),
-                                      self._m_wire_saved.inc(s)),
-                on_tier=lambda n, t: self._m_tier[t].inc(n),
-                tracer=self._trace)
+            # ring is replaced by the two-level hierarchical plane. The key
+            # is kept: the re-promotion probe rebuilds the plane with it
+            # after a demotion cooldown.
+            self._plane_key = key
+            self._establish_plane()
         # Stall watchdog (ISSUE 2): keeps reporting even when the loop is
         # wedged inside a blocking exchange, names missing ranks on the
         # coordinator rank, and can escalate (HOROVOD_STALL_SHUTDOWN_TIME)
@@ -1314,6 +1353,126 @@ class PyEngine:
         if self._coord is not None:
             self._coord.cache_flush()
         self._residuals.clear()
+        # Retained ring results are this membership's bits: a new elastic
+        # generation must never serve them as a redo answer.
+        self._retained.clear()
+
+    # -- transport-resilience ladder (ISSUE 8) -----------------------------
+
+    def _establish_plane(self) -> None:
+        """(Re)build the eager data plane through the coordinator's
+        hello/confirm barriers and publish the verdict to the plane gauge.
+        Used at init and by the re-promotion probe."""
+        self._ring = establish_data_plane(
+            self._client, self.topo, self._plane_key, self.config,
+            on_bytes=self._m_ring.inc,
+            on_wire=lambda w, s: (self._m_wire.inc(w),
+                                  self._m_wire_saved.inc(s)),
+            on_tier=lambda n, t: self._m_tier[t].inc(n),
+            tracer=self._trace)
+        self._m_plane.set(2 if isinstance(self._ring, _HierPlane)
+                          else 1 if self._ring is not None else 0)
+
+    def _demote_plane(self, reason: str, name: str = "") -> None:
+        """Rung 2: tear this rank's peer plane down and fall back to the
+        star relay (which relays through the coordinator and needs no peer
+        links). Idempotent; never raises — demotion is the recovery path
+        and must not become a second failure."""
+        plane, self._ring = self._ring, None
+        if plane is None:
+            return
+        self._m_demotions.inc()
+        self._m_plane.set(0)
+        log("warning",
+            f"eager data plane demoted to star on rank {self.topo.rank}"
+            f"{f' (collective {name})' if name else ''}: {reason}")
+        if self._trace is not None:
+            # The fault span names the flaky link in the merged trace: the
+            # reason string carries the underlying errno/timeout text.
+            t = self._trace.now_ns()
+            self._trace.span("plane#demote", name or "plane", "allreduce",
+                             "plane_demote", t, t, reason=str(reason)[:200])
+        try:
+            plane.close()
+        except Exception:  # noqa: BLE001 - teardown of a broken plane
+            pass
+
+    def _report_plane_fault(self, names: list, reason: str) -> None:
+        """Tell the coordinator about a link fault and the collectives this
+        rank must replay — it demotes the whole world (all-or-nothing, like
+        establishment) and opens a redo negotiation per name."""
+        if self._client is None:
+            return
+        try:
+            self._client.plane_fault(names, str(reason))
+        except Exception as e:  # noqa: BLE001
+            # Control channel down too: the next exchange raises the real
+            # HorovodInternalError (rung 3 — elastic reset).
+            log("warning", f"plane fault report failed: {e}")
+
+    def _requeue_redo(self, e: dict) -> None:
+        """Replay a failed/recalled collective through the star plane: a
+        fresh negotiation that bypasses the cache bit and re-ships the
+        bytes (the coordinator holds none for ring-plane entries)."""
+        e["sent"] = False
+        e["redo"] = True
+        with self._lock:
+            self._queue.append(e)
+
+    def _redo_inflight(self) -> None:
+        """After a demotion signal: entries already negotiated metadata-only
+        under the ring (bytes never shipped) must renegotiate with bytes."""
+        with self._lock:
+            for e in self._queue:
+                if e["op"] == "allreduce" and e.get("sent"):
+                    e["sent"] = False
+                    e["redo"] = True
+
+    def _retain(self, name: str, seq: int, out: np.ndarray) -> None:
+        self._retained[name] = (seq, out)
+        self._retained.move_to_end(name)
+        while len(self._retained) > self._retain_max:
+            self._retained.popitem(last=False)
+
+    def _apply_plane_signals(self) -> None:
+        """Consume the coordinator's demote/re-promote epochs piggybacked on
+        the last exchange response (one int compare each in the common
+        case)."""
+        plane = self._client.last_plane
+        if not plane:
+            return
+        demote = int(plane.get("demote", 0))
+        if demote > self._plane_demote_seen:
+            self._plane_demote_seen = demote
+            if self._ring is not None:
+                self._demote_plane(
+                    "coordinator demoted the world (link fault on a peer)")
+            self._redo_inflight()
+        reprobe = int(plane.get("reprobe", 0))
+        if reprobe > self._plane_reprobe_seen:
+            self._plane_reprobe_seen = reprobe
+            self._reestablish = True
+            self._wake.set()
+
+    def _try_repromote(self) -> None:
+        """Cooldown probe: rebuild peer links and return to ring/hier. All
+        ranks enter the same hello/confirm barriers, so re-promotion is
+        all-or-nothing exactly like initial establishment; on failure every
+        rank stays on the star and the coordinator re-arms the cooldown."""
+        if self._shutdown.is_set() or self._client is None:
+            return
+        try:
+            self._establish_plane()
+        except Exception as e:  # noqa: BLE001
+            log("warning", f"plane re-promotion attempt failed: {e}")
+            self._ring = None
+            self._m_plane.set(0)
+        if self._ring is not None:
+            self._m_repromotions.inc()
+            log("info",
+                f"eager data plane re-promoted to "
+                f"{'hier' if isinstance(self._ring, _HierPlane) else 'ring'}"
+                f" on rank {self.topo.rank} after cooldown")
 
     def shutdown(self) -> None:
         self._shutdown.set()
@@ -1367,6 +1526,12 @@ class PyEngine:
             if self._shutdown.is_set():
                 break
             cycles.inc()
+            if self._reestablish:
+                # Re-promotion probe (ISSUE 8): runs between batches, when
+                # no ring directive is in flight on this rank. The
+                # coordinator barriers line every rank up.
+                self._reestablish = False
+                self._try_repromote()
             if self._timeline:
                 self._timeline.mark_cycle()
             with self._lock:
@@ -1468,7 +1633,10 @@ class PyEngine:
                 else:
                     arrays[e["name"]] = e["array"]
             bit = None
-            if self._mirror is not None:
+            if self._mirror is not None and not e.get("redo"):
+                # Redo entries (plane demotion replay) bypass the cache bit:
+                # the coordinator needs the full request WITH bytes, and a
+                # replay must not skew the steady-state hit-rate stats.
                 key = self._entry_key(e)
                 if first:
                     bit = self._mirror.lookup(key)
@@ -1495,10 +1663,27 @@ class PyEngine:
                     req["trace"] = e["tid"]
                 requests.append(req)
                 self._m_full.inc()
+        # Redo answers (ISSUE 8): a link that died on a collective's FINAL
+        # allgather hop completed it here but not everywhere — the
+        # coordinator asked for our retained copy on the last response; ship
+        # it so the failed ranks get the identical bits. Only a copy whose
+        # directive seq MATCHES the recalled execution answers — the same
+        # tensor name recurs every step, and a previous step's bits must
+        # never close this step's redo.
+        redo_payload = {}
+        for nm, want_seq in self._client.last_redo:
+            held = self._retained.get(nm)
+            if held is not None and held[0] == want_seq:
+                redo_payload[nm] = held
+        redo_payload = redo_payload or None
         neg_t0 = (self._trace.now_ns() if self._trace is not None else 0)
         try:
-            results = self._client.exchange(requests, arrays, bits=bits)
+            results = self._client.exchange(requests, arrays, bits=bits,
+                                            redo_results=redo_payload)
         except Exception as exc:
+            # Rung 3: the control channel itself failed — nothing below a
+            # full reset can heal that (the coordinator is the recovery
+            # path). HorovodInternalError feeds hvd.elastic.run.
             for e in batch:
                 self._finish(e, HorovodInternalError(str(exc)), None)
             return
@@ -1532,7 +1717,12 @@ class PyEngine:
                 continue
             err, value = res
             if err is not None:
-                self._finish(e, TensorShapeMismatchError(err), None)
+                # Rung 3 errors (dead rank) must surface as the reset-worthy
+                # exception class — hvd.elastic.run catches
+                # HorovodInternalError, not validation mismatches.
+                self._finish(e, HorovodInternalError(err)
+                             if err.startswith(_FATAL)
+                             else TensorShapeMismatchError(err), None)
             elif isinstance(value, dict) and "__ring__" in value:
                 directives.append((value["seq"], e, value))
             elif isinstance(value, dict) and "__wire__" in value:
@@ -1549,12 +1739,29 @@ class PyEngine:
                 if isinstance(value, np.ndarray):
                     self._m_star.inc(int(value.nbytes))
                 self._finish(e, None, value)
+        # Demote/re-promote signals piggybacked on the response — applied
+        # AFTER unfinished entries re-joined the queue (so the redo marking
+        # sees them) and BEFORE directives execute (so a recalled plane is
+        # not used).
+        self._apply_plane_signals()
         # Ring execution in global sequence order: the coordinator stamps
         # each ready allreduce with a monotonic seq, and every rank executes
         # them in that order, so the neighbour exchanges pair up.
+        #
+        # Escalation ladder on a hop failure (ISSUE 8): a broken ring has no
+        # resync point (peer streams may be mid-message), but it no longer
+        # takes the job down — this rank demotes to the star relay, reports
+        # the fault, and REPLAYS the failed collective (and every later
+        # directive of this batch) through a fresh star negotiation. The
+        # canonical _ring_order_reduce keeps the replayed bits identical to
+        # what the ring would have produced, so ranks that finished before
+        # the link died and ranks that replay agree bitwise.
+        fault_names: list[str] = []
+        fault_reason = ""
         for _seq, e, d in sorted(directives, key=lambda t: t[0]):
-            if self._ring_error is not None:
-                self._finish(e, HorovodInternalError(self._ring_error), None)
+            if self._ring is None:
+                fault_names.append(e["name"])
+                self._requeue_redo(e)
                 continue
             if self._trace is not None and e.get("tid"):
                 # Directive echo check: the coordinator's independently
@@ -1570,15 +1777,20 @@ class PyEngine:
                 out = self._ring.allreduce(e["array"], bool(d["average"]),
                                            wire_dtype=e.get("wire"))
             except Exception as exc:  # noqa: BLE001
-                # A broken ring has no resync point (peer streams may be
-                # mid-message): fail this and every later ring collective.
-                self._ring_error = f"ring data plane failed: {exc}"
-                log("warning", self._ring_error)
-                self._finish(e, HorovodInternalError(self._ring_error), None)
+                fault_reason = f"{type(exc).__name__}: {exc}"
+                self._demote_plane(fault_reason, name=e["name"])
+                fault_names.append(e["name"])
+                self._requeue_redo(e)
             else:
+                self._retain(e["name"], int(d["seq"]), out)
                 self._finish(e, None, out)
             finally:
-                self._ring.trace_ctx = None
+                if self._ring is not None:
+                    self._ring.trace_ctx = None
+        if fault_names:
+            self._report_plane_fault(
+                fault_names, fault_reason or "ring directive recalled after "
+                "world demotion")
 
     def _stall_source(self) -> list:
         """Watchdog view of this rank's in-flight queue (reference
@@ -1670,6 +1882,40 @@ class _Coordinator:
         self._ring_plane: Optional[str] = None   # "flat" | "hier" verdict
         self._ring_votes: dict[int, bool] = {}
         self._ring_seq = 0
+        # --- transport-resilience ladder (ISSUE 8) ---
+        # Demote/re-promote epochs piggybacked on every exchange response;
+        # ranks apply them with one int compare. A plane_fault report from
+        # any rank demotes the WHOLE world to the star relay (all ranks or
+        # none, same invariant as establishment) and opens a redo
+        # negotiation for each recalled/failed collective. After the
+        # cooldown the reprobe epoch sends every rank back through the
+        # hello/confirm barriers.
+        self._demote_epoch = 0
+        self._reprobe_epoch = 0
+        self._grid: Optional[tuple] = None      # (L, C) when plane == hier
+        # name -> seq of the latest ring directive issued under it: tensor
+        # names recur every step, so a redo is identified by (name, seq)
+        # and only a retained copy of THAT execution may answer it.
+        self._directive_seq: dict[str, int] = {}
+        self._redo_wanted: dict[str, int] = {}     # name -> directive seq
+        self._redo_grid: dict[str, tuple] = {}
+        # name -> (close time, directive seq) of recently delivered redo
+        # answers: purge timer for retained-answer results, and duplicate
+        # late reports about the SAME execution must not reopen the redo.
+        self._redo_done: dict[str, tuple] = {}
+        # name -> ranks that FINISHED the recalled execution (and so will
+        # never re-poll it). A retained-answer result is pre-claimed for
+        # them, or it would linger until the next same-NAME collective,
+        # whose submissions would silently claim the stale bits (tensor
+        # names recur every step — the claim bookkeeping must reach world
+        # for the result to retire).
+        self._redo_claim: dict[str, set] = {}
+        self._repromote_s = _env_float("HOROVOD_PLANE_REPROMOTE_S", 30.0)
+        self._repromote_at: Optional[float] = None
+        # Ranks whose control connection dropped uncleanly (no "bye"): their
+        # collectives can never complete — fail them so survivors escalate
+        # to the elastic reset instead of waiting for the stall watchdog.
+        self._dead: set[int] = set()
         # Result-bearing responses currently between claim and socket write
         # (the stop() drain waits on this as well as on unclaimed results).
         self._owed = 0
@@ -1720,14 +1966,18 @@ class _Coordinator:
             self._threads.append(t)
 
     def _serve(self, conn: socket.socket) -> None:
+        rank: Optional[int] = None
+        clean = False
         try:
             while not self._stop.is_set():
                 msg = _recv_msg(conn, self.key)
                 kind = msg["kind"]
+                if "rank" in msg:
+                    rank = msg["rank"]
                 if kind == "exchange":
                     out = self._handle_exchange(
                         msg["rank"], msg["requests"], msg["arrays"],
-                        msg.get("bits", 0))
+                        msg.get("bits", 0), msg.get("redo_results"))
                     try:
                         _send_msg(conn, out, self.key)
                     finally:
@@ -1741,6 +1991,10 @@ class _Coordinator:
                 elif kind == "ring_confirm":
                     _send_msg(conn, self._handle_ring_confirm(
                         msg["rank"], bool(msg["ok"])), self.key)
+                elif kind == "plane_fault":
+                    _send_msg(conn, self._handle_plane_fault(
+                        msg["rank"], msg.get("names") or [],
+                        msg.get("reason", "")), self.key)
                 elif kind == "clock_probe":
                     # Trace clock alignment (tracing/clock.py): answer with
                     # this process's monotonic reading, nothing else — the
@@ -1748,6 +2002,7 @@ class _Coordinator:
                     # offset to this (the reference) clock.
                     _send_msg(conn, {"t": time.monotonic_ns()}, self.key)
                 elif kind == "bye":
+                    clean = True
                     return
         except (ConnectionError, EOFError, OSError):
             return
@@ -1758,6 +2013,14 @@ class _Coordinator:
                 conn.close()
             except OSError:
                 pass
+            # Rung 3 (coordinator heartbeat): a control connection that
+            # drops WITHOUT the "bye" goodbye means the worker died or is
+            # partitioned — its collectives can never complete. Fail them
+            # now so every surviving rank raises HorovodInternalError into
+            # the elastic reset path instead of waiting out the stall
+            # watchdog.
+            if rank is not None and not clean and not self._stop.is_set():
+                self._peer_lost(rank)
 
     # -- ring negotiation barriers
 
@@ -1785,6 +2048,7 @@ class _Coordinator:
                 # verdict would deadlock establishment).
                 infos = self._ring_endpoints
                 plane = "flat"
+                self._grid = None
                 if all(i.get("hier") for i in infos.values()):
                     coords = {r: (i.get("local_rank", 0),
                                   i.get("local_size", 1),
@@ -1795,6 +2059,14 @@ class _Coordinator:
                             and all(i.get("local_port") and i.get("cross_port")
                                     for i in infos.values())):
                         plane = "hier"
+                        # Remembered for redo replays: a collective that the
+                        # two-level plane partially finished must be
+                        # re-reduced in the GRID canonical order, or the
+                        # replayed ranks would diverge bitwise from the
+                        # ranks that completed.
+                        info0 = infos[min(infos)]
+                        self._grid = (info0.get("local_size", 1),
+                                      info0.get("cross_size", 1))
                 self._ring_plane = plane
             return {"peers": dict(self._ring_endpoints),
                     "plane": self._ring_plane}
@@ -1810,7 +2082,138 @@ class _Coordinator:
                 self._cv.wait(1.0)
             self.ring_active = (len(self._ring_votes) == self.world
                                 and all(self._ring_votes.values()))
+            if not self.ring_active and self._demote_epoch > 0 \
+                    and self._repromote_s > 0:
+                # Failed re-promotion probe (some link still down): stay on
+                # the star and re-arm the cooldown for the next attempt.
+                self._repromote_at = time.monotonic() + self._repromote_s
             return {"active": self.ring_active}
+
+    # -- escalation ladder (ISSUE 8) --
+
+    def _handle_plane_fault(self, rank: int, names: list, reason: str) -> dict:
+        """A rank's peer link failed (timeout past the retry budget,
+        ECONNRESET, rejected frame). Demote the WHOLE world to the star
+        relay — every rank applies the epoch from its next exchange
+        response — and open a redo negotiation for each collective the
+        reporter must replay."""
+        with self._cv:
+            if self.ring_active:
+                self.ring_active = False
+                self._demote_epoch += 1
+                if self._repromote_s > 0:
+                    self._repromote_at = time.monotonic() + self._repromote_s
+                # Ring-plane contributions were metadata-only; the star
+                # replay needs bytes. Drop them so re-submissions (full
+                # request + tensor) take their place.
+                for entry in self._pending.values():
+                    for r in [r for r, (_q, a) in entry.items() if a is None]:
+                        del entry[r]
+                # Recall undelivered ring directives: ranks that have not
+                # claimed them yet renegotiate on the star; ranks that
+                # already executed retain their result for the redo.
+                for nm in list(self._results):
+                    err, val = self._results[nm]
+                    if err is None and isinstance(val, dict) \
+                            and val.get("__ring__"):
+                        # Ranks that already claimed the directive may have
+                        # finished it; ranks that never claimed it will
+                        # renegotiate and must claim the redo answer.
+                        was_claimed = set(self._claimed.get(nm, set()))
+                        del self._results[nm]
+                        self._claimed.pop(nm, None)
+                        self._want_redo(nm, finished=was_claimed)
+                log("warning",
+                    f"coordinator: eager data plane demoted to star after a "
+                    f"link fault on rank {rank} "
+                    f"({', '.join(names) or 'link'}: {reason}); "
+                    + ("re-promotion probe in "
+                       f"{self._repromote_s:g}s" if self._repromote_s > 0
+                       else "re-promotion disabled (HOROVOD_PLANE_REPROMOTE_S=0)"))
+            for nm in names:
+                done = self._redo_done.get(nm)
+                if done is not None and \
+                        self._directive_seq.get(nm) == done[1]:
+                    # Duplicate late report about an execution whose redo
+                    # already closed: do NOT reopen it (names recur — a
+                    # fresh redo would target the next execution). Un-claim
+                    # the retiring answer so the reporter's replay can still
+                    # collect it.
+                    if nm in self._results and rank in self._claimed.get(
+                            nm, set()):
+                        self._claimed[nm].discard(rank)
+                    continue
+                self._want_redo(nm)
+                # The reporter must REPLAY nm, so it is not a finisher: it
+                # will claim the redo answer itself.
+                if nm in self._redo_claim:
+                    self._redo_claim[nm].discard(rank)
+            self._cv.notify_all()
+        return {"ok": 1}
+
+    def _want_redo(self, name: str, finished: Optional[set] = None) -> None:
+        """Open a redo negotiation for ``name`` (caller holds the lock): the
+        collective is answered either by a rank that finished it on the
+        peer plane (retained result — the identical bits) or by a fresh
+        star reduction over every rank's re-shipped bytes, whichever
+        arrives first."""
+        if name in self._results:
+            return  # already (re)answered
+        if name not in self._redo_wanted:
+            self._redo_wanted[name] = self._directive_seq.get(name, -1)
+            # Presumed finishers (pre-claimed when a retained answer closes
+            # the redo): the recall path passes the directive's claim set;
+            # a fault report on a fully-delivered directive starts from the
+            # whole world and carves reporters out as their reports arrive.
+            self._redo_claim[name] = set(range(self.world)) \
+                if finished is None else set(finished)
+        if self._grid is not None:
+            self._redo_grid[name] = self._grid
+
+    def _peer_lost(self, rank: int) -> None:
+        """Rung 3: rank's control connection dropped without a goodbye. Its
+        collectives can never complete — fail every pending (and redo)
+        negotiation with an error every surviving rank will receive, so the
+        failure surfaces as HorovodInternalError (the elastic reset +
+        blacklist path) within one engine tick."""
+        with self._cv:
+            if rank in self._dead:
+                return
+            self._dead.add(rank)
+            msg = (_FATAL + f"lost control connection to rank {rank} before "
+                   "its collectives completed (worker dead or partitioned); "
+                   "failing in-flight collectives")
+            names = list(self._pending) + [n for n in self._redo_wanted
+                                           if n not in self._pending]
+            for name in names:
+                self._pending.pop(name, None)
+                self._first_seen.pop(name, None)
+                self._redo_wanted.pop(name, None)
+                self._redo_grid.pop(name, None)
+                self._redo_claim.pop(name, None)
+                if name not in self._results:
+                    self._results[name] = (msg, None)
+                    self._claimed[name] = set()
+            if names:
+                log("warning", f"coordinator: {msg} "
+                    f"({', '.join(sorted(names))})")
+            self._cv.notify_all()
+
+    def _maybe_schedule_reprobe(self) -> None:
+        """Cooldown check (caller holds the lock): when the re-promotion
+        timer expires, clear the establishment barriers and bump the
+        reprobe epoch — every rank re-enters hello/confirm from its engine
+        loop."""
+        if (self._repromote_at is None or self.ring_active
+                or self._dead or time.monotonic() < self._repromote_at):
+            return
+        self._repromote_at = None
+        self._reprobe_epoch += 1
+        self._ring_endpoints.clear()
+        self._ring_votes.clear()
+        self._ring_plane = None
+        log("info", "coordinator: demotion cooldown expired — probing "
+            "ring re-promotion")
 
     # -- response cache authority
 
@@ -1883,9 +2286,41 @@ class _Coordinator:
     # -- the exchange
 
     def _handle_exchange(self, rank: int, requests: list[dict], arrays: dict,
-                         bits: int = 0) -> dict:
+                         bits: int = 0,
+                         redo_results: Optional[dict] = None) -> dict:
         ready: list[str] = []
         with self._cv:
+            self._maybe_schedule_reprobe()
+            now = time.monotonic()
+            # Redo answers (ISSUE 8): a rank that finished a collective on
+            # the peer plane before the link died ships its retained result
+            # — the identical bits the failed ranks would have produced —
+            # and the redo negotiation closes without re-reducing anything.
+            # Seq-checked: only a copy of the RECALLED execution counts
+            # (names recur every step; a stale copy must never answer).
+            for nm, (seq, arr) in (redo_results or {}).items():
+                if (self._redo_wanted.get(nm) == int(seq)
+                        and nm not in self._results):
+                    self._results[nm] = (None, np.asarray(arr))
+                    # Pre-claim the finishers: only the redoing ranks still
+                    # owe a claim, so the result retires as soon as they
+                    # collect it instead of lingering into (and poisoning)
+                    # the next same-name collective.
+                    self._claimed[nm] = set(self._redo_claim.pop(nm, set()))
+                    self._pending.pop(nm, None)
+                    self._first_seen.pop(nm, None)
+                    self._redo_wanted.pop(nm, None)
+                    self._redo_grid.pop(nm, None)
+                    self._redo_done[nm] = (now, int(seq))
+            # Retained-result answers can never be claimed by the whole
+            # world (the ranks that finished never re-poll the name), so the
+            # world-claimed deletion cannot fire — purge them after a claim
+            # window instead.
+            for nm, (ts, _seq) in list(self._redo_done.items()):
+                if now - ts > 60.0:
+                    self._redo_done.pop(nm)
+                    self._results.pop(nm, None)
+                    self._claimed.pop(nm, None)
             full_reqs = list(requests)
             if full_reqs and self._cache.enabled:
                 for req in full_reqs:
@@ -1927,9 +2362,28 @@ class _Coordinator:
                 contribs = self._pending.pop(name)
                 self._results[name] = self._execute(name, contribs)
                 self._first_seen.pop(name, None)
+                self._redo_wanted.pop(name, None)
+                self._redo_claim.pop(name, None)
                 self._claimed[name] = set()
                 if self._results[name][0] is None:
                     self._maybe_assign(name, contribs)
+            if self._dead:
+                # Rung 3 backstop: anything still (or newly) pending misses
+                # at least one dead rank forever — fail it now with the
+                # reset-worthy error instead of letting re-polls spin until
+                # the stall watchdog.
+                dmsg = (_FATAL + f"rank(s) {sorted(self._dead)} lost their "
+                        "control connection (worker dead or partitioned); "
+                        "collective cannot complete")
+                for name in list(self._pending):
+                    self._pending.pop(name)
+                    self._first_seen.pop(name, None)
+                    self._redo_wanted.pop(name, None)
+                    self._redo_grid.pop(name, None)
+                    self._redo_claim.pop(name, None)
+                    if name not in self._results:
+                        self._results[name] = (dmsg, None)
+                        self._claimed[name] = set()
             self._cv.notify_all()
             # Collective semantics: a tensor completes only when every rank
             # contributed. But an exchange never blocks on a straggler (the
@@ -1979,8 +2433,22 @@ class _Coordinator:
                 # Owed until _serve's send completes — stop()'s drain must
                 # not declare victory between the claim and the write.
                 self._owed += 1
-            return {"results": out, "assign": assign,
+            resp = {"results": out, "assign": assign,
                     "evict": self._drain_evictions(rank)}
+            if self._demote_epoch or self._reprobe_epoch:
+                # Ladder signals (ISSUE 8): epochs ride every response once
+                # a demotion happened (two small ints; ranks apply them with
+                # one compare each). Absent in the steady state, so the
+                # healthy-path response stays byte-identical to before.
+                resp["plane"] = {"demote": self._demote_epoch,
+                                 "reprobe": self._reprobe_epoch}
+            if self._redo_wanted:
+                # Ask every rank for its retained copy of the recalled
+                # (name, seq) executions — whichever survivor answers first
+                # closes the redo without re-reducing anything.
+                resp["redo"] = [[nm, seq]
+                                for nm, seq in self._redo_wanted.items()]
+            return resp
 
     def stall_candidates(self) -> list:
         """Watchdog source (reference CheckForStalledTensors with
@@ -2056,6 +2524,7 @@ class _Coordinator:
             # trace ID so every rank can verify the shared derivation.
             seq = self._ring_seq
             self._ring_seq += 1
+            self._directive_seq[name] = seq
             out = {"__ring__": True, "seq": seq,
                    "average": bool(reqs[0]["average"])}
             if tid is not None:
@@ -2068,6 +2537,13 @@ class _Coordinator:
         red_t0 = rec.now_ns() if rec is not None else 0
         try:
             if op == "allreduce":
+                # Redo replay after a HIERARCHICAL-plane demotion (ISSUE 8):
+                # ranks that finished before the link died hold grid-order
+                # bits; the star replay must fold in the same grid order or
+                # the world would diverge bitwise. (Uncompressed f64
+                # accumulation is order-exact, but the compressed path
+                # rounds per hop — the order IS the value.)
+                grid = self._redo_grid.pop(name, None)
                 wire_name = reqs[0].get("wire")
                 if wire_name:
                     # Contributions arrived at wire width (exact: they were
@@ -2079,13 +2555,13 @@ class _Coordinator:
                     orig = np.dtype(reqs[0]["dtype"])
                     full = [a.astype(orig) for a in arrs]
                     red = _ring_order_reduce(full, reqs[0]["average"],
-                                             wire_dtype=wire_np)
+                                             wire_dtype=wire_np, grid=grid)
                     if rec is not None:
                         rec.span(tid, name, op, "reduce", red_t0,
                                  rec.now_ns(), plane="star")
                     return (None, {"__wire__": red.astype(wire_np),
                                    "dtype": str(orig)})
-                red = _ring_order_reduce(arrs, reqs[0]["average"])
+                red = _ring_order_reduce(arrs, reqs[0]["average"], grid=grid)
                 if rec is not None:
                     # Star-plane reduction runs HERE (rank 0's process):
                     # record it under the shared trace ID so the merged
@@ -2138,6 +2614,11 @@ class _Client:
         # (assign, evict) announcements from the latest exchange response;
         # the engine applies them to its CacheMirror.
         self.last_cache: tuple[list, list] = ([], [])
+        # Escalation-ladder signals piggybacked on the latest exchange
+        # response (ISSUE 8): the coordinator's demote/reprobe epochs and
+        # the redo names it wants this rank's retained ring results for.
+        self.last_plane: dict = {}
+        self.last_redo: list = []
 
     def local_host(self) -> str:
         """Local address of the control connection — the interface that
@@ -2170,21 +2651,34 @@ class _Client:
                       self.key)
             return int(_recv_msg(self.sock, self.key)["t"])
 
-    def exchange(self, requests: list[dict], arrays: dict,
-                 bits: int = 0) -> dict:
+    def plane_fault(self, names: list, reason: str) -> None:
+        """Report a peer-link fault to the coordinator (rung 2): it demotes
+        the whole world to the star relay and opens a redo negotiation for
+        each named collective this rank must replay."""
         with self._lock:
-            self.last_sent_bytes = _send_msg(
-                self.sock, {"kind": "exchange", "rank": self.rank,
-                            "requests": requests, "arrays": arrays,
-                            "bits": bits},
-                self.key)
+            _send_msg(self.sock, {"kind": "plane_fault", "rank": self.rank,
+                                  "names": list(names),
+                                  "reason": str(reason)}, self.key)
+            _recv_msg(self.sock, self.key)
+
+    def exchange(self, requests: list[dict], arrays: dict,
+                 bits: int = 0, redo_results: Optional[dict] = None) -> dict:
+        with self._lock:
+            msg = {"kind": "exchange", "rank": self.rank,
+                   "requests": requests, "arrays": arrays, "bits": bits}
+            if redo_results:
+                msg["redo_results"] = redo_results
+            self.last_sent_bytes = _send_msg(self.sock, msg, self.key)
             resp = _recv_msg(self.sock, self.key)
         if isinstance(resp, dict) and "results" in resp:
             self.last_cache = (resp.get("assign") or [],
                                resp.get("evict") or [])
+            self.last_plane = resp.get("plane") or {}
+            self.last_redo = resp.get("redo") or []
             out = resp["results"]
         else:  # pragma: no cover - legacy shape
             self.last_cache = ([], [])
+            self.last_plane, self.last_redo = {}, []
             out = resp
         # Unwrap per-rank results (reducescatter / alltoall)
         for name, (err, val) in list(out.items()):

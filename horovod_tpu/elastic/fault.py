@@ -19,11 +19,33 @@ none of it belongs in a production config:
   ``os._exit``.
 - ``HOROVOD_FAULT_AGENT_EXIT_AFTER_S=S``: a resident hvd-agent hard-exits
   ``S`` seconds after start (agent.py) — the host-loss scenario.
+
+Network chaos (ISSUE 8, tools/chaos_smoke.py): frame-level fault injection
+inside the authenticated Channel (runner/network.py), exercising the
+transport-resilience ladder instead of killing processes:
+
+- ``HOROVOD_FAULT_NET={delay,reset,corrupt,drop}``: what to inject on a
+  matching outbound frame. ``delay`` sleeps ``HOROVOD_FAULT_NET_DELAY_MS``
+  (default 1000) before sending — absorbed by the receive retry budget
+  (rung 1). ``reset`` abort-closes the socket (RST to the peer) — a hard
+  link fault, absorbed by plane demotion (rung 2). ``corrupt`` flips a MAC
+  byte so the receiver rejects the frame (``horovod_frames_rejected_total``)
+  and fails the link — also rung 2. ``drop`` swallows the frame: the
+  receiver sees the *next* frame early (size/HMAC mismatch — the
+  broken-middlebox model) and fails the link.
+- Target selectors: ``HOROVOD_FAULT_NET_SCOPE`` (comma list of channel
+  scopes, default ``ring`` — the eager data-plane links; ``*`` = all),
+  ``HOROVOD_FAULT_NET_RANK`` (only this HOROVOD_RANK injects; default
+  any), ``HOROVOD_FAULT_NET_AFTER`` (skip the first N matching frames,
+  default 0), ``HOROVOD_FAULT_NET_COUNT`` (stop after firing N times,
+  default 1; -1 = unlimited), ``HOROVOD_FAULT_NET_RATE`` (per-frame firing
+  probability once past AFTER, default 1 = deterministic).
 """
 
 from __future__ import annotations
 
 import os
+import random
 import signal
 import threading
 
@@ -68,6 +90,73 @@ def die() -> None:
     except ValueError:
         sig = getattr(signal, f"SIG{spec.upper()}", signal.SIGKILL)
     os.kill(os.getpid(), sig)
+
+
+# -- network chaos (ISSUE 8) -------------------------------------------------
+
+NET_ACTIONS = ("delay", "reset", "corrupt", "drop")
+
+_net_lock = threading.Lock()
+_net_fired = 0
+_net_frames: dict = {}
+
+
+def net_fault_armed() -> bool:
+    """True when this process injects network faults (checked once per
+    Channel construction — the hot path stays branch-free when unset)."""
+    spec = os.environ.get("HOROVOD_FAULT_NET", "")
+    if spec not in NET_ACTIONS:
+        return False
+    target = os.environ.get("HOROVOD_FAULT_NET_RANK", "")
+    return target == "" or target == os.environ.get("HOROVOD_RANK", "")
+
+
+def net_fault(scope: str) -> str | None:
+    """Per-frame decision: return the action to inject on this outbound
+    frame, or None. Counts frames per scope so AFTER/COUNT selectors are
+    deterministic (the chaos smoke needs the fault to land mid-run, not at
+    a random establishment frame)."""
+    global _net_fired
+    spec = os.environ.get("HOROVOD_FAULT_NET", "")
+    if spec not in NET_ACTIONS:
+        return None
+    scopes = os.environ.get("HOROVOD_FAULT_NET_SCOPE", "ring")
+    if scopes != "*" and scope not in scopes.split(","):
+        return None
+    target = os.environ.get("HOROVOD_FAULT_NET_RANK", "")
+    if target and target != os.environ.get("HOROVOD_RANK", ""):
+        return None
+    with _net_lock:
+        count = int(os.environ.get("HOROVOD_FAULT_NET_COUNT", "") or 1)
+        if 0 <= count <= _net_fired:
+            return None
+        n = _net_frames.get(scope, 0) + 1
+        _net_frames[scope] = n
+        if n <= int(os.environ.get("HOROVOD_FAULT_NET_AFTER", "") or 0):
+            return None
+        rate = float(os.environ.get("HOROVOD_FAULT_NET_RATE", "") or 1.0)
+        if rate < 1.0 and random.random() >= rate:
+            return None
+        _net_fired += 1
+    from ..utils.logging import log
+
+    log("warning",
+        f"net fault injection firing: {spec} on {scope} frame {n} "
+        f"(rank {os.environ.get('HOROVOD_RANK', '?')})")
+    return spec
+
+
+def net_fault_delay_s() -> float:
+    return float(os.environ.get("HOROVOD_FAULT_NET_DELAY_MS", "") or 1000.0) \
+        / 1000.0
+
+
+def reset_net_fault_state() -> None:
+    """Forget fired/frame counters (unit tests re-arm between cases)."""
+    global _net_fired
+    with _net_lock:
+        _net_fired = 0
+        _net_frames.clear()
 
 
 def start_agent_fault_timer() -> None:

@@ -56,14 +56,40 @@ class _Handler(BaseHTTPRequestHandler):
 
 class MetricsServer:
     """Daemon-thread HTTP exposition server; ``port=0`` picks a free port
-    (read the bound one back from ``.port``)."""
+    (read the bound one back from ``.port``).
+
+    A requested port that is busy (EADDRINUSE) slides up through a small
+    window (``HOROVOD_METRICS_PORT_WINDOW``, default 16 ports) instead of
+    failing: an elastic respawn lands a fresh worker on a host where the
+    previous generation's exporter — or an unrelated process — still holds
+    ``port + local_rank``, and a metrics port must never crash ``hvd.init``
+    (same shape as the coordinator's bind retry). The bound port is always
+    read back from ``.port``."""
 
     def __init__(self, port: int, reg: Optional[MetricsRegistry] = None,
                  host: Optional[str] = None) -> None:
+        import errno
+
         reg = reg or registry()
         host = host or os.environ.get("HOROVOD_METRICS_HOST", "127.0.0.1")
         handler = type("BoundHandler", (_Handler,), {"registry": reg})
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        window = 1 if port == 0 else max(
+            int(os.environ.get("HOROVOD_METRICS_PORT_WINDOW", "") or 16), 1)
+        for offset in range(window):
+            try:
+                self._httpd = ThreadingHTTPServer((host, port + offset),
+                                                  handler)
+                break
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE or offset == window - 1:
+                    raise
+        if port and self._httpd.server_address[1] != port:
+            from ..utils.logging import log
+
+            log("warning",
+                f"metrics port {port} busy; exposition moved to "
+                f"{self._httpd.server_address[1]} "
+                "(HOROVOD_METRICS_PORT_WINDOW)")
         self._httpd.daemon_threads = True
         self.host = host
         self.port = self._httpd.server_address[1]

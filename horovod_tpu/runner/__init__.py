@@ -262,10 +262,14 @@ def run_command(command: Sequence[str], num_proc: Optional[int] = None,
                     "HOROVOD_SUPERVISE": "1",
                 })
             deadline = time.monotonic() + timeout if timeout else None
-            # Exponential poll backoff capped at 2 s: short jobs get
-            # sub-100ms exit latency, long jobs don't hammer the agents
-            # with a fixed 2 Hz poll per host for hours.
-            delay = 0.05
+            # Poll backoff on the shared transport policy (common/
+            # resilience.py Backoff, capped by HOROVOD_NETWORK_BACKOFF_MAX_MS
+            # — default 2 s): short jobs get sub-100ms exit latency, long
+            # jobs don't hammer the agents with a fixed 2 Hz poll per host
+            # for hours, and the jitter decorrelates multi-driver setups.
+            from ..common.resilience import Backoff
+
+            backoff = Backoff(base_s=0.05)
             while True:
                 codes = spawner.poll_returncodes()
                 if codes is None:
@@ -278,8 +282,7 @@ def run_command(command: Sequence[str], num_proc: Optional[int] = None,
                     raise TimeoutError(
                         f"{sum(c is None for c in codes)} workers still "
                         f"running after {timeout}s")
-                time.sleep(delay)
-                delay = min(delay * 2, 2.0)
+                backoff.sleep()
         finally:
             spawner.kill()
             spawner.close()

@@ -25,13 +25,14 @@ import hashlib
 import hmac
 import os
 import pickle
-import random
 import secrets as _secrets
 import socket
 import struct
 import threading
 import time
 from typing import Any, Callable, Optional
+
+from ..common import resilience
 
 
 def make_secret() -> bytes:
@@ -48,20 +49,14 @@ def derive_key(key: bytes, purpose: bytes) -> bytes:
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytearray:
-    # recv_into a preallocated buffer: the naive bytes-+= loop re-copies the
-    # accumulated prefix on every ~64 KiB segment, which is quadratic on the
-    # MB-sized frames the eager ring data plane moves over this framing.
-    # Returns the bytearray itself — hmac, pickle.loads and np.frombuffer
-    # all take buffers, so a final bytes() copy would be pure waste.
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
-    while got < n:
-        r = sock.recv_into(view[got:], n - got)
-        if not r:
-            raise ConnectionError("peer closed")
-        got += r
-    return buf
+    # resilience.recv_exact: recv_into a preallocated buffer (the naive
+    # bytes-+= loop is quadratic on MB-sized ring frames) PLUS the
+    # escalation ladder's bottom rung — on sockets with a timeout set, each
+    # idle deadline costs one retry from the HOROVOD_NETWORK_RETRIES budget
+    # before the op fails; sockets without a timeout keep blocking forever
+    # (idle request servers must). Returns the bytearray itself — hmac,
+    # pickle.loads and np.frombuffer all take buffers.
+    return resilience.recv_exact(sock, n)
 
 
 # Unauthenticated bytes are buffered before the digest check; cap the claimed
@@ -79,10 +74,29 @@ class Channel:
     both sides derive session_key = HMAC(secret, "hvd-session:"+nonce)).
     Each direction numbers its messages from 0 and the MAC covers
     (direction, seq, payload), so neither cross-connection replay nor
-    in-connection replay/reflection authenticates."""
+    in-connection replay/reflection authenticates.
 
-    def __init__(self, sock: socket.socket, key: bytes, server: bool) -> None:
+    ``scope`` names what the channel carries ("ctl" control traffic,
+    "ring" eager data-plane links) — it selects which channels the
+    env-triggered network chaos hooks target (elastic/fault.py,
+    HOROVOD_FAULT_NET) and costs nothing when injection is unarmed."""
+
+    def __init__(self, sock: socket.socket, key: bytes, server: bool,
+                 scope: str = "ctl") -> None:
         self.sock = sock
+        self.scope = scope
+        # Fault-injection hook (ISSUE 8 chaos harness): resolved ONCE per
+        # channel — None in production (one env check at construction), the
+        # fault module when HOROVOD_FAULT_NET arms this process. Lazy
+        # import: elastic's package init pulls the engine, which imports
+        # this module — at Channel-construction time the cycle is long
+        # resolved.
+        self._fault = None
+        if os.environ.get("HOROVOD_FAULT_NET"):
+            from ..elastic import fault as _fault_mod
+
+            if _fault_mod.net_fault_armed():
+                self._fault = _fault_mod
         # Distributed-tracing IO hook (ISSUE 6): when set, every RAW frame's
         # wire time is reported as io_hook(direction, nbytes, t0_ns, t1_ns)
         # with direction in {"send", "recv"}. Measured HERE — around the
@@ -126,11 +140,51 @@ class Channel:
         h.update(payload)
         return h.digest()
 
+    def _inject_fault(self) -> Optional[str]:
+        """Chaos hook (HOROVOD_FAULT_NET): decide and pre-apply this frame's
+        injected fault. Returns "drop" when the frame must be swallowed
+        (before the sequence number advances — the receiver then sees the
+        NEXT frame early and fails the link, the broken-middlebox model);
+        "corrupt" when the caller should flip a MAC byte; None otherwise.
+        "delay" sleeps here; "reset" abort-closes the socket (RST to the
+        peer) and raises."""
+        action = self._fault.net_fault(self.scope)
+        if action == "delay":
+            time.sleep(self._fault.net_fault_delay_s())
+            return None
+        if action == "reset":
+            try:
+                self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                     struct.pack("ii", 1, 0))
+            except OSError:
+                pass
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            raise ConnectionResetError(
+                "injected connection reset (HOROVOD_FAULT_NET=reset)")
+        return action
+
     def send(self, obj: Any) -> None:
         payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        corrupt = False
+        if self._fault is not None:
+            action = self._inject_fault()
+            if action == "drop":
+                # The dropped frame still consumes a sequence number — the
+                # receiver authenticates the NEXT frame against the dropped
+                # frame's seq and rejects it (a swallowed frame must surface
+                # as a detected link fault, never as a silent substitution).
+                self._send_seq += 1
+                return
+            corrupt = action == "corrupt"
         mac = self._mac(self._send_dir, self._send_seq, payload)
+        if corrupt:
+            mac = bytes([mac[0] ^ 0xFF]) + mac[1:]
         self._send_seq += 1
-        self.sock.sendall(mac + struct.pack("!Q", len(payload)) + payload)
+        resilience.send_all(
+            self.sock, mac + struct.pack("!Q", len(payload)) + payload)
 
     def recv(self) -> Any:
         digest = _recv_exact(self.sock, 32)
@@ -140,6 +194,7 @@ class Channel:
         payload = _recv_exact(self.sock, n)
         if not hmac.compare_digest(
                 digest, self._mac(self._recv_dir, self._recv_seq, payload)):
+            resilience.frames_rejected_counter().inc()
             raise PermissionError(
                 "HMAC digest mismatch: unauthenticated, replayed, or "
                 "reordered message")
@@ -158,12 +213,24 @@ class Channel:
 
     def send_bytes(self, data) -> None:
         view = memoryview(data).cast("B")
+        corrupt = False
+        if self._fault is not None:
+            action = self._inject_fault()
+            if action == "drop":
+                # Seq still advances — see send(): the swallowed frame must
+                # fail the receiver's HMAC check, not silently alias the
+                # next frame.
+                self._send_seq += 1
+                return
+            corrupt = action == "corrupt"
         mac = self._mac(self._send_dir.lower(), self._send_seq, view)
+        if corrupt:
+            mac = bytes([mac[0] ^ 0xFF]) + mac[1:]
         self._send_seq += 1
         hook = self.io_hook
         t0 = time.monotonic_ns() if hook else 0
-        self.sock.sendall(mac + struct.pack("!Q", len(view)))
-        self.sock.sendall(view)
+        resilience.send_all(self.sock, mac + struct.pack("!Q", len(view)))
+        resilience.send_all(self.sock, view)
         if hook:
             hook("send", len(view), t0, time.monotonic_ns())
 
@@ -178,6 +245,7 @@ class Channel:
         if not hmac.compare_digest(
                 digest,
                 self._mac(self._recv_dir.lower(), self._recv_seq, payload)):
+            resilience.frames_rejected_counter().inc()
             raise PermissionError(
                 "HMAC digest mismatch: unauthenticated, replayed, or "
                 "reordered message")
@@ -262,17 +330,20 @@ class BasicService:
 class BasicClient:
     """Blocking request/response client with retry-capable connect.
 
-    ``connect_retry_s`` > 0 keeps re-trying the full address list with
-    exponential backoff (jittered, capped at 2 s per sleep) for up to that
-    many seconds before giving up — a cold-starting pod's workers register
-    while the driver service may still be a few hundred ms from listening,
-    and one refused connection must not kill the worker."""
+    ``connect_retry_s`` > 0 keeps re-trying the full address list with the
+    shared decorrelated-jitter backoff (common/resilience.py Backoff,
+    capped at HOROVOD_NETWORK_BACKOFF_MAX_MS) for up to that many seconds
+    before giving up — a cold-starting pod's workers register while the
+    driver service may still be a few hundred ms from listening, and one
+    refused connection must not kill the worker. A whole pod retrying in
+    lockstep would hammer the driver at the same instants; the jitter
+    decorrelates them."""
 
     def __init__(self, addresses, key: bytes, timeout: float = 60.0,
                  connect_retry_s: float = 0.0) -> None:
         self.key = key
         deadline = time.monotonic() + max(connect_retry_s, 0.0)
-        delay = 0.05
+        backoff = resilience.Backoff(base_s=0.05)
         last: Optional[Exception] = None
         while True:
             for host, port in addresses:
@@ -295,10 +366,7 @@ class BasicClient:
                     last = e
             if time.monotonic() >= deadline:
                 break
-            # Jittered backoff: a whole pod retrying in lockstep would keep
-            # hammering the driver at the same instants.
-            time.sleep(min(delay, 2.0) * (0.5 + random.random()))
-            delay *= 2
+            backoff.sleep()
         raise ConnectionError(f"cannot reach service at {addresses}: {last}")
 
     def request(self, obj: Any) -> Any:

@@ -486,3 +486,43 @@ def test_metrics_callback_single_process(hvd, tmp_path):
     on_disk = json.loads(path.read_text())
     assert validate_snapshot(on_disk) == []
     assert on_disk["counters"].get("horovod_epochs_total", 0) >= 1
+
+
+def test_http_exposition_bind_retry_on_busy_port():
+    """EADDRINUSE slides the exporter up a small port window instead of
+    crashing hvd.init (ISSUE 8 satellite: an elastic respawn lands where
+    the previous generation's exporter still holds port + local_rank)."""
+    import socket as _socket
+
+    reg = MetricsRegistry()
+    blocker = _socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    busy = blocker.getsockname()[1]
+    try:
+        srv = start_metrics_server(busy, reg)
+        try:
+            assert srv.port != busy
+            assert busy < srv.port < busy + 16
+            ok = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5).read()
+            assert ok == b"ok\n"
+        finally:
+            srv.stop()
+    finally:
+        blocker.close()
+
+
+def test_http_exposition_window_exhaustion_raises(monkeypatch):
+    import socket as _socket
+
+    monkeypatch.setenv("HOROVOD_METRICS_PORT_WINDOW", "1")
+    reg = MetricsRegistry()
+    blocker = _socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    try:
+        with pytest.raises(OSError):
+            start_metrics_server(blocker.getsockname()[1], reg)
+    finally:
+        blocker.close()

@@ -1,0 +1,490 @@
+"""Transport-resilience ladder tests (ISSUE 8).
+
+Covers, bottom rung to top:
+
+- the shared policy/backoff module (``common/resilience.py``): env parsing,
+  decorrelated-jitter bounds, retry-within-budget and budget-exhaustion on
+  ``recv_exact`` with the matching counters;
+- frame-level defenses on the authenticated Channel: corrupt-HMAC and
+  replayed-sequence frames are REJECTED (never unpickled), counted in
+  ``horovod_frames_rejected_total``, and surface as a link fault the
+  demotion rung absorbs — not a crash;
+- the env-triggered network chaos hooks (``elastic/fault.py``): action /
+  scope / rank / AFTER / COUNT selectors, and the injected faults' wire
+  behaviour (drop consumes a sequence number, delay stalls the frame);
+- coordinator escalation-ladder protocol units: plane_fault demotes the
+  world and opens seq-tagged redo negotiations, stale retained answers are
+  rejected, finishers are pre-claimed so redo results retire, dead ranks
+  fail pending collectives with the reset-worthy ``[reset]`` error;
+- (slow) a 4-process end-to-end: an injected link reset mid-run demotes
+  ring -> star with BITWISE-identical results, zero HorovodInternalErrors,
+  and re-promotes to the ring after the cooldown.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import resilience
+from horovod_tpu.elastic import fault
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in list(os.environ):
+        if var.startswith(("HOROVOD_NETWORK_", "HOROVOD_FAULT_NET")):
+            monkeypatch.delenv(var, raising=False)
+    resilience._reset_for_tests()
+    fault.reset_net_fault_state()
+    yield
+    resilience._reset_for_tests()
+    fault.reset_net_fault_state()
+
+
+# ------------------------------------------------------------ policy/backoff
+
+def test_policy_env_parsing(monkeypatch):
+    assert resilience.from_env() == resilience.Policy()
+    monkeypatch.setenv("HOROVOD_NETWORK_TIMEOUT", "2.5")
+    monkeypatch.setenv("HOROVOD_NETWORK_RETRIES", "5")
+    monkeypatch.setenv("HOROVOD_NETWORK_BACKOFF_MAX_MS", "750")
+    pol = resilience.from_env()
+    assert (pol.timeout_s, pol.retries, pol.backoff_max_ms) == (2.5, 5, 750.0)
+    assert pol.patience_s == pytest.approx(15.0)
+    # Hostile values clamp instead of breaking every socket op.
+    monkeypatch.setenv("HOROVOD_NETWORK_TIMEOUT", "-3")
+    monkeypatch.setenv("HOROVOD_NETWORK_RETRIES", "-2")
+    pol = resilience.from_env()
+    assert pol.timeout_s == 0.05 and pol.retries == 0
+
+
+def test_default_policy_cached_until_refresh(monkeypatch):
+    p0 = resilience.default_policy()
+    monkeypatch.setenv("HOROVOD_NETWORK_TIMEOUT", "9")
+    assert resilience.default_policy() is p0  # cached
+    assert resilience.default_policy(refresh=True).timeout_s == 9.0
+
+
+def test_backoff_decorrelated_jitter_bounds():
+    class Rng:
+        def uniform(self, a, b):
+            return b  # worst case: always the top of the window
+
+    b = resilience.Backoff(base_s=0.05, cap_s=0.4, rng=Rng())
+    delays = [b.next() for _ in range(8)]
+    assert all(0.05 <= d <= 0.4 for d in delays)
+    assert delays[-1] == 0.4  # growth saturates at the cap
+    b.reset()
+    assert b.next() == pytest.approx(0.15)  # 3 * base after reset
+
+
+def test_backoff_default_cap_from_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_NETWORK_BACKOFF_MAX_MS", "123")
+    resilience._reset_for_tests()
+    assert resilience.Backoff().cap_s == pytest.approx(0.123)
+
+
+def _pair(timeout=0.1):
+    a, b = socket.socketpair()
+    b.settimeout(timeout)
+    return a, b
+
+
+def test_recv_exact_retries_within_budget():
+    a, b = _pair(timeout=0.1)
+    pol = resilience.Policy(timeout_s=0.1, retries=5)
+    r0 = resilience.retries_counter().value
+    t0 = resilience.timeouts_counter().value
+    try:
+        t = threading.Timer(0.25, lambda: a.sendall(b"x" * 64))
+        t.start()
+        got = resilience.recv_exact(b, 64, policy=pol)
+        assert bytes(got) == b"x" * 64
+        # The ~0.25 s stall cost >= 2 idle deadlines, absorbed in place.
+        assert resilience.retries_counter().value - r0 >= 2
+        assert resilience.timeouts_counter().value == t0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_exact_exhausts_budget_and_counts_timeout():
+    a, b = _pair(timeout=0.05)
+    pol = resilience.Policy(timeout_s=0.05, retries=2)
+    r0 = resilience.retries_counter().value
+    t0 = resilience.timeouts_counter().value
+    try:
+        with pytest.raises(TimeoutError, match="HOROVOD_NETWORK_RETRIES"):
+            resilience.recv_exact(b, 8, policy=pol)
+        assert resilience.timeouts_counter().value - t0 == 1
+        assert resilience.retries_counter().value - r0 == 2
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_exact_progress_resets_budget():
+    # Two stalls of ~2 deadlines each: a FIXED budget of 3 would fail, but
+    # progress resets it — the deadline bounds idle time, not frame size.
+    a, b = _pair(timeout=0.05)
+    pol = resilience.Policy(timeout_s=0.05, retries=3)
+
+    def feed():
+        time.sleep(0.12)
+        a.sendall(b"x" * 32)
+        time.sleep(0.12)
+        a.sendall(b"y" * 32)
+
+    r0 = resilience.retries_counter().value
+    t0 = resilience.timeouts_counter().value
+    try:
+        th = threading.Thread(target=feed)
+        th.start()
+        got = resilience.recv_exact(b, 64, policy=pol)
+        th.join()
+        assert bytes(got) == b"x" * 32 + b"y" * 32
+        assert resilience.retries_counter().value - r0 >= 4
+        assert resilience.timeouts_counter().value == t0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_exact_peer_close_raises_connection_error():
+    a, b = _pair()
+    a.close()
+    try:
+        with pytest.raises(ConnectionError):
+            resilience.recv_exact(b, 4, policy=resilience.Policy())
+    finally:
+        b.close()
+
+
+# ----------------------------------------------------------- frame defenses
+
+def _channel_pair(scope_client="ctl", scope_server="ctl"):
+    from horovod_tpu.runner.network import Channel, make_secret
+
+    key = make_secret()
+    s_srv, s_cli = socket.socketpair()
+    s_srv.settimeout(5)
+    s_cli.settimeout(5)
+    out = {}
+    th = threading.Thread(
+        target=lambda: out.update(
+            srv=Channel(s_srv, key, server=True, scope=scope_server)))
+    th.start()
+    cli = Channel(s_cli, key, server=False, scope=scope_client)
+    th.join()
+    return cli, out["srv"]
+
+
+def test_channel_replayed_sequence_rejected_and_counted():
+    cli, srv = _channel_pair()
+    cli.send({"n": 1})
+    assert srv.recv() == {"n": 1}
+    # Replay frame seq 0 verbatim: re-MAC the same payload under the OLD
+    # sequence number and push the raw bytes (a captured-frame replay).
+    import pickle
+
+    payload = pickle.dumps({"n": 1}, protocol=pickle.HIGHEST_PROTOCOL)
+    mac = cli._mac(cli._send_dir, 0, payload)
+    cli.sock.sendall(mac + struct.pack("!Q", len(payload)) + payload)
+    before = resilience.frames_rejected_counter().value
+    with pytest.raises(PermissionError, match="replayed"):
+        srv.recv()
+    assert resilience.frames_rejected_counter().value == before + 1
+
+
+def test_channel_corrupt_mac_rejected_and_counted(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FAULT_NET", "corrupt")
+    monkeypatch.setenv("HOROVOD_FAULT_NET_SCOPE", "*")
+    fault.reset_net_fault_state()
+    cli, srv = _channel_pair()
+    before = resilience.frames_rejected_counter().value
+    cli.send({"secret": 42})
+    with pytest.raises(PermissionError, match="HMAC"):
+        srv.recv()
+    assert resilience.frames_rejected_counter().value == before + 1
+
+
+def test_channel_drop_consumes_sequence_number(monkeypatch):
+    # A swallowed frame must surface as a DETECTED fault on the next frame,
+    # never as a silent substitution of the following message.
+    monkeypatch.setenv("HOROVOD_FAULT_NET", "drop")
+    monkeypatch.setenv("HOROVOD_FAULT_NET_SCOPE", "*")
+    fault.reset_net_fault_state()
+    cli, srv = _channel_pair()
+    cli.send({"dropped": True})   # injected: swallowed, seq consumed
+    cli.send({"next": True})      # arrives bearing seq 1; receiver expects 0
+    with pytest.raises(PermissionError, match="HMAC"):
+        srv.recv()
+
+
+def test_channel_delay_injection_stalls_frame(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FAULT_NET", "delay")
+    monkeypatch.setenv("HOROVOD_FAULT_NET_SCOPE", "*")
+    monkeypatch.setenv("HOROVOD_FAULT_NET_DELAY_MS", "200")
+    fault.reset_net_fault_state()
+    cli, srv = _channel_pair()
+    t0 = time.monotonic()
+    cli.send({"late": 1})
+    assert srv.recv() == {"late": 1}
+    assert time.monotonic() - t0 >= 0.2
+
+
+def test_channel_reset_injection_raises(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FAULT_NET", "reset")
+    monkeypatch.setenv("HOROVOD_FAULT_NET_SCOPE", "*")
+    fault.reset_net_fault_state()
+    cli, srv = _channel_pair()
+    with pytest.raises(ConnectionResetError):
+        cli.send({"x": 1})
+
+
+# ------------------------------------------------------ chaos hook selectors
+
+def test_net_fault_selectors(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FAULT_NET", "delay")
+    monkeypatch.setenv("HOROVOD_RANK", "1")
+    # scope filter: default targets only ring channels
+    assert fault.net_fault("ctl") is None
+    assert fault.net_fault("ring") == "delay"
+    # rank filter
+    fault.reset_net_fault_state()
+    monkeypatch.setenv("HOROVOD_FAULT_NET_RANK", "0")
+    assert not fault.net_fault_armed()
+    assert fault.net_fault("ring") is None
+    monkeypatch.setenv("HOROVOD_FAULT_NET_RANK", "1")
+    assert fault.net_fault_armed()
+
+
+def test_net_fault_after_and_count(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FAULT_NET", "corrupt")
+    monkeypatch.setenv("HOROVOD_FAULT_NET_AFTER", "2")
+    monkeypatch.setenv("HOROVOD_FAULT_NET_COUNT", "2")
+    fault.reset_net_fault_state()
+    hits = [fault.net_fault("ring") for _ in range(6)]
+    # frames 1-2 skipped (AFTER), frames 3-4 fire (COUNT=2), rest pass
+    assert hits == [None, None, "corrupt", "corrupt", None, None]
+
+
+def test_net_fault_unknown_action_inert(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FAULT_NET", "explode")
+    assert not fault.net_fault_armed()
+    assert fault.net_fault("ring") is None
+
+
+# ------------------------------------------- coordinator ladder (protocol)
+
+@pytest.fixture()
+def coord(monkeypatch):
+    from horovod_tpu.common.engine import _Coordinator
+
+    monkeypatch.setenv("HOROVOD_PLANE_REPROMOTE_S", "30")
+    c = _Coordinator(4, "127.0.0.1", 0, key=b"k" * 16)
+    yield c
+    c.stop()
+
+
+def _seed_directive(coord, name, claimed):
+    """Issue a ring directive for ``name`` the way _execute would."""
+    seq = coord._ring_seq
+    coord._ring_seq += 1
+    coord._directive_seq[name] = seq
+    coord._results[name] = (None, {"__ring__": True, "seq": seq,
+                                   "average": True})
+    coord._claimed[name] = set(claimed)
+    return seq
+
+
+def test_plane_fault_demotes_world_and_opens_redo(coord):
+    coord.ring_active = True
+    seq = _seed_directive(coord, "t", claimed={0, 1})   # 2 and 3 not yet
+    coord._pending["u"] = {0: ({"op": "allreduce"}, None),
+                           2: ({"op": "allreduce"}, np.ones(2))}
+    coord._handle_plane_fault(1, ["t"], "boom")
+    assert coord.ring_active is False
+    assert coord._demote_epoch == 1
+    assert coord._repromote_at is not None
+    # the undelivered directive was recalled into a seq-tagged redo
+    assert "t" not in coord._results
+    assert coord._redo_wanted == {"t": seq}
+    # reporter 1 must replay; 0 finished; 2/3 never claimed -> will replay
+    assert coord._redo_claim["t"] == {0}
+    # metadata-only (ring) contributions dropped, bytes kept
+    assert list(coord._pending["u"]) == [2]
+
+
+def test_redo_stale_seq_rejected_fresh_accepted(coord):
+    coord.ring_active = True
+    seq = _seed_directive(coord, "t", claimed={0, 1, 2, 3})
+    del coord._results["t"]     # fully delivered before the fault
+    del coord._claimed["t"]
+    coord._handle_plane_fault(2, ["t"], "boom")
+    assert coord._redo_wanted == {"t": seq}
+    # a STALE retained copy (previous step, seq-1) must not answer
+    out = coord._handle_exchange(3, [], {},
+                                 redo_results={"t": (seq - 1, np.ones(2))})
+    assert "t" not in out["results"] and "t" not in coord._results
+    assert [list(x) for x in out["redo"]] == [["t", seq]]
+    # the matching copy answers and is pre-claimed for the finishers
+    coord._handle_exchange(0, [], {},
+                           redo_results={"t": (seq, np.full(2, 7.0))})
+    assert "t" in coord._results
+    # world minus reporter(2): {0,1,3} pre-claimed; 2 claims on its re-poll
+    assert coord._claimed["t"] == {0, 1, 3}
+    out = coord._handle_exchange(2, [{"name": "t", "op": "allreduce",
+                                      "shape": (2,), "dtype": "float64",
+                                      "root": 0, "average": True}],
+                                 {"t": np.ones(2)})
+    err, val = out["results"]["t"]
+    assert err is None and np.array_equal(val, np.full(2, 7.0))
+    # all four claimed -> the result retired (no lingering stale bits for
+    # the NEXT same-name collective)
+    assert "t" not in coord._results
+
+
+def test_peer_lost_fails_pending_with_reset_error(coord):
+    from horovod_tpu.common.engine import _FATAL
+
+    coord._pending["g"] = {0: ({"op": "allreduce"}, np.ones(2))}
+    coord._peer_lost(2)
+    err, _ = coord._results["g"]
+    assert err.startswith(_FATAL) and "rank 2" in err
+    assert not coord._pending
+    # idempotent
+    coord._peer_lost(2)
+    # new names keep failing while the rank is dead (rung 3 backstop)
+    out = coord._handle_exchange(0, [{"name": "h", "op": "allreduce",
+                                      "shape": (2,), "dtype": "float64",
+                                      "root": 0, "average": True}],
+                                 {"h": np.ones(2)})
+    err, _ = out["results"]["h"]
+    assert err.startswith(_FATAL)
+
+
+def test_fatal_error_surfaces_as_internal_error():
+    from horovod_tpu.common.engine import (_FATAL, HorovodInternalError,
+                                           TensorShapeMismatchError)
+
+    # the client maps [reset]-tagged errors to the reset-worthy class
+    err = _FATAL + "lost control connection to rank 1"
+    exc = HorovodInternalError(err) if err.startswith(_FATAL) \
+        else TensorShapeMismatchError(err)
+    assert type(exc) is HorovodInternalError
+
+
+def test_exchange_response_carries_plane_epochs(coord):
+    out = coord._handle_exchange(0, [], {})
+    assert "plane" not in out     # steady state: no extra bytes
+    coord.ring_active = True
+    coord._handle_plane_fault(1, [], "boom")
+    out = coord._handle_exchange(0, [], {})
+    assert out["plane"] == {"demote": 1, "reprobe": 0}
+
+
+def test_reprobe_fires_after_cooldown(coord):
+    coord.ring_active = True
+    coord._handle_plane_fault(1, [], "boom")
+    coord._ring_endpoints[0] = {"enabled": True}
+    coord._ring_votes[0] = False
+    with coord._cv:
+        coord._maybe_schedule_reprobe()
+        assert coord._reprobe_epoch == 0    # cooldown not expired
+        coord._repromote_at = time.monotonic() - 1
+        coord._maybe_schedule_reprobe()
+        assert coord._reprobe_epoch == 1
+        # establishment barriers cleared for the re-entry
+        assert not coord._ring_endpoints and not coord._ring_votes
+        assert coord._repromote_at is None
+    out = coord._handle_exchange(0, [], {})
+    assert out["plane"] == {"demote": 1, "reprobe": 1}
+
+
+def test_reprobe_held_while_a_rank_is_dead(coord):
+    coord.ring_active = True
+    coord._handle_plane_fault(1, [], "boom")
+    coord._peer_lost(3)
+    with coord._cv:
+        coord._repromote_at = time.monotonic() - 1
+        coord._maybe_schedule_reprobe()
+        assert coord._reprobe_epoch == 0    # dead rank: stay on the star
+
+
+# ----------------------------------------------------------- e2e (4-proc)
+
+WORKER = r"""
+import hashlib, json, os, sys, time
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import PyEngine, HorovodInternalError
+from horovod_tpu.common.topology import Topology
+from horovod_tpu import metrics as hvd_metrics
+
+rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+steps = int(os.environ["T_STEPS"]); settle = int(os.environ["T_SETTLE"])
+eng = PyEngine(Topology(rank, world, 0, 1, rank, world),
+               Config(cycle_time_ms=1.0, stall_check_disable=True))
+errors = 0
+digest = hashlib.sha256()
+try:
+    for i in range(steps):
+        for t in range(2):
+            try:
+                out = eng.run("allreduce",
+                              np.arange(128, dtype=np.float32) * (rank + 1)
+                              + i + t, f"g.{t}")
+                digest.update(out.tobytes())
+            except HorovodInternalError:
+                errors += 1
+        time.sleep(0.01)
+    for j in range(settle):
+        eng.run("allreduce", np.ones(4, dtype=np.float32), f"s.{j}")
+        time.sleep(0.05)
+    snap = hvd_metrics.registry().snapshot()
+    print(json.dumps({
+        "hash": digest.hexdigest(), "errors": errors,
+        "demotions": snap["counters"].get("horovod_plane_demotions_total", 0),
+        "repromotions": snap["counters"].get(
+            "horovod_plane_repromotions_total", 0),
+        "plane": snap["gauges"].get("horovod_plane_current", -1),
+    }), flush=True)
+finally:
+    eng.shutdown()
+"""
+
+
+@pytest.mark.slow
+def test_injected_reset_demotes_then_repromotes_bitwise():
+    from launch_util import launch_world
+
+    base = {"HOROVOD_ENGINE": "python", "HOROVOD_RING_DATA_PLANE": "1",
+            "HOROVOD_NETWORK_TIMEOUT": "0.4", "HOROVOD_NETWORK_RETRIES": "3",
+            "T_STEPS": "14", "T_SETTLE": "0",
+            "HOROVOD_PLANE_REPROMOTE_S": "0"}
+    clean = launch_world(4, WORKER, extra_env=base)
+    faulty = launch_world(4, WORKER, extra_env={
+        **base, "T_SETTLE": "50", "HOROVOD_PLANE_REPROMOTE_S": "1.0",
+        "HOROVOD_FAULT_NET": "reset", "HOROVOD_FAULT_NET_RANK": "1",
+        "HOROVOD_FAULT_NET_SCOPE": "ring",
+        # land the reset mid-run: after ~7 steps x 2 tensors x 6 frames
+        "HOROVOD_FAULT_NET_AFTER": "84", "HOROVOD_FAULT_NET_COUNT": "1"})
+    clean_hash = {r["out"]["hash"] for r in clean}
+    assert len(clean_hash) == 1
+    for r in faulty:
+        o = r["out"]
+        assert o["errors"] == 0, "ladder escalated past demotion"
+        assert o["demotions"] >= 1, "reset did not demote the plane"
+        assert o["repromotions"] >= 1, "cooldown probe never re-promoted"
+        assert o["plane"] == 1, "world did not return to the ring plane"
+    assert {r["out"]["hash"] for r in faulty} == clean_hash, \
+        "faulted world diverged bitwise from the clean world"

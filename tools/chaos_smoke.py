@@ -1,0 +1,347 @@
+#!/usr/bin/env python
+"""CI network-chaos smoke for the transport-resilience ladder (ISSUE 8;
+wired into ci.sh).
+
+Runs 4-process Python-engine worlds under env-triggered frame-level fault
+injection (elastic/fault.py HOROVOD_FAULT_NET hooks inside the authenticated
+Channel) and asserts that each fault class stops at the RIGHT rung of the
+graded escalation ladder:
+
+1. **delay** (rung 1 — retry in place): a 1.2 s stall on one ring link is
+   absorbed by the receive retry budget (HOROVOD_NETWORK_TIMEOUT x
+   HOROVOD_NETWORK_RETRIES): ``horovod_transport_retries_total`` > 0, ZERO
+   plane demotions, results bitwise identical to the clean world.
+2. **reset** (rung 2 — demote, then re-promote): an injected RST on a ring
+   link mid-run demotes the whole world to the star relay
+   (``horovod_plane_demotions_total`` >= 1 per rank), the interrupted
+   collective replays with BITWISE-identical results (the canonical chunk
+   order is shared by both planes), ``horovod_elastic_resets_total`` stays
+   0, and after the HOROVOD_PLANE_REPROMOTE_S cooldown every rank is back
+   on the ring (``horovod_plane_repromotions_total`` >= 1,
+   ``horovod_plane_current`` == 1).
+3. **corrupt** and **drop** (rung 2 via frame authentication): a flipped MAC
+   byte / a swallowed frame is REJECTED by the receiver
+   (``horovod_frames_rejected_total`` >= 1 — never unpickled, never
+   silently substituted), the link fault demotes the plane, results stay
+   bitwise identical, zero elastic resets.
+4. **kill** (rung 3 — elastic reset): a worker killed mid-run under the
+   real elastic driver escalates past retries and demotion to EXACTLY ONE
+   re-rendezvous — the coordinator's control-connection loss fails the
+   in-flight collectives immediately (no stall-watchdog wait: the smoke
+   sets no stall env), the survivors raise HorovodInternalError into
+   hvd.elastic.run, and training completes on the survivors with exact
+   resumed state.
+
+Exits non-zero with a reason on any violation. Wall-clock budget: ~60 s.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORLD = 4
+STEPS = 26
+TENSORS = 4
+# Outbound ring frames per step on one rank: (world-1) reduce-scatter +
+# (world-1) allgather sends per tensor. The AFTER selector counts frames on
+# the injecting rank only, so the fault lands mid-run deterministically.
+FRAMES_PER_STEP = 2 * (WORLD - 1) * TENSORS
+FAULT_STEP = 12
+
+WORKER = r"""
+import hashlib, json, os, sys, time
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import PyEngine, HorovodInternalError
+from horovod_tpu.common.topology import Topology
+from horovod_tpu import metrics as hvd_metrics
+
+rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+steps = int(os.environ["SMOKE_STEPS"]); tensors = int(os.environ["SMOKE_TENSORS"])
+sleep_s = float(os.environ.get("SMOKE_STEP_SLEEP", "0") or 0)
+settle = int(os.environ.get("SMOKE_SETTLE", "0") or 0)
+eng = PyEngine(Topology(rank, world, 0, 1, rank, world),
+               Config(cycle_time_ms=1.0, stall_check_disable=True))
+internal_errors = 0
+digest = hashlib.sha256()
+try:
+    for i in range(steps):
+        for t in range(tensors):
+            try:
+                out = eng.run("allreduce",
+                              np.arange(256, dtype=np.float32) * (rank + 1)
+                              + i + t, f"grad.{t}")
+                digest.update(out.tobytes())
+            except HorovodInternalError:
+                internal_errors += 1
+        if sleep_s:
+            time.sleep(sleep_s)
+    # Settle window (reset leg): keep the world ticking a FIXED number of
+    # extra collectives — identical on every rank, so no rank diverges on a
+    # local decision — long enough for the demotion cooldown to expire and
+    # the re-promotion probe to rebuild the ring.
+    for j in range(settle):
+        try:
+            eng.run("allreduce", np.ones(8, dtype=np.float32) * (rank + 1),
+                    f"settle.{j}")
+        except HorovodInternalError:
+            internal_errors += 1
+        time.sleep(0.05)
+    snap = hvd_metrics.registry().snapshot()
+    c, g = snap["counters"], snap["gauges"]
+    print(json.dumps({
+        "rank": rank,
+        "hash": digest.hexdigest(),
+        "internal_errors": internal_errors,
+        "ring_active": eng.cache_stats()["ring_active"],
+        "retries": c.get("horovod_transport_retries_total", 0),
+        "timeouts": c.get("horovod_transport_timeouts_total", 0),
+        "rejected": c.get("horovod_frames_rejected_total", 0),
+        "demotions": c.get("horovod_plane_demotions_total", 0),
+        "repromotions": c.get("horovod_plane_repromotions_total", 0),
+        "plane": g.get("horovod_plane_current", -1),
+        "elastic_resets": c.get("horovod_elastic_resets_total", 0),
+    }), flush=True)
+finally:
+    eng.shutdown()
+"""
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def fail(msg: str) -> None:
+    print(f"chaos smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run_world(fault_env: dict, settle: int = 0,
+              sleep_s: float = 0.0) -> list[dict]:
+    port = free_port()
+    secret = secrets.token_hex(16)
+    procs = []
+    for rank in range(WORLD):
+        env = dict(os.environ)
+        env.update({
+            "HVD_REPO": REPO,
+            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(WORLD),
+            "HOROVOD_COORD_ADDR": f"127.0.0.1:{port}",
+            "HOROVOD_SECRET": secret,
+            "HOROVOD_ENGINE": "python",
+            "HOROVOD_RING_DATA_PLANE": "1",
+            # Tight ladder so faults resolve in seconds, not minutes:
+            # 0.4 s idle deadline x (1 + 3) attempts = 1.6 s patience.
+            "HOROVOD_NETWORK_TIMEOUT": "0.4",
+            "HOROVOD_NETWORK_RETRIES": "3",
+            "HOROVOD_PLANE_REPROMOTE_S": "0",
+            "SMOKE_STEPS": str(STEPS),
+            "SMOKE_TENSORS": str(TENSORS),
+            "SMOKE_SETTLE": str(settle),
+            "SMOKE_STEP_SLEEP": str(sleep_s),
+        })
+        env.update(fault_env)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=120)
+            if p.returncode != 0:
+                fail(f"worker rc={p.returncode}:\n{stderr[-2000:]}")
+            outs.append(json.loads(stdout.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return outs
+
+
+def check_common(leg: str, outs: list[dict], clean_hash: str) -> None:
+    """Every non-kill leg: no reset-worthy errors, no elastic resets, and
+    the collective results bitwise identical to the fault-free world."""
+    for r in outs:
+        if r["internal_errors"]:
+            fail(f"{leg}: rank {r['rank']} saw {r['internal_errors']} "
+                 "HorovodInternalError(s) — the ladder escalated past its "
+                 "rung")
+        if r["elastic_resets"]:
+            fail(f"{leg}: rank {r['rank']} counted "
+                 f"{r['elastic_resets']} elastic resets (want 0)")
+    hashes = {r["hash"] for r in outs}
+    if len(hashes) != 1:
+        fail(f"{leg}: results differ across ranks")
+    if hashes != {clean_hash}:
+        fail(f"{leg}: results diverge bitwise from the fault-free world")
+
+
+def fault(kind: str, at_step: int = FAULT_STEP, **extra) -> dict:
+    env = {"HOROVOD_FAULT_NET": kind,
+           "HOROVOD_FAULT_NET_RANK": "1",
+           "HOROVOD_FAULT_NET_SCOPE": "ring",
+           "HOROVOD_FAULT_NET_AFTER": str(at_step * FRAMES_PER_STEP),
+           "HOROVOD_FAULT_NET_COUNT": "1"}
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def run_kill_leg() -> tuple[int, float]:
+    """Rung 3 under the real elastic driver: a killed worker escalates to
+    exactly one re-rendezvous. No stall-watchdog env — detection rides the
+    coordinator's control-connection loss (_peer_lost), not the watchdog."""
+    from horovod_tpu.metrics import validate_snapshot
+    from horovod_tpu.runner import run_elastic
+
+    total_steps, kill_step, world = 8, 3, 3
+    tmp = tempfile.mkdtemp(prefix="hvd_chaos_smoke_")
+    event_log = os.path.join(tmp, "events.jsonl")
+    snapshot_path = os.path.join(tmp, "pod_metrics.json")
+    os.environ["HOROVOD_METRICS_SNAPSHOT"] = snapshot_path
+
+    def entry():
+        import os as _os
+
+        import numpy as _np
+
+        import horovod_tpu as hvd
+
+        state = hvd.elastic.ElasticState(step=0, acc=0.0)
+
+        def train(state):
+            while state.step < total_steps:
+                gen = _os.environ.get("HOROVOD_ELASTIC_GENERATION", "0")
+                out = hvd.allreduce(_np.ones(2), average=True,
+                                    name=f"grad.{state.step}.g{gen}")
+                state.acc = state.acc + float(out[0])
+                state.step += 1
+                state.commit()
+            return (hvd.rank(), int(state.step), float(state.acc))
+
+        return hvd.elastic.run(train)(state)
+
+    t0 = time.monotonic()
+    try:
+        results = run_elastic(
+            entry, num_proc=world, timeout=120,
+            env={"HOROVOD_ENGINE": "python",
+                 "HOROVOD_ELASTIC_EVENT_LOG": event_log,
+                 "HOROVOD_ELASTIC_BLACKLIST_THRESHOLD": "1",
+                 "HOROVOD_FAULT_INJECT_STEP": str(kill_step),
+                 "HOROVOD_FAULT_INJECT_INDEX": "2"})
+    except Exception as e:
+        fail(f"kill leg: elastic job did not complete: "
+             f"{type(e).__name__}: {e}")
+    elapsed = time.monotonic() - t0
+    if len(results) != world - 1:
+        fail(f"kill leg: expected {world - 1} survivor results, got "
+             f"{results}")
+    for r, (rank, step, acc) in enumerate(results):
+        if (rank, step, acc) != (r, total_steps, float(total_steps)):
+            fail(f"kill leg: wrong resumed state on rank {r}: "
+                 f"{(rank, step, acc)}")
+    events = [json.loads(line) for line in open(event_log)]
+    kinds = [e["event"] for e in events]
+    rendezvous = kinds.count("rendezvous_complete")
+    if rendezvous != 2:
+        fail(f"kill leg: expected exactly 2 formed generations (one elastic "
+             f"reset), got {rendezvous}: {kinds}")
+    with open(snapshot_path) as f:
+        pod = json.load(f)
+    errs = validate_snapshot(pod)
+    if errs:
+        fail(f"kill leg: pod snapshot schema violations: {errs[:5]}")
+    resets = pod["counters"].get("horovod_elastic_resets_total", 0)
+    if resets < 1:
+        fail(f"kill leg: pod horovod_elastic_resets_total={resets}, "
+             "expected >= 1")
+    gen = pod.get("info", {}).get("elastic", {}).get("generation", 0)
+    if gen != 2:
+        fail(f"kill leg: pod info.elastic.generation={gen}, expected "
+             "exactly 2 (one reset)")
+    return int(resets), elapsed
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    clean = run_world({})
+    for r in clean:
+        if not r["ring_active"]:
+            fail(f"clean: rank {r['rank']} ring not active")
+        if r["demotions"] or r["internal_errors"]:
+            fail(f"clean: rank {r['rank']} demoted or errored with no fault "
+                 f"injected: {r}")
+    clean_hash = clean[0]["hash"]
+    check_common("clean", clean, clean_hash)
+
+    # rung 1: a 1.2 s link stall < the 1.6 s patience — absorbed by retries.
+    delay = run_world(fault("delay", HOROVOD_FAULT_NET_DELAY_MS=1200))
+    check_common("delay", delay, clean_hash)
+    if sum(r["retries"] for r in delay) < 1:
+        fail(f"delay: no transport retries counted: {delay}")
+    if sum(r["demotions"] for r in delay) != 0:
+        fail(f"delay: retry-absorbable stall demoted the plane: {delay}")
+    for r in delay:
+        if r["plane"] != 1:
+            fail(f"delay: rank {r['rank']} not on the ring plane at exit")
+
+    # rung 2: an RST mid-run demotes ring -> star with bitwise-identical
+    # replays, then the cooldown probe re-promotes every rank to the ring.
+    # 60 settle collectives x 50 ms >> the 1.5 s re-promotion cooldown.
+    reset = run_world(fault("reset", HOROVOD_PLANE_REPROMOTE_S=1.5),
+                      settle=60, sleep_s=0.02)
+    check_common("reset", reset, clean_hash)
+    for r in reset:
+        if r["demotions"] < 1:
+            fail(f"reset: rank {r['rank']} never demoted "
+                 f"(demotions={r['demotions']})")
+        if r["repromotions"] < 1:
+            fail(f"reset: rank {r['rank']} never re-promoted after the "
+                 f"cooldown (repromotions={r['repromotions']})")
+        if r["plane"] != 1:
+            fail(f"reset: rank {r['rank']} finished on plane {r['plane']}, "
+                 "want 1 (ring) after re-promotion")
+
+    # rung 2 via frame authentication: corrupt + drop frames are rejected
+    # (counted), demote the plane, and never poison the results.
+    for kind in ("corrupt", "drop"):
+        outs = run_world(fault(kind))
+        check_common(kind, outs, clean_hash)
+        if sum(r["rejected"] for r in outs) < 1:
+            fail(f"{kind}: no frames rejected "
+                 f"(horovod_frames_rejected_total == 0)")
+        if sum(r["demotions"] for r in outs) < 1:
+            fail(f"{kind}: rejected frame did not demote the plane")
+
+    # rung 3: a killed worker under the elastic driver — exactly one reset.
+    resets, kill_elapsed = run_kill_leg()
+
+    print(
+        "chaos smoke OK: delay absorbed by "
+        f"{sum(r['retries'] for r in delay):.0f} retries (0 demotions), "
+        f"reset demoted {reset[0]['demotions']:.0f}x + re-promoted to ring "
+        "with bitwise-identical results and 0 elastic resets, "
+        "corrupt/drop frames rejected + demoted, "
+        f"kill escalated to exactly 1 elastic reset "
+        f"({kill_elapsed:.1f}s); total {time.monotonic() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
