@@ -55,6 +55,17 @@ timeout -k 10 240 python tools/eager_smoke.py
 echo "== hier smoke (simulated 2-host x 2-rank grid: two-level plane active, worst-rank cross-host bytes <= 0.35x flat, flat==hier==star bitwise incl. bf16, cache hit rate unchanged) =="
 timeout -k 10 240 python tools/hier_smoke.py
 
+echo "== sparse smoke (ISSUE 9: topk@1% cuts DCN bytes >= 10x on the 2-host grid, star==ring==hier bitwise with sparsification on, steady-state hit rate unchanged, adaptive policy picks ici=none/dcn=topk) =="
+timeout -k 10 240 python tools/sparse_smoke.py
+
+echo "== compression A/B bench + gate (ISSUE 9: none vs bf16 vs topk@1% on f32 ring payloads; the topk byte-reduction metric must exist and clear the 10x absolute floor) =="
+HVD_BENCH_SMOKE=1 HVD_BENCH_BUDGET_S=150 timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python bench.py --compression-ab | tee /tmp/hvd_compression_ab.log
+python tools/perf_gate.py --current /tmp/hvd_compression_ab.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric compression_ab_topk_byte_reduction \
+  --min-abs compression_ab_topk_byte_reduction=10 --allow-missing-baseline
+
 echo "== hier A/B bench + gate (ISSUE 7: cross-byte reduction metric must exist and clear the 2.5x floor — CI fails if a change silently re-inflates DCN traffic) =="
 HVD_BENCH_SMOKE=1 timeout -k 10 240 python bench.py --hier-ab | tee /tmp/hvd_hier_ab.log
 python tools/perf_gate.py --current /tmp/hvd_hier_ab.log \

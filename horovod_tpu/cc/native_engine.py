@@ -20,6 +20,7 @@ from . import lib_path
 # Shared exception types: user except clauses must match regardless of which
 # engine implementation is active.
 from ..common.engine import HorovodInternalError, TensorShapeMismatchError  # noqa: F401
+from ..utils.logging import log
 
 # Order in sync with hvd_common.h.
 OPS = {"allreduce": 0, "allgather": 1, "broadcast": 2, "reducescatter": 3, "alltoall": 4}
@@ -154,8 +155,21 @@ class NativeEngine:
         # And the wire-compression dtype (engine.h wire_dtype_from_env,
         # read at Engine construction): export the Config value so
         # Config(compression=...) behaves like every other field.
-        os.environ["HOROVOD_COMPRESSION"] = str(
-            getattr(config, "compression", "none") or "none")
+        _comp = str(getattr(config, "compression", "none") or "none")
+        os.environ["HOROVOD_COMPRESSION"] = _comp
+        from ..compression import normalize as _comp_normalize
+
+        if _comp_normalize(_comp) in ("topk", "adaptive"):
+            # The sparse wire and the adaptive policy live in the Python
+            # engine (common/engine.py + common/policy.py); the C++ parser
+            # maps unknown names to dense. Keep that no-op LOUD (the repo
+            # rule since VERDICT r3) instead of silently shipping full
+            # width.
+            log("warning",
+                f"HOROVOD_COMPRESSION={_comp} is implemented by the Python "
+                "engine only; the native engine ships dense payloads (set "
+                "HOROVOD_ENGINE=python for sparse/adaptive compression, or "
+                "use bf16/fp16 here)", rank=topo.rank)
         # Distributed tracing (ISSUE 6): same env crossing as the knobs
         # above (the C++ engine reads HOROVOD_TRACE_DIR at construction).
         trace_dir = getattr(config, "trace_dir", "") or ""

@@ -62,18 +62,20 @@ DEFAULT_COMPRESSION_MIN_BYTES = 4096
 
 
 def _env_compression() -> str:
-    """HOROVOD_COMPRESSION={none,fp16,bf16}: the wire dtype every data plane
-    casts gradient payloads to (docs/compression.md). Unknown values warn
-    and fall back to none — config parsing never takes the job down."""
-    from ..compression import WIRE_DTYPES
+    """HOROVOD_COMPRESSION={none,fp16,bf16,topk,adaptive}: the wire format
+    every data plane applies to gradient payloads (docs/compression.md).
+    ``topk@<ratio>`` specs (the autotune spelling) are kept verbatim —
+    the engine's parse_spec extracts the ratio. Unknown values warn and
+    fall back to none — config parsing never takes the job down."""
+    from ..compression import WIRE_DTYPES, parse_spec
 
     v = os.environ.get("HOROVOD_COMPRESSION", "none").lower() or "none"
-    if v not in WIRE_DTYPES:
+    if v not in WIRE_DTYPES and parse_spec(v) == ("none", None):
         import sys
 
         print(f"[horovod_tpu/warning] unknown HOROVOD_COMPRESSION={v!r}; "
-              f"expected one of {sorted(WIRE_DTYPES)}; using 'none'",
-              file=sys.stderr)
+              f"expected one of {sorted(WIRE_DTYPES)} or 'topk@<ratio>'; "
+              "using 'none'", file=sys.stderr)
         return "none"
     return v
 # Stall-check warning period: 60 s (reference operations.cc:258 STALL_WARNING_TIME).
@@ -188,6 +190,12 @@ class Config:
     compression_min_bytes: int = field(                   # HOROVOD_COMPRESSION_MIN_BYTES
         default_factory=lambda: max(0, _env_int(
             "HOROVOD_COMPRESSION_MIN_BYTES", DEFAULT_COMPRESSION_MIN_BYTES)))
+    # Sparse top-k wire format (ISSUE 9, docs/compression.md): fraction of
+    # entries a topk-compressed gradient keeps. Env-aware default like the
+    # compression fields above. 0.0 means "unset" — resolution falls back
+    # to HOROVOD_TOPK_RATIO / the 1% default at use time.
+    topk_ratio: float = field(                            # HOROVOD_TOPK_RATIO
+        default_factory=lambda: _env_float("HOROVOD_TOPK_RATIO", 0.0))
     # Fabric-aware compiled plane (ISSUE 7, docs/hierarchical.md): a wire
     # dtype and a bucket-size cap applied to the DCN (cross-host) tier of
     # the hierarchical ladder only. Empty dcn_compression inherits the

@@ -53,9 +53,11 @@ def cache_capacity_from_env() -> int:
 
 def request_key(req: dict) -> tuple:
     """Signature tuple for a request dict (engine wire shape). The trailing
-    element is the wire-compression dtype ('' = uncompressed), so a cache
-    bit bound under one wire dtype invalidates when HOROVOD_COMPRESSION
-    changes — mirroring PyEngine._entry_key exactly."""
+    element is the wire FORMAT — a wire dtype name ('bfloat16'/'float16'),
+    the sparse 'topk' tag (ISSUE 9), or '' for uncompressed — so a cache
+    bit bound under one format invalidates when HOROVOD_COMPRESSION (or an
+    adaptive-policy resolution) changes, exactly like a shape change —
+    mirroring PyEngine._entry_key."""
     return (req["name"], req["op"], tuple(req["shape"]), req["dtype"],
             req.get("root", 0), bool(req.get("average", True)),
             str(req.get("wire") or ""))

@@ -200,7 +200,13 @@ def DistributedOptimizer(
     (non-float and tiny buckets opt out per bucket). bf16 is the TPU pick —
     fp32 exponent range, so no loss scaling. The wire dtype joins the
     ``(fusion_threshold, num_buckets)`` joint autotune as a third dimension
-    (``bench.py --compression-ab``). Full story: docs/compression.md.
+    (``bench.py --compression-ab``), where ``"topk@<ratio>"`` specs put
+    the sparse ratio on the same categorical axis (ISSUE 9).
+    ``hvd.Compression.topk`` / ``adaptive`` resolve here too: the eager
+    engines sparsify / apply the per-tier policy, while this compiled
+    path substitutes the policy's dense tier table (full width on ICI,
+    bf16 on the DCN psum) — XLA collectives cannot ship runtime-sparse
+    frames. Full story: docs/compression.md.
 
     ``hierarchical`` (or HOROVOD_HIERARCHICAL_ALLREDUCE) routes every
     bucket over the two-level fabric ladder on a ``('dcn','ici')`` mesh,

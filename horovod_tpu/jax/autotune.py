@@ -254,7 +254,12 @@ def tune(step_factory: Callable[..., Callable[[], None]],
     (threshold, buckets) plane, exactly how the native ParameterManager
     treats its hierarchical categoricals beside the numeric knobs. The
     factory is then called with an extra ``compression=`` kwarg (a
-    HOROVOD_COMPRESSION name).
+    HOROVOD_COMPRESSION name). Since ISSUE 9 the grid may also carry
+    ``"topk@<ratio>"`` specs — the top-k ratio rides the same categorical
+    dimension (``compressions=("none", "bf16", "topk@0.01",
+    "topk@0.05")``), so a factory that exports the spec to
+    HOROVOD_COMPRESSION lets the tuner pick the sparsity level alongside
+    the dtype (compression.parse_spec splits the ratio back out).
 
     ``hierarchicals``: a grid of ladder choices (e.g. ``(False, True)``)
     joins as the FOURTH joint dimension (ISSUE 7) — categorical like the

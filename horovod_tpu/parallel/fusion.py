@@ -32,7 +32,7 @@ from .mesh import HVD_AXIS
 from ..common.config import (DEFAULT_COMPRESSION_MIN_BYTES,
                              DEFAULT_FUSION_THRESHOLD, _env_int)
 from ..compat import axis_size
-from ..compression import compression_name, numpy_wire_dtype
+from ..compression import compiled_formats, compression_name, numpy_wire_dtype
 
 
 @dataclass(frozen=True)
@@ -220,6 +220,11 @@ def wire_dtype_for_bucket(compression, dtype, nbytes: int, op,
     return jnp.dtype(wire) if wire is not None else None
 
 
+# One-shot warning latch: topk on the compiled plane runs dense (see the
+# resolution block in fused_allreduce); say so once, not per trace.
+_TOPK_COMPILED_WARNED = False
+
+
 def fused_allreduce(
     tree,
     axis_name: str = HVD_AXIS,
@@ -262,6 +267,28 @@ def fused_allreduce(
     bucket cap becomes ``dcn_threshold * ici_size``; None reads
     HOROVOD_DCN_FUSION_THRESHOLD, 0 = no separate cap). The per-tier plan
     lands in trace-time gauges (metrics.record_tier_plan)."""
+    # Policy names resolve to concrete dense formats here (ISSUE 9): the
+    # compiled plane can't ship runtime-sparse frames (XLA collectives have
+    # static shapes), so 'topk' runs dense — LOUDLY — and 'adaptive'
+    # substitutes its compiled tier table: full width on ICI, bf16 on the
+    # hierarchical ladder's DCN psum (compression.compiled_formats).
+    _comp_name = compression_name(compression)
+    if _comp_name in ("topk", "adaptive"):
+        _ici_fmt, _dcn_fmt = compiled_formats(_comp_name)
+        if _comp_name == "topk":
+            global _TOPK_COMPILED_WARNED
+            if not _TOPK_COMPILED_WARNED:
+                _TOPK_COMPILED_WARNED = True
+                from ..utils.logging import log
+
+                log("warning",
+                    "HOROVOD_COMPRESSION=topk applies to the eager engines "
+                    "only; the compiled plane ships dense buckets (use "
+                    "bf16/adaptive for a compiled-plane wire cut)")
+        if dcn_compression is None:
+            dcn_compression = (os.environ.get("HOROVOD_DCN_COMPRESSION", "")
+                               or _dcn_fmt)
+        compression = _ici_fmt
     pad_to = 1
     if hierarchical and op not in (collectives.ReduceOp.SUM,
                                    collectives.ReduceOp.AVERAGE):

@@ -25,9 +25,26 @@ from horovod_tpu.common.engine import (
 from horovod_tpu.common.topology import Topology
 from horovod_tpu.compression import (
     Compression,
+    compiled_formats,
     compression_name,
     numpy_dtype_by_name,
     numpy_wire_dtype,
+    parse_spec,
+    topk_densify,
+    topk_eligible,
+    topk_encode,
+    topk_k,
+    topk_merge,
+    topk_pack,
+    topk_pack_dense,
+    topk_ratio_from_env,
+    topk_select,
+    topk_sparsify,
+    topk_state_add,
+    topk_state_dense,
+    topk_state_scale,
+    topk_state_slice,
+    topk_unpack,
 )
 
 from launch_util import launch_world
@@ -519,3 +536,498 @@ def test_autotune_compression_third_dimension():
     assert report.best.num_buckets == 4
     assert report.best.config["compression"] == "bf16"
     assert "compression" in report.knob_curve()
+
+
+# ---------------------------------------------------------- topk unit tier
+# Sparse top-k wire format (ISSUE 9, docs/compression.md).
+
+def test_topk_spec_and_eligibility():
+    assert parse_spec("topk") == ("topk", None)
+    assert parse_spec("topk@0.05") == ("topk", 0.05)
+    assert parse_spec("topk@bogus") == ("none", None)
+    assert parse_spec("adaptive") == ("adaptive", None)
+    assert compression_name(Compression.topk) == "topk"
+    assert Compression.by_name("topk@0.02") is Compression.topk
+    assert Compression.by_name("adaptive") is Compression.adaptive
+    # topk/adaptive are NOT dtype casts: no wire dtype resolves.
+    assert numpy_wire_dtype("topk", np.float32) is None
+    assert numpy_wire_dtype("adaptive", np.float32) is None
+    # The compiled plane's substitution table.
+    assert compiled_formats("adaptive") == ("none", "bf16")
+    assert compiled_formats("topk") == ("none", "none")
+    assert compiled_formats("bf16") == ("bf16", "bf16")
+    # Eligibility: f32 only, floor HOROVOD_COMPRESSION_MIN_BYTES, and
+    # sparse must beat dense (ratio bound).
+    assert topk_eligible(np.float32, 1 << 20, 0.01, 4096)
+    assert not topk_eligible(np.float64, 1 << 20, 0.01, 4096)
+    assert not topk_eligible(np.float32, 1024, 0.01, 4096)
+    assert not topk_eligible(np.float32, 1 << 20, 0.9, 4096)
+    assert topk_k(1000, 0.01) == 10
+    assert topk_k(10, 0.001) == 1  # floor: k >= 1
+
+
+def test_topk_ratio_env(monkeypatch):
+    monkeypatch.delenv("HOROVOD_TOPK_RATIO", raising=False)
+    assert topk_ratio_from_env() == 0.01
+    monkeypatch.setenv("HOROVOD_TOPK_RATIO", "0.05")
+    assert topk_ratio_from_env() == 0.05
+    monkeypatch.setenv("HOROVOD_TOPK_RATIO", "0.9")  # clamp: > 0.5 never pays
+    assert topk_ratio_from_env() == 0.5
+    monkeypatch.setenv("HOROVOD_TOPK_RATIO", "junk")
+    assert topk_ratio_from_env() == 0.01
+    monkeypatch.setenv("HOROVOD_TOPK_RATIO", "-1")
+    assert topk_ratio_from_env() == 0.01
+
+
+def test_topk_select_deterministic_and_zero_free():
+    x = np.array([0.0, -3.0, 2.0, -2.0, 0.5, -0.0, 3.0], np.float32)
+    idx, val = topk_select(x, 4)
+    # Magnitude descending with lower-index tie-break: |−3|=|3| picks
+    # index 1 first; |2|=|−2| picks index 2 first. Output index-ascending.
+    np.testing.assert_array_equal(idx, [1, 2, 3, 6])
+    np.testing.assert_array_equal(val, x[[1, 2, 3, 6]])
+    # Exact zeros (and -0.0) are never selected, even when k exceeds the
+    # nonzero count — the empty-k edge collapses to the nonzero support.
+    idx, val = topk_select(np.zeros(8, np.float32), 4)
+    assert idx.size == 0 and val.size == 0
+    i2, v2 = topk_select(x, 100)
+    assert 0 not in i2 and 5 not in i2 and len(i2) == 5
+    # Deterministic: same input, same selection.
+    rng = np.random.default_rng(1)
+    big = rng.standard_normal(10000).astype(np.float32)
+    a = topk_select(big, 100)
+    b = topk_select(big.copy(), 100)
+    np.testing.assert_array_equal(a[0], b[0])
+
+
+def test_topk_pack_unpack_roundtrip_and_validation():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal(500).astype(np.float32)
+    idx, val = topk_select(x, 20)
+    kind, i2, v2 = topk_unpack(topk_pack(idx, val), 500)
+    assert kind == "sparse"
+    np.testing.assert_array_equal(i2, idx)
+    np.testing.assert_array_equal(v2, val)
+    kind, arr = topk_unpack(topk_pack_dense(x), 500)
+    assert kind == "dense"
+    np.testing.assert_array_equal(arr, x)
+    # Empty sparse frame (the all-zero tensor) roundtrips.
+    e = np.array([], np.int32)
+    kind, i0, v0 = topk_unpack(topk_pack(e, e.astype(np.float32)), 500)
+    assert kind == "sparse" and i0.size == 0 and v0.size == 0
+    # Corrupt/inconsistent frames fail loudly, never scatter blindly.
+    with pytest.raises(ValueError):
+        topk_unpack(topk_pack(idx, val), 10)        # k > n
+    with pytest.raises(ValueError):
+        topk_unpack(topk_pack_dense(x), 400)        # wrong dense length
+    with pytest.raises(ValueError):
+        topk_unpack(np.array([7], np.uint8), 4)     # unknown kind
+    bad = topk_pack(np.array([3, 2], np.int32), np.ones(2, np.float32))
+    with pytest.raises(ValueError):
+        topk_unpack(bad, 500)                        # non-ascending indices
+
+
+def test_topk_merge_overflow_and_state_ops():
+    n = 100
+    i1 = np.array([1, 5, 9], np.int32)
+    v1 = np.array([1.0, 2.0, 3.0], np.float32)
+    i2 = np.array([5, 50], np.int32)
+    v2 = np.array([10.0, 20.0], np.float32)
+    st = topk_merge(i1, v1, i2, v2, n)
+    assert st[0] == "sparse"
+    np.testing.assert_array_equal(st[1], [1, 5, 9, 50])
+    np.testing.assert_array_equal(st[2], [1.0, 12.0, 3.0, 20.0])
+    # Densify-on-overflow: past max_nnz the merge returns dense — with the
+    # identical values.
+    dense_st = topk_merge(i1, v1, i2, v2, n, max_nnz=3)
+    assert dense_st[0] == "dense"
+    np.testing.assert_array_equal(
+        dense_st[1], topk_state_dense(st, n))
+    # state_add into a dense accumulator == dense elementwise add.
+    st2 = topk_state_add(dense_st, i1, v1, n)
+    assert st2[0] == "dense"
+    np.testing.assert_array_equal(
+        st2[1], dense_st[1] + topk_densify(i1, v1, n))
+    # Empty merges are no-ops either way around.
+    e = np.array([], np.int32)
+    ev = np.array([], np.float32)
+    assert topk_merge(e, ev, e, ev, n)[1].size == 0
+    np.testing.assert_array_equal(
+        topk_state_dense(topk_merge(e, ev, i1, v1, n), n),
+        topk_densify(i1, v1, n))
+    # Slice re-bases indices; scale divides values only (zeros stay +0.0).
+    sl = topk_state_slice(st, 4, 60)
+    np.testing.assert_array_equal(
+        topk_state_dense(sl, 56), topk_state_dense(st, n)[4:60])
+    sc = topk_state_scale(st, 4)
+    np.testing.assert_array_equal(sc[2], st[2] / 4)
+    # Encode: sparse when preferred and smaller; dense states re-sparsify
+    # when the next tier prefers sparse (value-neutral either way).
+    assert int(topk_encode(st, n, True)[0]) == 0
+    assert int(topk_encode(st, n, False)[0]) == 1
+    assert int(topk_encode(dense_st, n, True)[0]) == 0
+    for frame, prefer in ((topk_encode(dense_st, n, True), True),
+                          (topk_encode(st, n, False), False)):
+        np.testing.assert_array_equal(
+            topk_state_dense(topk_unpack(frame, n), n),
+            topk_state_dense(st, n))
+
+
+def test_oracle_topk_sentinel_is_pure_f32_fold():
+    """_ring_order_reduce(..., wire_dtype='topk') = the f32 ring-order fold
+    with no per-hop rounding — the canonical order the index-merging
+    planes reproduce. Sparse merges (which skip the zero terms) must be
+    bitwise identical to this dense fold."""
+    rng = np.random.default_rng(5)
+    n, world, k = 4001, 4, 40
+    denses = []
+    for r in range(world):
+        idx, val = topk_select(rng.standard_normal(n).astype(np.float32), k)
+        denses.append(topk_densify(idx, val, n))
+    out = _ring_order_reduce(denses, True, wire_dtype="topk")
+    ref = _ring_order_reduce(denses, True, wire_dtype=np.float32)
+    np.testing.assert_array_equal(out, ref)
+    # Replay the ring's sparse chunk merges and compare bitwise.
+    from horovod_tpu.common.engine import _chunk_bounds
+
+    bounds = _chunk_bounds(n, world)
+    for c in range(world):
+        lo, hi = bounds[c], bounds[c + 1]
+        start = (c + 1) % world
+        st = ("sparse", *topk_sparsify(denses[start][lo:hi]))
+        for j in range(1, world):
+            st = topk_state_add(
+                st, *topk_sparsify(denses[(start + j) % world][lo:hi]),
+                hi - lo)
+        st = topk_state_scale(st, world)
+        np.testing.assert_array_equal(
+            topk_state_dense(st, hi - lo), out[lo:hi])
+    # Grid sentinel: (1, world) degenerates to the flat order.
+    np.testing.assert_array_equal(
+        _ring_order_reduce(denses, True, wire_dtype="topk",
+                           grid=(1, world)), out)
+
+
+def test_single_proc_topk_selects_and_residual(monkeypatch):
+    monkeypatch.delenv("HOROVOD_COMPRESSION_ERROR_FEEDBACK", raising=False)
+    monkeypatch.delenv("HOROVOD_TOPK_RATIO", raising=False)
+    eng = _engine("topk")
+    try:
+        x = ((np.arange(8192, dtype=np.float32) - 4096) / 7)
+        out = eng.run("allreduce", x, "g")
+        # topk@1% keeps exactly 82 entries; the rest is the residual
+        # (error feedback defaults ON for topk — dropping 99% of the mass
+        # without it is a bias, not a compression).
+        assert int((out != 0).sum()) == topk_k(8192, 0.01)
+        res = eng._residuals["g"]
+        np.testing.assert_array_equal(out + res, x)
+        # The residual folds into the NEXT submission of the same name.
+        out2 = eng.run("allreduce", x, "g")
+        assert int((out2 != 0).sum()) == topk_k(8192, 0.01)
+        assert not np.array_equal(out, out2)
+        # Flush (elastic reset) drops residuals.
+        eng.cache_flush()
+        assert not eng._residuals
+        # Sub-floor and non-f32 tensors ship dense, untouched.
+        tiny = np.ones(16, np.float32)
+        np.testing.assert_array_equal(eng.run("allreduce", tiny, "t"), tiny)
+        wide = np.arange(8192, dtype=np.float64)
+        np.testing.assert_array_equal(eng.run("allreduce", wide, "w"), wide)
+    finally:
+        eng.shutdown()
+
+
+def test_single_proc_topk_error_feedback_opt_out(monkeypatch):
+    monkeypatch.setenv("HOROVOD_COMPRESSION_ERROR_FEEDBACK", "0")
+    eng = _engine("topk")
+    try:
+        x = (np.arange(8192, dtype=np.float32) - 4096) / 7
+        eng.run("allreduce", x, "g")
+        assert "g" not in eng._residuals  # explicit opt-out honored
+    finally:
+        eng.shutdown()
+
+
+def test_topk_error_feedback_linear_model_converges():
+    """The DGC claim, scaled down: a linear model trained with topk@5%
+    gradients + error feedback lands within tolerance of the uncompressed
+    run — the un-sent 95% of the mass arrives over subsequent steps via
+    the residual, so convergence is delayed, not lost."""
+    rng = np.random.default_rng(9)
+    X = rng.standard_normal((128, 64)).astype(np.float32)
+    w_true = rng.standard_normal(64).astype(np.float32)
+    y = X @ w_true
+
+    def train(compression, ratio=None, steps=400):
+        if ratio is not None:
+            import os
+
+            os.environ["HOROVOD_TOPK_RATIO"] = str(ratio)
+        try:
+            eng = _engine(compression)
+            try:
+                w = np.zeros(64, dtype=np.float32)
+                for _ in range(steps):
+                    grad = (2.0 / len(X)) * X.T @ (X @ w - y)
+                    g = eng.run("allreduce", grad.astype(np.float32),
+                                "grad.w")
+                    w = w - 0.05 * g
+                return float(np.mean((X @ w - y) ** 2))
+            finally:
+                eng.shutdown()
+        finally:
+            if ratio is not None:
+                os.environ.pop("HOROVOD_TOPK_RATIO", None)
+
+    base = train("none")
+    sparse = train("topk", ratio=0.05)
+    assert sparse <= max(base * 1.5, base + 1e-2), (base, sparse)
+
+
+# ----------------------------------------------------- topk protocol tier
+
+def test_topk_policy_flip_invalidates_cache_bit():
+    """A full request for a name bound under a different wire format
+    ('topk' vs dense) evicts the stale bit everywhere — a policy flip
+    invalidates like a shape change (the ISSUE 9 cache-protocol clause)."""
+    def fn(rank, client):
+        req = {"name": "g", "op": "allreduce", "shape": (512,),
+               "dtype": "float32", "root": 0, "average": True}
+        _, assign0, _ = _exchange_until(
+            client, [req], {"g": np.ones(512, np.float32)}, "g")
+        bit0 = assign0[0][0]
+        _exchange_until(client, [dict(req, name="sync")],
+                        {"sync": np.ones(512, np.float32)}, "sync")
+        idx, val = topk_select(np.arange(512, dtype=np.float32), 5)
+        wire_req = dict(req, wire="topk")
+        res, assign, evict = _exchange_until(
+            client, [wire_req], {"g": topk_pack(idx, val)}, "g")
+        return bit0, assign, evict, res
+
+    results = _run_ranks(2, fn)
+    # Both ranks shipped the identical selection, so the average equals it
+    # ((v + v) / 2 is exact in f32).
+    idx, val = topk_select(np.arange(512, dtype=np.float32), 5)
+    expected = topk_densify(idx, val, 512)
+    for rank in range(2):
+        bit0, assign, evict, (err, value) = results[rank]
+        assert bit0 in evict, "stale bit survived the topk policy flip"
+        assert assign and assign[0][0] != bit0
+        assert err is None
+        # Sparse star results travel as packed frames with the shape tag.
+        assert isinstance(value, dict) and value.get("fmt") == "topk"
+        st = topk_unpack(value["__wire__"], 512)
+        np.testing.assert_array_equal(topk_state_dense(st, 512), expected)
+
+
+def test_mismatched_topk_vs_dense_is_an_error():
+    """Half the world sparsifying and half not must produce a delivered
+    error, not a deadlock (the existing wire-mismatch validation covers
+    the topk tag too)."""
+    def fn(rank, client):
+        req = {"name": "g", "op": "allreduce", "shape": (512,),
+               "dtype": "float32", "root": 0, "average": True}
+        if rank == 1:
+            req["wire"] = "topk"
+            idx, val = topk_select(np.ones(512, np.float32), 5)
+            arr = topk_pack(idx, val)
+        else:
+            arr = np.ones(512, np.float32)
+        res, _, _ = _exchange_until(client, [req], {"g": arr}, "g")
+        return res
+
+    results = _run_ranks(2, fn)
+    for rank in range(2):
+        err, _ = results[rank]
+        assert err and "wire compression" in err
+
+
+# ------------------------------------------------------- topk system tier
+
+TOPK_WORKER = r"""
+import hashlib, json, os, sys
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import PyEngine
+from horovod_tpu.common.topology import Topology
+from horovod_tpu import metrics as hvd_metrics
+
+rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+L = int(os.environ.get("TOPK_LOCAL_SIZE", "1"))
+hier = os.environ.get("TOPK_HIER", "0") == "1"
+topo = (Topology(rank, world, rank % L, L, rank // L, world // L) if L > 1
+        else Topology(rank, world, 0, 1, rank, world))
+eng = PyEngine(topo, Config(cycle_time_ms=1.0, stall_check_disable=True,
+                            hierarchical_allreduce=hier))
+try:
+    digest = hashlib.sha256()
+    rng = np.random.default_rng(100 + rank)
+    for i in range(3):
+        for t in range(2):
+            x = rng.standard_normal(20000).astype(np.float32)
+            out = eng.run("allreduce", x, f"grad.{t}")
+            digest.update(out.tobytes())
+    snap = hvd_metrics.registry().snapshot()["counters"]
+    print(json.dumps({
+        "rank": rank, "hash": digest.hexdigest(),
+        "plane": eng.cache_stats()["plane"],
+        "wire": snap.get('horovod_wire_bytes_total{plane="eager"}', 0),
+        "saved": snap.get('horovod_wire_bytes_saved_total{plane="eager"}', 0),
+        "saved_topk": snap.get(
+            'horovod_wire_bytes_saved_total{method="topk"}', 0),
+    }))
+finally:
+    eng.shutdown()
+"""
+
+
+def _topk_oracle_hashes(world, grid=None, steps=3, tensors=2, n=20000):
+    """Replay TOPK_WORKER's enqueue (top-1% select with the default-on
+    error feedback) per rank and fold with the canonical oracle."""
+    import hashlib
+
+    k = topk_k(n, 0.01)
+    res = {(r, t): np.zeros(n, np.float32)
+           for r in range(world) for t in range(tensors)}
+    rngs = [np.random.default_rng(100 + r) for r in range(world)]
+    digest = hashlib.sha256()
+    for i in range(steps):
+        for t in range(tensors):
+            denses = []
+            for r in range(world):
+                arr = rngs[r].standard_normal(n).astype(np.float32) \
+                    + res[(r, t)]
+                idx, val = topk_select(arr, k)
+                dense = topk_densify(idx, val, n)
+                res[(r, t)] = arr - dense
+                denses.append(dense)
+            out = _ring_order_reduce(denses, True, wire_dtype="topk",
+                                     grid=grid)
+            digest.update(out.tobytes())
+    return digest.hexdigest()
+
+
+@pytest.mark.engine
+def test_topk_ring_star_hier_pinned_to_oracles_4proc():
+    """The ISSUE 9 tentpole contract on free-form payloads: the sparse
+    ring and the star relay produce the canonical flat fold BITWISE, the
+    hierarchical plane produces the canonical grid fold BITWISE (the
+    cross-plane hash identity on exact-arithmetic payloads is CI's
+    tools/sparse_smoke.py), and the wire counters prove the >= 10x byte
+    reduction at topk@1%."""
+    env = {"HOROVOD_COMPRESSION": "topk"}
+    ring = launch_world(4, TOPK_WORKER,
+                        extra_env=dict(env, HOROVOD_RING_DATA_PLANE="1"))
+    star = launch_world(4, TOPK_WORKER,
+                        extra_env=dict(env, HOROVOD_RING_DATA_PLANE="0"))
+    hier = launch_world(4, TOPK_WORKER,
+                        extra_env=dict(env, HOROVOD_RING_DATA_PLANE="1",
+                                       TOPK_LOCAL_SIZE="2", TOPK_HIER="1",
+                                       HOROVOD_HIERARCHICAL_ALLREDUCE="1"))
+    assert {r["out"]["plane"] for r in ring} == {"ring"}
+    assert {r["out"]["plane"] for r in star} == {"star"}
+    assert {r["out"]["plane"] for r in hier} == {"hier"}
+    flat_oracle = _topk_oracle_hashes(4)
+    grid_oracle = _topk_oracle_hashes(4, grid=(2, 2))
+    assert {r["out"]["hash"] for r in ring} == {flat_oracle}, (
+        "sparse ring diverged from the canonical flat fold")
+    assert {r["out"]["hash"] for r in star} == {flat_oracle}, (
+        "sparse star diverged from the canonical flat fold")
+    assert {r["out"]["hash"] for r in hier} == {grid_oracle}, (
+        "sparse hier plane diverged from the canonical grid fold")
+    for r in ring + hier:
+        o = r["out"]
+        assert o["wire"] > 0 and o["saved_topk"] > 0
+        assert (o["wire"] + o["saved"]) / o["wire"] >= 10.0, (
+            "topk@1% did not deliver the 10x wire-byte reduction")
+
+
+CHAOS_EF_WORKER = r"""
+import hashlib, json, os, sys
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import PyEngine
+from horovod_tpu.common.topology import Topology
+from horovod_tpu import metrics as hvd_metrics
+
+rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+eng = PyEngine(Topology(rank, world, 0, 1, rank, world),
+               Config(cycle_time_ms=1.0, stall_check_disable=True))
+try:
+    digest = hashlib.sha256()
+    rng = np.random.default_rng(40 + rank)
+    for i in range(10):
+        x = rng.standard_normal(8192).astype(np.float32)
+        out = eng.run("allreduce", x, "grad.ef")
+        digest.update(out.tobytes())
+    snap = hvd_metrics.registry().snapshot()["counters"]
+    print(json.dumps({
+        "rank": rank, "hash": digest.hexdigest(),
+        "demotions": snap.get("horovod_plane_demotions_total", 0),
+        "resets": snap.get("horovod_elastic_resets_total", 0),
+    }))
+finally:
+    eng.shutdown()
+"""
+
+
+@pytest.mark.engine
+@pytest.mark.parametrize("compression", ["bf16", "topk"])
+def test_residual_not_double_folded_across_demotion(compression):
+    """ISSUE 9 satellite: a plane-demotion redo (HOROVOD_FAULT_NET=reset
+    mid-run) replays the already-quantized/sparsified contribution — the
+    error-feedback residual was claimed at enqueue, so the replay must not
+    fold it twice. Proof: the faulted world's 10-step result stream is
+    BITWISE identical to the fault-free world's (any double fold would
+    change every post-fault step), with the demotion actually exercised."""
+    base = {"HOROVOD_RING_DATA_PLANE": "1",
+            "HOROVOD_COMPRESSION": compression,
+            "HOROVOD_COMPRESSION_ERROR_FEEDBACK": "1",
+            "HOROVOD_PLANE_REPROMOTE_S": "0"}
+    clean = launch_world(4, CHAOS_EF_WORKER, extra_env=base)
+    # Land the reset on a mid-run ring data frame of rank 1 (each 4-world
+    # flat-ring allreduce sends 6 frames per rank; skip establishment).
+    fault = launch_world(4, CHAOS_EF_WORKER, extra_env=dict(
+        base, HOROVOD_FAULT_NET="reset", HOROVOD_FAULT_NET_RANK="1",
+        HOROVOD_FAULT_NET_SCOPE="ring", HOROVOD_FAULT_NET_AFTER="20",
+        HOROVOD_FAULT_NET_COUNT="1"))
+    clean_hashes = {r["out"]["hash"] for r in clean}
+    fault_hashes = {r["out"]["hash"] for r in fault}
+    assert len(clean_hashes) == 1 and len(fault_hashes) == 1
+    assert clean_hashes == fault_hashes, (
+        f"{compression}+EF results diverged across the demotion replay "
+        "(residual folded twice or replay re-quantized)")
+    assert max(r["out"]["demotions"] for r in fault) >= 1, (
+        "fault injection never demoted the plane — the test exercised "
+        "nothing")
+    assert all(r["out"]["resets"] == 0 for r in fault), (
+        "demotion escalated to an elastic reset")
+
+
+def test_autotune_topk_ratio_joins_compression_dimension():
+    """tune(compressions=...) accepts 'topk@<ratio>' specs on the
+    categorical compression dimension (ISSUE 9): the factory receives the
+    spec, every grid point is covered, and the winner carries it."""
+    from horovod_tpu.jax.autotune import tune
+
+    calls = []
+
+    def factory(fusion_threshold, num_buckets, compression):
+        calls.append(compression)
+        rate = {"none": 1.0, "topk@0.01": 2.0, "topk@0.05": 1.5}[compression]
+
+        import time as _t
+
+        def run():
+            _t.sleep(0.001 / rate)
+        return run
+
+    report = tune(factory, thresholds=(1 << 20,), num_buckets=(1,),
+                  compressions=("none", "topk@0.01", "topk@0.05"),
+                  warmup=0, iters=2, reps=1, gp_rounds=0)
+    assert set(calls) == {"none", "topk@0.01", "topk@0.05"}
+    assert report.best.compression == "topk@0.01"
+    assert report.best.config["compression"] == "topk@0.01"
+    assert "topk@0.01" in report.knob_curve()

@@ -1,0 +1,115 @@
+"""Adaptive compression-policy tests (ISSUE 9, common/policy.py).
+
+Unit tier only: the policy's value-changing decisions must be
+deterministic functions of (size, dtype, topology, config) — that
+determinism IS the cross-rank agreement contract — and the live-metrics
+refresh may steer only the value-neutral sparse/dense hop framing. The
+end-to-end demonstration (different formats per fabric tier on a real
+grid) lives in tools/sparse_smoke.py and the engine tests.
+"""
+
+import numpy as np
+
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.policy import CompressionPolicy, resolve_format
+from horovod_tpu.common.topology import Topology
+
+BIG = 4 << 20      # 4 MiB f32 gradient: topk territory on DCN
+MED = 16 << 10     # 16 KiB: bf16 territory on DCN
+TINY = 256         # below HOROVOD_COMPRESSION_MIN_BYTES
+
+
+def _grid_topo(rank=0, world=4, local=2):
+    return Topology(rank, world, rank % local, local,
+                    rank // local, world // local)
+
+
+def _single_host_topo(rank=0, world=4):
+    return Topology(rank, world, rank, world, 0, 1)
+
+
+def test_decision_table_per_tier():
+    pol = CompressionPolicy(Config(), _grid_topo())
+    # ICI: full width for everything (the fast fabric is not the cliff).
+    assert pol.decide(BIG, np.float32, "ici") == "none"
+    assert pol.decide(BIG, np.float32, "local") == "none"
+    # DCN: topk for large f32, bf16 for medium floats and f64.
+    assert pol.decide(BIG, np.float32, "dcn") == "topk"
+    assert pol.decide(BIG, np.float32, "cross") == "topk"
+    assert pol.decide(MED, np.float32, "dcn") == "bf16"
+    assert pol.decide(BIG, np.float64, "dcn") == "bf16"  # topk is f32-only
+    # Opt-outs on every tier: non-floats, <=2-byte floats, sub-floor sizes.
+    for tier in ("ici", "dcn"):
+        assert pol.decide(BIG, np.int32, tier) == "none"
+        assert pol.decide(BIG, np.float16, tier) == "none"
+        assert pol.decide(TINY, np.float32, tier) == "none"
+
+
+def test_resolve_depends_on_topology():
+    # A grid world crosses hosts: the value-changing format is the DCN
+    # decision. A single-host world never touches DCN: full width.
+    grid = CompressionPolicy(Config(), _grid_topo())
+    flat = CompressionPolicy(Config(), _single_host_topo())
+    assert grid.resolve(BIG, np.float32) == "topk"
+    assert grid.resolve(MED, np.float32) == "bf16"
+    assert flat.resolve(BIG, np.float32) == "none"
+    # Deterministic across ranks: every rank of the same grid resolves
+    # identically (the cross-rank wire-agreement contract).
+    for rank in range(4):
+        pol = CompressionPolicy(Config(), _grid_topo(rank))
+        assert pol.resolve(BIG, np.float32) == "topk"
+
+
+def test_topk_ratio_and_floor_config(monkeypatch):
+    monkeypatch.delenv("HOROVOD_TOPK_RATIO", raising=False)
+    pol = CompressionPolicy(Config(topk_ratio=0.05), _grid_topo())
+    assert pol.topk_ratio == 0.05
+    monkeypatch.setenv("HOROVOD_TOPK_MIN_BYTES", str(32 << 20))
+    high_floor = CompressionPolicy(Config(), _grid_topo())
+    # Below the raised topk floor the DCN pick degrades to bf16.
+    assert high_floor.decide(BIG, np.float32, "dcn") == "bf16"
+
+
+def test_refresh_steers_sparse_framing_only():
+    pol = CompressionPolicy(Config(), _grid_topo())
+    assert pol.sparse_tiers() == frozenset({"cross"})
+    # Cross-dominant wire time: sparse framing stays DCN-only.
+    diag = pol.refresh({"counters": {
+        'horovod_wire_bytes_total{tier="local"}': 1000,
+        'horovod_wire_bytes_total{tier="cross"}': 9000,
+    }, "gauges": {}})
+    assert diag["bottleneck_tier"] == "dcn"
+    assert pol.sparse_tiers() == frozenset({"cross"})
+    # Local-dominant critical-path wire seconds (shared-core hosts):
+    # the local tier gains sparse framing too — value-neutral escalation.
+    diag = pol.refresh({"counters": {}, "gauges": {
+        'horovod_critical_path_wire_seconds{tier="local"}': 3.0,
+        'horovod_critical_path_wire_seconds{tier="cross"}': 0.5,
+    }})
+    assert diag["bottleneck_tier"] == "ici"
+    assert pol.sparse_tiers() == frozenset({"cross", "local"})
+    # The refresh NEVER changes the value-changing table.
+    assert pol.decide(BIG, np.float32, "ici") == "none"
+    assert pol.decide(BIG, np.float32, "dcn") == "topk"
+    # Empty snapshot: falls back to the topology default.
+    diag = pol.refresh({})
+    assert diag["bottleneck_tier"] == "dcn"
+
+
+def test_report_shape_for_smoke():
+    pol = CompressionPolicy(Config(), _grid_topo())
+    rep = pol.report()
+    assert rep["ici"] == "none" and rep["dcn"] == "topk"
+    assert rep["resolved"] == "topk"
+    assert rep["topk_ratio"] == pol.topk_ratio
+    assert rep["sparse_tiers"] == ["cross"]
+
+
+def test_resolve_format_helper():
+    pol = CompressionPolicy(Config(), _grid_topo())
+    assert resolve_format("bf16", None, BIG, np.float32) == "bf16"
+    assert resolve_format("topk@0.02", None, BIG, np.float32) == "topk"
+    assert resolve_format("adaptive", pol, BIG, np.float32) == "topk"
+    assert resolve_format("adaptive", pol, MED, np.float32) == "bf16"
+    # No policy wired (non-engine callers): adaptive degrades to none.
+    assert resolve_format("adaptive", None, BIG, np.float32) == "none"

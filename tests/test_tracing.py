@@ -354,6 +354,31 @@ def test_perf_gate_partial_skipped_and_empty_is_error(tmp_path):
     assert r.returncode == 2
 
 
+def test_perf_gate_skips_nonzero_rc_bench_records(tmp_path):
+    """ISSUE 9 satellite: a harness record from a bench that exited
+    non-zero (the pre-watchdog BENCH_r05 rc=124 shape) is skipped
+    outright — even when its tail happens to contain parseable JSON
+    fragments, which must never become a comparison baseline."""
+    import perf_gate
+
+    bad = _write(tmp_path, "BENCH_bad.json", {
+        "n": 5, "cmd": "python bench.py", "rc": 124, "parsed": None,
+        # A metric line stranded in the killed process's stderr tail:
+        # scraping it would fabricate a 9000 img/s baseline.
+        "tail": json.dumps(dict(REC, value=9000.0))})
+    assert perf_gate.load_records(bad) == []
+    # rc=0 harness records still parse through their "parsed" payload.
+    good = _write(tmp_path, "BENCH_good.json",
+                  {"rc": 0, "parsed": dict(REC, value=900.0)})
+    assert [r["value"] for r in perf_gate.load_records(good)] == [900.0]
+    # End to end: the rc!=0 file contributes no baseline, so a current run
+    # far below the stranded tail value still passes against the real one.
+    cur = _write(tmp_path, "cur.json", dict(REC, value=860.0))
+    r = _gate(["--current", cur, "--baseline", bad, "--baseline", good])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "skipping" in r.stdout and "rc=124" in r.stdout
+
+
 def test_perf_gate_require_metric(tmp_path):
     cur = _write(tmp_path, "cur.json", REC)
     r = _gate(["--current", cur, "--allow-missing-baseline",

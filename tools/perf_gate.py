@@ -57,11 +57,28 @@ def _records_from_obj(obj) -> list[dict]:
 
 def load_records(path: str) -> list[dict]:
     """Extract metric records from a file: whole-file JSON first, else every
-    parseable JSON line (bench logs mix warnings with the metric line)."""
+    parseable JSON line (bench logs mix warnings with the metric line).
+
+    Harness-shaped records (``{"rc": ..., "tail": ..., "parsed": ...}``)
+    from a bench run that exited non-zero are skipped OUTRIGHT — their
+    ``tail`` is the truncated stderr of a killed process (the pre-watchdog
+    BENCH_r05 rc=124 shape), and scraping partial JSON fragments out of it
+    would compare today's run against a number the bench never finished
+    producing."""
     with open(path) as f:
         text = f.read()
     try:
-        recs = _records_from_obj(json.loads(text))
+        obj = json.loads(text)
+        if isinstance(obj, dict) and "rc" in obj:
+            try:
+                rc = int(obj["rc"])
+            except (TypeError, ValueError):
+                rc = -1
+            if rc != 0:
+                print(f"perf gate: skipping {path}: bench record exited "
+                      f"rc={obj['rc']} (partial tail not parsed)")
+                return []
+        recs = _records_from_obj(obj)
         if recs:
             return recs
     except ValueError:
