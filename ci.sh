@@ -82,6 +82,13 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/elastic_smoke.py
 echo "== chaos smoke (ISSUE 8 escalation ladder: injected delay absorbed by retries, link reset demotes ring->star bitwise-identically with 0 elastic resets then re-promotes, corrupt/drop frames rejected, killed rank escalates to exactly 1 elastic reset) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 
+echo "== serve smoke (ISSUE 10 serving vertical: 2-replica continuous batching coalesces (mean batch > 1), p99 under the smoke SLO with zero sheds at nominal load, schema-valid /stats, raw-training-checkpoint refusal, replica kill mid-load recovers with zero failed client requests) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/serve_smoke.py | tee /tmp/hvd_serve_smoke.log
+python tools/perf_gate.py --current /tmp/hvd_serve_smoke.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric serve_smoke_throughput_rps \
+  --min-abs serve_smoke_throughput_rps=25 --allow-missing-baseline
+
 echo "== fast tier (includes the launcher e2e: test_run_happy_path) =="
 python -m pytest tests/ -m fast -q
 

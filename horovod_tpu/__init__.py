@@ -60,10 +60,12 @@ def __getattr__(name: str):
     # The launcher package is heavyweight (spawning, agents, TCP services)
     # and most library users never touch it — resolve `hvd.runner` lazily
     # so `hvd.runner.run_elastic(...)` works without an eager import.
-    if name == "runner":
+    # Same treatment for the serving vertical: the router never needs the
+    # framework bindings, and training jobs never pay for the server.
+    if name in ("runner", "serving"):
         import importlib
 
-        return importlib.import_module(".runner", __name__)
+        return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

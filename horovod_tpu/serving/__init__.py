@@ -1,0 +1,37 @@
+"""hvd.serving — the online-inference vertical (docs/inference.md).
+
+Continuous-batching serving for exported checkpoints, composed from the
+training stack's parts (ISSUE 10): the metrics HTTP-server pattern as the
+frontend, the scan-per-dispatch trick for multi-step decode, the elastic
+driver's slot-pool/supervision shape as the replica manager, and the
+elastic fault hooks for chaos testing.
+
+    from horovod_tpu import serving
+    server = serving.InferenceServer(checkpoint="/ckpts/serve").start()
+
+or standalone::
+
+    python -m horovod_tpu.serving --checkpoint /ckpts/serve \
+        --builder my_project.serving:build
+
+Knobs: HOROVOD_SERVE_PORT / _MAX_BATCH / _MAX_WAIT_MS / _SLO_MS and
+friends — see :class:`~.config.ServeConfig` and the README serving table.
+"""
+
+from .admission import AdmissionController  # noqa: F401
+from .batcher import (  # noqa: F401
+    ContinuousBatcher,
+    Request,
+    bucket_for,
+    bucket_sizes,
+    pad_batch,
+)
+from .config import ServeConfig  # noqa: F401
+from .manager import ReplicaManager, autoscale_decision  # noqa: F401
+from .model import (  # noqa: F401
+    load_for_serving,
+    make_decode_fn,
+    mlp_builder,
+    resolve_builder,
+)
+from .server import DEFAULT_BUILDER, InferenceServer, serve  # noqa: F401

@@ -1,0 +1,33 @@
+"""``python -m horovod_tpu.serving`` — standalone inference server CLI
+(docs/inference.md train -> export -> serve walkthrough)."""
+
+from __future__ import annotations
+
+import argparse
+
+from .config import ServeConfig
+from .server import DEFAULT_BUILDER, serve
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.serving",
+        description="Serve an exported checkpoint over HTTP with "
+                    "continuous batching, SLO-aware admission, and "
+                    "elastic replica autoscaling.")
+    ap.add_argument("--checkpoint", required=True,
+                    help="path written by checkpoint.export_for_inference")
+    ap.add_argument("--builder", default=DEFAULT_BUILDER,
+                    help="'module:function' turning restored state into "
+                         "an apply_fn (default: the built-in MLP builder)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="override HOROVOD_SERVE_PORT")
+    args = ap.parse_args()
+    cfg = ServeConfig.from_env(**({"port": args.port}
+                                  if args.port is not None else {}))
+    serve(args.checkpoint, args.builder, cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,94 @@
+"""SLO-aware admission control — shed load *before* it queues.
+
+Clipper-style (Crankshaw et al., NSDI 2017) queue-depth/latency admission:
+every request carries a deadline (client-supplied ``deadline_ms`` or the
+``HOROVOD_SERVE_SLO_MS`` default), and the controller keeps a live
+estimate of the fleet's drain rate (EWMA of requests retired per second
+per replica, fed by every completed batch). A request is admitted only
+when the *projected* queue wait — current depth over the fleet's drain
+rate — still fits inside the SLO; otherwise it is shed with 429
+(``horovod_serve_shed_total``), which keeps the p99 of admitted requests
+bounded instead of letting the whole queue miss its deadlines together.
+
+Cold start admits everything: until the first batch completes there is no
+rate estimate, projected wait reads 0, and nothing sheds — the queue-cap
+backstop (batcher) still bounds memory.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+from ..metrics import registry as _registry
+
+_EWMA_ALPHA = 0.2
+
+
+class AdmissionController:
+    def __init__(self, cfg, reg=None):
+        self.cfg = cfg
+        self.slo_s = cfg.slo_ms / 1000.0
+        reg = reg or _registry()
+        self._lock = threading.Lock()
+        self._drain_rate: Optional[float] = None   # req/s per replica
+        self._shed_c = reg.counter(
+            "horovod_serve_shed_total",
+            help="requests shed (429) because the projected queue wait "
+                 "exceeded the SLO")
+        self._shed_429 = reg.counter(
+            "horovod_serve_requests_total",
+            help="terminal request outcomes by HTTP-style code", code="429")
+        self._wait_gauge = reg.gauge(
+            "horovod_serve_projected_wait_seconds",
+            help="projected queue wait at the last admission decision")
+
+    # -- feedback from completed batches -------------------------------------
+
+    def observe_batch(self, n_requests: int, service_s: float) -> None:
+        """A replica retired ``n_requests`` in ``service_s`` seconds —
+        fold into the per-replica drain-rate EWMA."""
+        if n_requests <= 0 or service_s <= 0:
+            return
+        rate = n_requests / service_s
+        with self._lock:
+            self._drain_rate = rate if self._drain_rate is None else \
+                (1 - _EWMA_ALPHA) * self._drain_rate + _EWMA_ALPHA * rate
+
+    def drain_rate(self) -> Optional[float]:
+        with self._lock:
+            return self._drain_rate
+
+    # -- the admission decision ----------------------------------------------
+
+    def projected_wait_s(self, queue_depth: int, replicas: int) -> float:
+        """Expected time a request arriving NOW spends queued: everything
+        ahead of it drained by ``replicas`` workers at the observed
+        per-replica rate. 0 until the first observation."""
+        with self._lock:
+            rate = self._drain_rate
+        if rate is None or rate <= 0:
+            return 0.0
+        return queue_depth / (rate * max(replicas, 1))
+
+    def admit(self, queue_depth: int, replicas: int,
+              budget_s: Optional[float] = None) -> Tuple[bool, float]:
+        """(admitted, projected_wait_s). ``budget_s`` is the request's own
+        deadline budget (default: the SLO) — a request that provably
+        cannot make its deadline is shed NOW, not failed after consuming a
+        queue slot. Shedding fires only on a live estimate — a cold
+        server never 429s its first requests."""
+        wait = self.projected_wait_s(queue_depth, replicas)
+        self._wait_gauge.set(wait)
+        if wait > (budget_s if budget_s is not None else self.slo_s):
+            self._shed_c.inc()
+            self._shed_429.inc()
+            return False, wait
+        return True, wait
+
+    def report(self) -> dict:
+        with self._lock:
+            rate = self._drain_rate
+        return {"slo_ms": self.cfg.slo_ms,
+                "drain_rate_per_replica": rate,
+                "shed_total": self._shed_c.value}

@@ -1,0 +1,113 @@
+"""Serving configuration — every knob of the inference plane in one place.
+
+All knobs are environment variables with the ``HOROVOD_SERVE_`` prefix
+(README "serving" table, docs/inference.md), resolved once at server
+construction by :meth:`ServeConfig.from_env`; programmatic overrides win
+over the environment so tests and ``bench.py --serve`` can pin a config
+without mutating ``os.environ``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, fields
+
+
+def _f(name: str, default: float) -> float:
+    return float(os.environ.get(name, "") or default)
+
+
+def _i(name: str, default: int) -> int:
+    return int(os.environ.get(name, "") or default)
+
+
+@dataclass
+class ServeConfig:
+    # -- frontend -----------------------------------------------------------
+    port: int = 8600          # HOROVOD_SERVE_PORT; 0 = pick a free port
+    host: str = "127.0.0.1"   # HOROVOD_SERVE_HOST (same posture as metrics:
+    #                           localhost-only unless explicitly widened)
+    token: str = ""           # HOROVOD_SERVE_TOKEN; when set, POST /v1/infer
+    #                           requires "Authorization: Bearer <token>"
+    # -- continuous batcher -------------------------------------------------
+    max_batch: int = 8        # HOROVOD_SERVE_MAX_BATCH: device batch cap
+    max_wait_ms: float = 5.0  # HOROVOD_SERVE_MAX_WAIT_MS: how long a forming
+    #                           batch waits for companions before dispatch
+    queue_cap: int = 1024     # HOROVOD_SERVE_QUEUE_CAP: admission backstop
+    decode_steps: int = 1     # HOROVOD_SERVE_DECODE_STEPS: model steps per
+    #                           dispatch (the scan-per-dispatch trick)
+    # -- SLO-aware admission ------------------------------------------------
+    slo_ms: float = 500.0     # HOROVOD_SERVE_SLO_MS: default per-request
+    #                           deadline AND the load-shedding bound on the
+    #                           projected queue wait
+    # -- elastic replica autoscaling ---------------------------------------
+    min_replicas: int = 1     # HOROVOD_SERVE_MIN_REPLICAS
+    max_replicas: int = 4     # HOROVOD_SERVE_MAX_REPLICAS
+    target_queue: float = 4.0  # HOROVOD_SERVE_TARGET_QUEUE: queued requests
+    #                            per replica the autoscaler aims for
+    cooldown_s: float = 10.0  # HOROVOD_SERVE_COOLDOWN_S: hysteresis between
+    #                           scale actions (repair ignores it)
+    # -- replica supervision ------------------------------------------------
+    max_retries: int = 2      # HOROVOD_SERVE_MAX_RETRIES: re-dispatches of a
+    #                           request whose replica died mid-batch
+    replica_timeout_s: float = 30.0   # HOROVOD_SERVE_REPLICA_TIMEOUT: one
+    #                                   infer round trip to a replica
+    replica_start_timeout_s: float = 120.0  # HOROVOD_SERVE_START_TIMEOUT:
+    #                                         spawn -> ready (jax import +
+    #                                         checkpoint restore)
+    blacklist_threshold: int = 1      # HOROVOD_SERVE_BLACKLIST_THRESHOLD:
+    #                                   failures before a replica slot is
+    #                                   blacklisted (ids are never reused)
+
+    _ENV = {
+        "port": "HOROVOD_SERVE_PORT",
+        "host": "HOROVOD_SERVE_HOST",
+        "token": "HOROVOD_SERVE_TOKEN",
+        "max_batch": "HOROVOD_SERVE_MAX_BATCH",
+        "max_wait_ms": "HOROVOD_SERVE_MAX_WAIT_MS",
+        "queue_cap": "HOROVOD_SERVE_QUEUE_CAP",
+        "decode_steps": "HOROVOD_SERVE_DECODE_STEPS",
+        "slo_ms": "HOROVOD_SERVE_SLO_MS",
+        "min_replicas": "HOROVOD_SERVE_MIN_REPLICAS",
+        "max_replicas": "HOROVOD_SERVE_MAX_REPLICAS",
+        "target_queue": "HOROVOD_SERVE_TARGET_QUEUE",
+        "cooldown_s": "HOROVOD_SERVE_COOLDOWN_S",
+        "max_retries": "HOROVOD_SERVE_MAX_RETRIES",
+        "replica_timeout_s": "HOROVOD_SERVE_REPLICA_TIMEOUT",
+        "replica_start_timeout_s": "HOROVOD_SERVE_START_TIMEOUT",
+        "blacklist_threshold": "HOROVOD_SERVE_BLACKLIST_THRESHOLD",
+    }
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        kw = {}
+        for f in fields(cls):
+            env = cls._ENV.get(f.name)
+            raw = os.environ.get(env, "") if env else ""
+            if f.name in overrides:
+                kw[f.name] = overrides.pop(f.name)
+            elif raw:
+                # PEP 563 makes f.type a STRING here; resolve by name.
+                t = f.type if isinstance(f.type, type) \
+                    else {"int": int, "float": float, "str": str}.get(
+                        str(f.type), str)
+                kw[f.name] = t(raw)
+        if overrides:
+            raise TypeError(f"unknown ServeConfig overrides: "
+                            f"{sorted(overrides)}")
+        cfg = cls(**kw)
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.decode_steps < 1:
+            raise ValueError(
+                f"decode_steps must be >= 1, got {self.decode_steps}")
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{self.min_replicas}..{self.max_replicas}")
+        if self.slo_ms <= 0:
+            raise ValueError(f"slo_ms must be > 0, got {self.slo_ms}")

@@ -1,0 +1,144 @@
+"""HTTP frontend — the router's public face.
+
+Reuses the metrics-exposition server pattern (metrics/exposition.py:
+``ThreadingHTTPServer`` + daemon thread, localhost-bound by default,
+``port=0`` picks a free port read back from ``.port``). Endpoints:
+
+- ``POST /v1/infer`` — body ``{"inputs": [...], "deadline_ms": 250}``;
+  authenticated (``HOROVOD_SERVE_TOKEN`` -> ``Authorization: Bearer``),
+  admission-checked, enqueued, and answered when the batch completes:
+  ``{"outputs": [...], "latency_ms": ..}``. Error codes: 400 malformed,
+  401 unauthenticated, 429 shed (projected queue wait over the SLO, with
+  a ``Retry-After``), 503 failed after retries / shutting down, 504
+  deadline exceeded.
+- ``GET /healthz`` — 200 once at least one replica is serving (readiness
+  probe for load balancers and the smoke), 503 before.
+- ``GET /stats`` — ``{"serving": {...}, "metrics": <registry snapshot>}``
+  where ``metrics`` is the standard per-rank snapshot shape
+  (docs/metrics_schema.json validates it — same contract as
+  ``/metrics.json`` on the training side).
+
+One request-handler thread parks per in-flight request (the threading
+server's thread-per-connection model); the wait is bounded by the
+request's deadline, so a wedged replica cannot accumulate parked threads
+past the SLO horizon.
+"""
+
+from __future__ import annotations
+
+import hmac
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_ref = None  # type: ignore[assignment]  # the InferenceServer
+
+    # -- helpers -------------------------------------------------------------
+
+    def _reply(self, status: int, obj: dict,
+               headers: Optional[dict] = None) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client gave up; nothing to salvage
+
+    def _authenticated(self) -> bool:
+        token = self.server_ref.cfg.token
+        if not token:
+            return True
+        header = self.headers.get("Authorization", "")
+        supplied = header[len("Bearer "):] if header.startswith("Bearer ") \
+            else ""
+        return hmac.compare_digest(supplied, token)
+
+    # -- routes --------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+        path = self.path.split("?")[0]
+        srv = self.server_ref
+        if path == "/healthz":
+            n = srv.manager.serving_count()
+            self._reply(200 if n >= 1 else 503,
+                        {"ok": n >= 1, "replicas": n})
+        elif path == "/stats":
+            self._reply(200, srv.stats())
+        else:
+            self._reply(404, {"error": f"no route {path}"})
+
+    def do_POST(self):  # noqa: N802
+        path = self.path.split("?")[0]
+        if path != "/v1/infer":
+            self._reply(404, {"error": f"no route {path}"})
+            return
+        if not self._authenticated():
+            self._reply(401, {"error": "missing or wrong bearer token "
+                                       "(HOROVOD_SERVE_TOKEN)"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            x = np.asarray(body["inputs"], dtype=np.float32)
+            deadline_ms = body.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+                if deadline_ms <= 0:
+                    raise ValueError("deadline_ms must be > 0")
+        except (KeyError, TypeError, ValueError) as e:
+            self._reply(400, {"error": f"malformed request: {e}"})
+            return
+        t0 = time.monotonic()
+        req, shed_wait = self.server_ref.submit(x, deadline_ms=deadline_ms)
+        if req.code == 429:
+            self._reply(429, {"error": req.error},
+                        headers={"Retry-After":
+                                 f"{max(shed_wait, 0.001):.3f}"})
+            return
+        budget = (req.deadline_t - t0) if req.deadline_t else \
+            self.server_ref.cfg.slo_ms / 1000.0
+        if not req.event.wait(timeout=budget + 0.05):
+            req.fail(504, "deadline exceeded awaiting a batch slot")
+            self.server_ref.count_code(504)
+        if req.code == 200:
+            self._reply(200, {
+                "outputs": np.asarray(req.output).tolist(),
+                "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+            })
+        else:
+            self._reply(req.code, {"error": req.error})
+
+    def log_message(self, *args):  # silence per-request stderr spam
+        pass
+
+
+class ServeFrontend:
+    """Daemon-thread HTTP server bound to (cfg.host, cfg.port); ``port=0``
+    picks a free port — read the bound one back from ``.port``."""
+
+    def __init__(self, server) -> None:
+        handler = type("BoundHandler", (_Handler,), {"server_ref": server})
+        self._httpd = ThreadingHTTPServer((server.cfg.host, server.cfg.port),
+                                          handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvd_serve_http",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)

@@ -1,0 +1,131 @@
+"""Replica-side model machinery: serving-checkpoint loading, builder
+resolution, and the scan-per-dispatch decode loop.
+
+A **builder** is what turns restored checkpoint state into a callable the
+replica can jit: ``builder(state) -> apply_fn`` with
+``apply_fn(x: [batch, ...]) -> y``. Replicas are separate processes, so
+builders are named by an importable ``"module:function"`` spec (the same
+convention the launcher uses for entry points) rather than passed as
+closures. :func:`mlp_builder` is the built-in used by the smoke tests and
+``bench.py --serve``; real deployments point at their own model module.
+
+jax imports stay inside functions: the ROUTER process imports this module
+for the builder-spec validation and must never pay (or wedge on) backend
+startup — only replicas touch jax.
+"""
+
+from __future__ import annotations
+
+import importlib
+import re
+from typing import Any, Callable
+
+
+def load_for_serving(path: str, template: Any = None) -> Any:
+    """Restore a serving checkpoint written by
+    :func:`horovod_tpu.checkpoint.export_for_inference`.
+
+    Refuses a raw *training* checkpoint: optimizer state in the restored
+    tree means the export step never ran — which also means per-rank batch
+    statistics were never consolidated, so serving it would silently serve
+    one rank's stats (docs/inference.md). The error names the fix."""
+    from ..checkpoint import load_for_inference
+
+    state = load_for_inference(path, template)
+    if isinstance(state, dict) and "opt_state" in state:
+        raise ValueError(
+            f"checkpoint at {path!r} is a raw TRAINING checkpoint (it "
+            "contains 'opt_state'): the serving plane refuses it because "
+            "optimizer state was never stripped and per-rank batch "
+            "statistics were never consolidated. Export it first with "
+            "horovod_tpu.checkpoint.export_for_inference(path, state) and "
+            "serve the exported copy.")
+    return state
+
+
+def resolve_builder(spec: str) -> Callable:
+    """``"pkg.module:function"`` -> the function. Import errors surface
+    with the spec named (a typo'd builder must fail the replica loudly at
+    startup, not at the first request)."""
+    mod_name, sep, fn_name = spec.partition(":")
+    if not sep or not mod_name or not fn_name:
+        raise ValueError(
+            f"builder spec {spec!r} must look like 'pkg.module:function'")
+    try:
+        mod = importlib.import_module(mod_name)
+    except ImportError as e:
+        raise ImportError(f"cannot import builder module {mod_name!r} "
+                          f"(from spec {spec!r}): {e}") from e
+    try:
+        return getattr(mod, fn_name)
+    except AttributeError as e:
+        raise AttributeError(
+            f"builder module {mod_name!r} has no attribute "
+            f"{fn_name!r} (from spec {spec!r})") from e
+
+
+def make_decode_fn(apply_fn: Callable, steps: int = 1) -> Callable:
+    """Jit ``apply_fn``; with ``steps > 1`` wrap it in a ``lax.scan`` so
+    ONE dispatch runs K model steps — the ``make_scan_train_loop``
+    amortization trick (docs/benchmarks.md: ~9–13 ms per dispatch through
+    a tunneled runtime) applied to multi-step decode. The scanned form
+    feeds each step's output to the next (``y_k = f(y_{k-1})``), so the
+    model's output must be shaped like its input."""
+    import jax
+
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if steps == 1:
+        return jax.jit(apply_fn)
+
+    def scanned(x):
+        def body(carry, _):
+            y = apply_fn(carry)
+            return y, None
+
+        y, _ = jax.lax.scan(body, x, None, length=steps)
+        return y
+
+    return jax.jit(scanned)
+
+
+def shard_batch(x, mesh=None):
+    """Lay a host batch out across this replica's local devices (batch-dim
+    sharding) when the bucket size divides the device count's multiple —
+    the 'jitted forward step across the mesh' piece on multi-chip
+    replicas. Single-device replicas (and indivisible buckets) return the
+    array unchanged; jit handles committed single-device inputs fine."""
+    import jax
+
+    n_dev = len(jax.local_devices())
+    if n_dev <= 1 or x.shape[0] % n_dev != 0:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if mesh is None:
+        mesh = jax.make_mesh((n_dev,), ("batch",))
+    return jax.device_put(x, NamedSharding(mesh, PartitionSpec("batch")))
+
+
+def mlp_builder(state: Any) -> Callable:
+    """Built-in builder for :class:`horovod_tpu.models.MLP` serving
+    checkpoints: layer widths are re-derived from the kernel shapes, so
+    the replica needs no side-channel architecture file."""
+    import jax.numpy as jnp
+
+    from ..models import MLP
+
+    params = state["params"]
+    names = sorted((k for k in params if re.fullmatch(r"Dense_\d+", k)),
+                   key=lambda k: int(k.split("_")[1]))
+    if not names:
+        raise ValueError(
+            f"mlp_builder: no Dense_* layers in params (keys: "
+            f"{sorted(params)})")
+    features = tuple(int(params[k]["kernel"].shape[1]) for k in names)
+    model = MLP(features=features)
+
+    def apply_fn(x):
+        return model.apply({"params": params}, jnp.asarray(x))
+
+    return apply_fn

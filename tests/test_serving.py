@@ -1,0 +1,392 @@
+"""Serving vertical (ISSUE 10): continuous batcher, SLO admission,
+autoscaler decision, checkpoint refusal, scan decode, and the 2-replica
+end-to-end kill/retry/drain path."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu import checkpoint
+from horovod_tpu.serving import (
+    AdmissionController,
+    ContinuousBatcher,
+    InferenceServer,
+    ReplicaManager,
+    Request,
+    ServeConfig,
+    autoscale_decision,
+    bucket_for,
+    bucket_sizes,
+    load_for_serving,
+    make_decode_fn,
+    mlp_builder,
+    pad_batch,
+    resolve_builder,
+)
+
+
+def _cfg(**kw):
+    kw.setdefault("port", 0)
+    return ServeConfig.from_env(**kw)
+
+
+# -- config ------------------------------------------------------------------
+
+
+def test_config_env_and_overrides(monkeypatch):
+    monkeypatch.setenv("HOROVOD_SERVE_MAX_BATCH", "16")
+    monkeypatch.setenv("HOROVOD_SERVE_SLO_MS", "250")
+    cfg = ServeConfig.from_env()
+    assert cfg.max_batch == 16 and cfg.slo_ms == 250.0
+    # explicit overrides win over env
+    assert ServeConfig.from_env(max_batch=4).max_batch == 4
+    with pytest.raises(TypeError):
+        ServeConfig.from_env(nonsense=1)
+    with pytest.raises(ValueError):
+        ServeConfig.from_env(min_replicas=3, max_replicas=2)
+
+
+# -- padding buckets ---------------------------------------------------------
+
+
+def test_bucket_sizes_and_selection():
+    assert bucket_sizes(8) == (1, 2, 4, 8)
+    assert bucket_sizes(6) == (1, 2, 4, 6)
+    assert bucket_sizes(1) == (1,)
+    sizes = bucket_sizes(8)
+    assert [bucket_for(n, sizes) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+    with pytest.raises(ValueError):
+        bucket_for(9, sizes)
+
+
+def test_pad_batch_zero_fills_to_bucket():
+    xs = [np.full(3, i, np.float32) for i in range(3)]
+    arr = pad_batch(xs, 4)
+    assert arr.shape == (4, 3)
+    np.testing.assert_array_equal(arr[2], np.full(3, 2.0))
+    np.testing.assert_array_equal(arr[3], np.zeros(3))
+    with pytest.raises(ValueError):
+        pad_batch(xs, 2)
+
+
+# -- continuous batcher ------------------------------------------------------
+
+
+def test_batcher_coalesces_queued_requests_in_one_take():
+    b = ContinuousBatcher(_cfg(max_batch=8, max_wait_ms=30.0))
+    reqs = [Request(np.zeros(2, np.float32)) for _ in range(5)]
+    for r in reqs:
+        assert b.submit(r)
+    batch = b.take_batch(timeout=1.0)
+    assert [r.rid for r in batch] == [r.rid for r in reqs]
+    assert b.depth() == 0
+
+
+def test_batcher_waits_max_wait_for_late_companions():
+    b = ContinuousBatcher(_cfg(max_batch=8, max_wait_ms=200.0))
+    first = Request(np.zeros(2, np.float32))
+    late = Request(np.zeros(2, np.float32))
+    b.submit(first)
+
+    def arrive_late():
+        time.sleep(0.05)
+        b.submit(late)
+
+    t = threading.Thread(target=arrive_late)
+    t.start()
+    batch = b.take_batch(timeout=1.0)
+    t.join()
+    # the late arrival landed inside the max-wait window and coalesced
+    assert len(batch) == 2
+
+
+def test_batcher_full_batch_dispatches_without_waiting():
+    b = ContinuousBatcher(_cfg(max_batch=4, max_wait_ms=5000.0))
+    for _ in range(4):
+        b.submit(Request(np.zeros(2, np.float32)))
+    t0 = time.monotonic()
+    batch = b.take_batch(timeout=1.0)
+    assert len(batch) == 4
+    assert time.monotonic() - t0 < 1.0  # did NOT sit out the 5s max-wait
+
+
+def test_batcher_fails_expired_requests_with_504():
+    b = ContinuousBatcher(_cfg(max_batch=4, max_wait_ms=1.0))
+    dead = Request(np.zeros(2, np.float32),
+                   deadline_t=time.monotonic() - 0.01)
+    live = Request(np.zeros(2, np.float32),
+                   deadline_t=time.monotonic() + 30.0)
+    b.submit(dead)
+    b.submit(live)
+    batch = b.take_batch(timeout=1.0)
+    assert [r.rid for r in batch] == [live.rid]
+    assert dead.code == 504 and dead.event.is_set()
+
+
+def test_batcher_requeue_front_preserves_order_and_closes_with_503():
+    b = ContinuousBatcher(_cfg(max_batch=8, max_wait_ms=1.0))
+    r1, r2, r3 = (Request(np.zeros(1, np.float32)) for _ in range(3))
+    b.submit(r3)
+    b.requeue_front([r1, r2])
+    batch = b.take_batch(timeout=1.0)
+    assert [r.rid for r in batch] == [r1.rid, r2.rid, r3.rid]
+    pending = Request(np.zeros(1, np.float32))
+    b.submit(pending)
+    b.close()
+    assert pending.code == 503
+    assert b.submit(Request(np.zeros(1, np.float32))) is False
+
+
+def test_request_terminal_state_is_single_assignment():
+    r = Request(np.zeros(1, np.float32))
+    assert r.finish(np.ones(1)) is True
+    assert r.fail(504, "late") is False
+    assert r.code == 200 and r.output is not None
+    r2 = Request(np.zeros(1, np.float32))
+    assert r2.fail(429, "shed") is True
+    assert r2.finish(np.ones(1)) is False
+    assert r2.code == 429
+
+
+# -- SLO admission -----------------------------------------------------------
+
+
+def test_admission_cold_start_admits_then_sheds_on_projection():
+    cfg = _cfg(slo_ms=500.0)
+    adm = AdmissionController(cfg)
+    # cold: no drain-rate estimate, nothing sheds however deep the queue
+    ok, wait = adm.admit(queue_depth=10_000, replicas=1)
+    assert ok and wait == 0.0
+    # one replica retires 10 req/s -> 10 queued project to 1s > 500ms SLO
+    adm.observe_batch(10, 1.0)
+    assert adm.projected_wait_s(10, 1) == pytest.approx(1.0)
+    ok, wait = adm.admit(10, 1)
+    assert not ok and wait == pytest.approx(1.0)
+    # more replicas drain faster: the same depth fits the SLO again
+    ok, _ = adm.admit(10, 4)
+    assert ok
+    # a request with its own generous deadline is NOT shed
+    ok, _ = adm.admit(10, 1, budget_s=20.0)
+    assert ok
+    # ... and a tighter-than-SLO deadline sheds earlier
+    ok, _ = adm.admit(3, 1, budget_s=0.1)
+    assert not ok
+
+
+def test_admission_ewma_tracks_observed_rate():
+    adm = AdmissionController(_cfg())
+    adm.observe_batch(8, 1.0)      # 8 req/s
+    r0 = adm.drain_rate()
+    adm.observe_batch(16, 1.0)     # rate doubles; EWMA moves toward it
+    assert r0 < adm.drain_rate() < 16.0
+
+
+# -- autoscaler decision -----------------------------------------------------
+
+
+def test_autoscale_decision_up_down_and_cooldown():
+    cfg = _cfg(min_replicas=1, max_replicas=4, target_queue=4.0,
+               cooldown_s=10.0)
+    now = 1000.0
+    # queue over the per-replica setpoint -> +1
+    assert autoscale_decision(depth=9, desired=2, cfg=cfg, now=now,
+                              last_scale_t=0.0, last_busy_t=now) == 1
+    # inside the cooldown window -> hold, whatever the queue says
+    assert autoscale_decision(9, 2, cfg, now, last_scale_t=now - 5.0,
+                              last_busy_t=now) == 0
+    # at max_replicas -> hold
+    assert autoscale_decision(100, 4, cfg, now, 0.0, now) == 0
+    # empty queue but only briefly idle -> hold
+    assert autoscale_decision(0, 2, cfg, now, 0.0,
+                              last_busy_t=now - 2.0) == 0
+    # empty queue, idle a full cooldown -> -1
+    assert autoscale_decision(0, 2, cfg, now, 0.0,
+                              last_busy_t=now - 11.0) == -1
+    # never below min_replicas
+    assert autoscale_decision(0, 1, cfg, now, 0.0, now - 100.0) == 0
+
+
+def test_manager_requeue_failed_retries_then_503():
+    cfg = _cfg(max_retries=1, max_batch=4)
+    b = ContinuousBatcher(cfg)
+    mgr = ReplicaManager(cfg, b, AdmissionController(cfg))
+    fresh = Request(np.zeros(1, np.float32))
+    spent = Request(np.zeros(1, np.float32))
+    spent.retries = 1   # already used its one retry
+    mgr._requeue_failed([fresh, spent])
+    assert spent.code == 503 and "retries exhausted" in spent.error
+    assert fresh.retries == 1 and not fresh.event.is_set()
+    assert b.depth() == 1   # only the retryable request went back
+
+
+# -- model machinery ---------------------------------------------------------
+
+
+def test_load_for_serving_refuses_raw_training_checkpoint(tmp_path):
+    state = {"params": {"w": np.ones(3)},
+             "opt_state": {"momentum": np.ones(3)}}
+    checkpoint.save(str(tmp_path / "train"), state)
+    with pytest.raises(ValueError, match="export_for_inference"):
+        load_for_serving(str(tmp_path / "train"))
+    checkpoint.export_for_inference(str(tmp_path / "serve"), state)
+    restored = load_for_serving(str(tmp_path / "serve"))
+    assert "opt_state" not in restored
+
+
+def test_resolve_builder_spec_errors():
+    assert resolve_builder("horovod_tpu.serving.model:mlp_builder") \
+        is mlp_builder
+    with pytest.raises(ValueError):
+        resolve_builder("no-colon-here")
+    with pytest.raises(ImportError):
+        resolve_builder("not.a.module:fn")
+    with pytest.raises(AttributeError):
+        resolve_builder("horovod_tpu.serving.model:nope")
+
+
+def test_make_decode_fn_scan_matches_sequential_applies():
+    import jax.numpy as jnp
+
+    def step(x):
+        return jnp.tanh(x) * 1.5 + 0.25
+
+    x = np.linspace(-1, 1, 12).astype(np.float32).reshape(3, 4)
+    scanned = make_decode_fn(step, steps=4)
+    expect = x
+    for _ in range(4):
+        expect = step(expect)
+    np.testing.assert_allclose(np.asarray(scanned(x)), np.asarray(expect),
+                               rtol=1e-6)
+    with pytest.raises(ValueError):
+        make_decode_fn(step, steps=0)
+
+
+def test_mlp_builder_rederives_architecture_from_params():
+    import jax
+
+    from horovod_tpu.models import MLP
+
+    model = MLP(features=(24, 7))
+    x = np.random.RandomState(0).randn(5, 12).astype(np.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    apply_fn = mlp_builder({"params": params})
+    out = np.asarray(apply_fn(x))
+    assert out.shape == (5, 7)
+    np.testing.assert_allclose(
+        out, np.asarray(model.apply({"params": params}, x)), rtol=1e-6)
+    with pytest.raises(ValueError, match="no Dense"):
+        mlp_builder({"params": {"Conv_0": {"kernel": np.ones((3, 3))}}})
+
+
+# -- end to end --------------------------------------------------------------
+
+
+def _post(port: int, payload: dict, timeout: float = 30.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/infer",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_two_replica_serve_kill_retry_and_drain(tmp_path):
+    """The serving e2e: export -> 2 replicas -> HTTP + in-process infer ->
+    SIGKILL one replica under load (zero failed requests, respawn,
+    blacklist) -> drain to 1 on scale-down -> still serving."""
+    import jax
+
+    from horovod_tpu.models import MLP
+
+    dim = 16
+    model = MLP(features=(32, 8))
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, dim), np.float32))["params"]
+    ckpt = str(tmp_path / "serve")
+    checkpoint.export_for_inference(ckpt, {"params": params})
+
+    cfg = _cfg(min_replicas=1, max_replicas=2, max_batch=4,
+               max_wait_ms=5.0, slo_ms=8000.0, cooldown_s=3600.0)
+    server = InferenceServer(ckpt, config=cfg,
+                             replica_env={"JAX_PLATFORMS": "cpu"}).start()
+    try:
+        server.manager.scale_to(2)
+        deadline = time.monotonic() + 180
+        while server.manager.serving_count() < 2 and \
+                time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert server.manager.serving_count() == 2, \
+            server.manager.degraded_reason or server.manager.describe()
+
+        # in-process + HTTP round trips agree with the model
+        x = np.linspace(0, 1, dim).astype(np.float32)
+        expect = np.asarray(model.apply({"params": params}, x[None]))[0]
+        np.testing.assert_allclose(server.infer(x, deadline_ms=8000),
+                                   expect, rtol=1e-5)
+        status, body = _post(server.port, {"inputs": x.tolist()})
+        assert status == 200
+        np.testing.assert_allclose(np.asarray(body["outputs"]), expect,
+                                   rtol=1e-4)
+
+        # kill one replica while requests are in flight
+        failures: list[str] = []
+
+        def load():
+            for _ in range(60):
+                try:
+                    server.infer(x, deadline_ms=8000)
+                except RuntimeError as e:
+                    failures.append(str(e))
+
+        threads = [threading.Thread(target=load) for _ in range(3)]
+        for t in threads:
+            t.start()
+        victim = next(r["pid"] for r in
+                      server.manager.describe()["replicas"].values()
+                      if r["state"] == "serving")
+        time.sleep(0.2)
+        os.kill(victim, 9)
+        for t in threads:
+            t.join()
+        assert not failures, failures[:3]
+        deadline = time.monotonic() + 120
+        while server.manager.serving_count() < 2 and \
+                time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert server.manager.serving_count() == 2, "no respawn"
+        assert server.manager.blacklist.blacklisted(), \
+            "killed replica not blacklisted"
+
+        # drain-on-scale-down: back to 1 replica with no dropped requests
+        server.manager.scale_to(1)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            reps = server.manager.describe()["replicas"]
+            if len(reps) == 1 and all(r["state"] == "serving"
+                                      for r in reps.values()):
+                break
+            time.sleep(0.1)
+        reps = server.manager.describe()["replicas"]
+        assert len(reps) == 1, reps
+        np.testing.assert_allclose(server.infer(x, deadline_ms=8000),
+                                   expect, rtol=1e-5)
+
+        # /stats carries the serving series + a schema-valid snapshot
+        from horovod_tpu.metrics import validate_snapshot
+
+        stats = server.stats()
+        assert validate_snapshot(stats["metrics"]) == []
+        counters = stats["metrics"]["counters"]
+        assert counters.get('horovod_serve_requests_total{code="200"}',
+                            0) > 0
+        assert counters.get("horovod_serve_replica_deaths_total", 0) >= 1
+        assert counters.get("horovod_serve_replica_respawns_total", 0) >= 1
+    finally:
+        server.stop()

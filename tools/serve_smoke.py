@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""CI smoke for the serving vertical (ISSUE 10; wired into ci.sh).
+
+Stands up the full train→export→serve path on the CPU mesh and verifies
+the serving contract end to end:
+
+1.  export: a tiny-MLP serving checkpoint via
+    ``checkpoint.export_for_inference``; the replica-side loader must
+    REFUSE the raw training checkpoint (error naming
+    ``export_for_inference``) and accept the exported one.
+2.  nominal load: a 2-replica server under concurrent closed-loop HTTP
+    clients — every request answers 200, continuous batching demonstrably
+    coalesces (mean device batch > 1), measured client p99 stays under the
+    smoke SLO, and load-shedding never fires.
+3.  observability: ``/healthz`` gates on replica readiness and ``/stats``
+    carries a schema-valid metrics snapshot (docs/metrics_schema.json)
+    with the serving series populated.
+4.  admission: with the fleet pinned and an SLO far below the offered
+    load's projected wait, excess requests shed with 429 (and the shed
+    counter says so) instead of stretching everyone's latency.
+5.  chaos: SIGKILL one replica mid-load — in-flight requests retry on the
+    survivor, the supervisor respawns the dead replica (back to 2
+    serving), the dead id is blacklisted, and ZERO client requests fail.
+
+Prints one perf-gate JSON line (``serve_smoke_throughput_rps``) that
+ci.sh floors with ``tools/perf_gate.py --min-abs``. Exits non-zero with a
+reason on any violation. Wall-clock budget: ~45 s.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SMOKE_SLO_MS = 2000.0   # generous: CI boxes are 1-core and oversubscribed
+DIM = 32
+
+
+def fail(msg: str) -> None:
+    print(f"serve smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+class LoadStats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.codes: dict[int, int] = {}
+        self.lat_ms: list[float] = []
+        self.errors: list[str] = []
+
+    def record(self, code: int, lat_ms: float = 0.0, err: str = "") -> None:
+        with self.lock:
+            self.codes[code] = self.codes.get(code, 0) + 1
+            if code == 200:
+                self.lat_ms.append(lat_ms)
+            elif err and len(self.errors) < 5:
+                self.errors.append(err)
+
+    def p(self, pct: float) -> float:
+        with self.lock:
+            if not self.lat_ms:
+                return 0.0
+            s = sorted(self.lat_ms)
+            return s[min(int(len(s) * pct / 100), len(s) - 1)]
+
+
+def drive(url: str, stats: LoadStats, clients: int, seconds: float,
+          deadline_ms: float = SMOKE_SLO_MS) -> float:
+    body = json.dumps({"inputs": [0.25] * DIM,
+                       "deadline_ms": deadline_ms}).encode()
+    stop_t = time.monotonic() + seconds
+
+    def loop():
+        while time.monotonic() < stop_t:
+            t0 = time.monotonic()
+            try:
+                r = urllib.request.urlopen(urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"}),
+                    timeout=deadline_ms / 1000.0 + 10)
+                r.read()
+                stats.record(r.status, (time.monotonic() - t0) * 1e3)
+            except urllib.error.HTTPError as e:
+                stats.record(e.code, err=f"HTTP {e.code}: "
+                                         f"{e.read()[:200]!r}")
+            except OSError as e:
+                stats.record(-1, err=repr(e))
+
+    threads = [threading.Thread(target=loop) for _ in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.monotonic() - t0
+
+
+def fetch(url: str):
+    return json.loads(urllib.request.urlopen(url, timeout=10).read())
+
+
+def main() -> int:
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from horovod_tpu import checkpoint as hvd_ckpt
+    from horovod_tpu import serving
+    from horovod_tpu.metrics import validate_snapshot
+
+    tmp = tempfile.mkdtemp(prefix="hvd_serve_smoke_")
+    train_ckpt = os.path.join(tmp, "train")
+    serve_ckpt = os.path.join(tmp, "serve")
+
+    # -- 1. export + the refusal contract ------------------------------------
+    from horovod_tpu.models import MLP
+
+    model = MLP(features=(64, 16))
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, DIM), np.float32))["params"]
+    train_state = {"params": params, "opt_state": {"momentum": np.ones(4)}}
+    hvd_ckpt.save(train_ckpt, train_state)              # raw training ckpt
+    hvd_ckpt.export_for_inference(serve_ckpt, train_state)
+    try:
+        serving.load_for_serving(train_ckpt)
+        fail("load_for_serving accepted a raw training checkpoint")
+    except ValueError as e:
+        if "export_for_inference" not in str(e):
+            fail(f"refusal error does not name export_for_inference: {e}")
+    state = serving.load_for_serving(serve_ckpt)
+    if "opt_state" in state:
+        fail("exported checkpoint still carries opt_state")
+    print("serve smoke: export + training-checkpoint refusal OK")
+
+    # -- 2./3. nominal load on a 2-replica server ----------------------------
+    cfg = serving.ServeConfig.from_env(
+        port=0, min_replicas=2, max_replicas=2, max_batch=8,
+        max_wait_ms=5.0, slo_ms=SMOKE_SLO_MS)
+    server = serving.InferenceServer(serve_ckpt, config=cfg).start()
+    try:
+        if not server.wait_ready(120):
+            fail("no replica became ready in 120s "
+                 + (server.manager.degraded_reason or ""))
+        base = f"http://127.0.0.1:{server.port}"
+        # healthz readiness gate
+        if not fetch(f"{base}/healthz").get("ok"):
+            fail("/healthz not ok with replicas serving")
+
+        nominal = LoadStats()
+        drive(f"{base}/v1/infer", nominal, clients=8, seconds=4.0)
+        wall = sum(nominal.codes.values())
+        if not wall:
+            fail("nominal load produced zero responses")
+        bad = {c: n for c, n in nominal.codes.items() if c != 200}
+        if bad:
+            fail(f"nominal load had non-200 responses {bad}; "
+                 f"first errors: {nominal.errors}")
+        p99 = nominal.p(99)
+        if p99 >= SMOKE_SLO_MS:
+            fail(f"nominal p99 {p99:.0f}ms >= smoke SLO {SMOKE_SLO_MS}ms")
+
+        stats = fetch(f"{base}/stats")
+        errs = validate_snapshot(stats["metrics"])
+        if errs:
+            fail(f"/stats metrics snapshot schema violations: {errs[:5]}")
+        mean_batch = stats["serving"]["mean_batch_size"]
+        if mean_batch <= 1.0:
+            fail(f"continuous batching never coalesced "
+                 f"(mean batch {mean_batch})")
+        shed = stats["serving"]["admission"]["shed_total"]
+        if shed:
+            fail(f"load shedding fired at nominal load ({shed} sheds)")
+        counters = stats["metrics"]["counters"]
+        for series in ('horovod_serve_requests_total{code="200"}',
+                       "horovod_serve_batches_total"):
+            if counters.get(series, 0) <= 0:
+                fail(f"serving series {series} missing or zero")
+        n200 = nominal.codes.get(200, 0)
+        print(f"serve smoke: nominal OK — {n200} x 200, p50 "
+              f"{nominal.p(50):.1f}ms p99 {p99:.1f}ms, mean batch "
+              f"{mean_batch:.2f}, 0 shed")
+
+        # -- 4. admission sheds when the projected wait breaks the SLO ------
+        tight = LoadStats()
+        drive(f"{base}/v1/infer", tight, clients=16, seconds=2.0,
+              deadline_ms=40.0)   # SLO-beating deadline: 16 closed-loop
+        #                           clients project > 40ms of queue wait
+        shed_now = fetch(f"{base}/stats")["serving"]["admission"][
+            "shed_total"]
+        hard_fail = sum(n for c, n in tight.codes.items()
+                        if c not in (200, 429, 504))
+        if hard_fail:
+            fail(f"overload produced hard failures: {tight.codes} "
+                 f"{tight.errors}")
+        print(f"serve smoke: overload OK — codes {tight.codes}, "
+              f"shed_total {shed_now:.0f}")
+
+        # -- 5. kill a replica mid-load; zero failed client requests --------
+        reps = fetch(f"{base}/stats")["serving"]["replicas"]
+        victim_pid = next(r["pid"] for r in reps.values()
+                          if r["state"] == "serving")
+        chaos = LoadStats()
+        killer_done = threading.Event()
+
+        def killer():
+            time.sleep(0.8)   # land the kill mid-load
+            os.kill(victim_pid, 9)
+            killer_done.set()
+
+        threading.Thread(target=killer).start()
+        elapsed = drive(f"{base}/v1/infer", chaos, clients=6, seconds=6.0)
+        if not killer_done.is_set():
+            fail("killer thread never fired")
+        bad = {c: n for c, n in chaos.codes.items() if c != 200}
+        if bad:
+            fail(f"replica kill lost client requests: {bad}; "
+                 f"first errors: {chaos.errors}")
+        deadline = time.monotonic() + 60
+        while server.manager.serving_count() < 2 and \
+                time.monotonic() < deadline:
+            time.sleep(0.2)
+        if server.manager.serving_count() < 2:
+            fail("autoscaler/supervisor never respawned the killed replica")
+        final = fetch(f"{base}/stats")
+        cs = final["metrics"]["counters"]
+        if cs.get("horovod_serve_replica_deaths_total", 0) < 1:
+            fail("replica death not counted")
+        if cs.get("horovod_serve_replica_respawns_total", 0) < 1:
+            fail("replica respawn not counted")
+        if not final["serving"]["blacklisted"]:
+            fail("killed replica id was not blacklisted")
+        n_chaos = chaos.codes.get(200, 0)
+        print(f"serve smoke: chaos OK — killed pid {victim_pid} mid-load, "
+              f"{n_chaos} x 200 / 0 failures, respawned to "
+              f"{server.manager.serving_count()} replicas, blacklist "
+              f"{final['serving']['blacklisted']}")
+
+        rps = n200 / 4.0
+        print(json.dumps({
+            "metric": "serve_smoke_throughput_rps",
+            "value": round(rps, 2), "unit": "req/s",
+            "clients": 8, "replicas": 2,
+            "p50_ms": round(nominal.p(50), 2),
+            "p99_ms": round(p99, 2),
+            "mean_batch_size": mean_batch,
+            "chaos_requests_ok": n_chaos,
+            "chaos_elapsed_s": round(elapsed, 1),
+        }), flush=True)
+    finally:
+        server.stop()
+    print("serve smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
