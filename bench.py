@@ -207,10 +207,11 @@ def _build(fusion_threshold=None, compression=None, hierarchical=False,
     # Fusion threshold: the --autotune winner on this chip (256 MiB — the
     # whole ~100 MB gradient set in one bucket; A/B measured +1.5% over the
     # 64 MiB default, reproducible across runs). HOROVOD_FUSION_THRESHOLD
-    # still overrides, and --autotune re-derives it on new hardware.
-    from horovod_tpu.common.config import _env_int
-
-    tuned_default = _env_int("HOROVOD_FUSION_THRESHOLD", 256 << 20)
+    # still overrides, and --autotune re-derives it on new hardware. The
+    # `or` spelling keeps 256 MiB a bench-local tuned seed, not a second
+    # default for the knob (the engine default stays config.py's 64 MiB —
+    # tools/analyze flags divergent defaults).
+    tuned_default = int(os.environ.get("HOROVOD_FUSION_THRESHOLD") or 256 << 20)
     opt = hvd.jax.DistributedOptimizer(
         optax.sgd(0.01 * n_dev, momentum=0.9),
         fusion_threshold=fusion_threshold or tuned_default,
@@ -440,7 +441,9 @@ def autotune_main() -> None:
         thresholds=DEFAULT_THRESHOLDS,
         branches=branches,
         warmup=3, iters=8, reps=3, gp_rounds=2,
-        log_path=os.environ.get("HVD_AUTOTUNE_LOG", "autotune_compiled.csv"),
+        # mode-local fallback, not the knob default (other modes default
+        # to no log) — hence `or`, which tools/analyze reads as a fallback
+        log_path=os.environ.get("HVD_AUTOTUNE_LOG") or "autotune_compiled.csv",
         verbose=True,
     )
     print(report.knob_curve(), file=sys.stderr)
@@ -785,7 +788,9 @@ def hier_ab_main() -> None:
     correctness riding along. One JSON line, always (budget watchdog)."""
     budget = _Budget.install("hier_ab_cross_byte_reduction", "x")
     world = int(os.environ.get("HVD_EAGER_WORLD", "4"))
-    lsz = max(2, int(os.environ.get("HVD_EAGER_LOCAL_SIZE", "2")))
+    # mode-local fallback (`or`): the hier A/B needs a >=2 grid; the knob's
+    # default stays the flat micro-bench's 1 (tools/analyze registry)
+    lsz = max(2, int(os.environ.get("HVD_EAGER_LOCAL_SIZE") or 2))
     if _smoke_on():
         os.environ.setdefault("HVD_EAGER_MB", "1")
         os.environ.setdefault("HVD_EAGER_ITERS", "3")

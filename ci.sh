@@ -36,6 +36,14 @@ for sym in ("hvd_init", "hvd_pm_create", "hvd_pm_set_num_buckets",
 print("native core loads ok (shm_open resolved)")
 PY
 
+echo "== conformance analyzer (ISSUE 11: protocol/knob/metric/lock parity across both engines; generated specs must regenerate byte-identically — hard fail on any unsuppressed finding) =="
+timeout -k 10 120 python -m tools.analyze --check
+git diff --exit-code -- docs/protocol_spec.json docs/config_registry.json \
+  || { echo "generated spec files changed on disk — commit the --emit-spec output"; exit 1; }
+
+echo "== sanitizer smoke (asan/ubsan/tsan builds of the native core; shm/ring-engine tests under ASan+UBSan with zero reports) =="
+timeout -k 10 600 python tools/sanitize_smoke.py
+
 echo "== bench smoke (tiny model, hard timeout: a hang fails fast, not rc=124 at the harness) =="
 HVD_BENCH_SMOKE=1 timeout -k 10 240 env JAX_PLATFORMS=cpu \
   python bench.py --buckets-ab | tee /tmp/hvd_bench_smoke.log

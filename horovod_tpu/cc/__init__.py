@@ -23,7 +23,18 @@ class NativeBuildError(ImportError):
 
 
 def lib_path(build: bool = True) -> str:
-    """Path to the built shared library, building it if needed."""
+    """Path to the built shared library, building it if needed.
+
+    ``HVD_NATIVE_LIB`` overrides the lazy build with an explicit library
+    path — the CI sanitizer leg points every process (including test
+    subprocesses, which inherit the env) at the ASan/UBSan build this way
+    (`make -C horovod_tpu/cc asan`, docs/analysis.md)."""
+    override = os.environ.get("HVD_NATIVE_LIB")
+    if override:
+        if not os.path.exists(override):
+            raise NativeBuildError(
+                f"HVD_NATIVE_LIB={override} does not exist")
+        return override
     with _lock:
         sources_newer = False
         if os.path.exists(_LIB):

@@ -426,7 +426,10 @@ void Engine::wait_for_work() {
     static const double cap_ms = [] {
       const char* v = std::getenv("HOROVOD_CYCLE_IDLE_MAX_MS");
       double d = (v && *v) ? std::atof(v) : 100.0;
-      return d > 1.0 ? d : 100.0;
+      // Clamp to a 1 ms floor, exactly like the Python engine's
+      // max(value, 1.0) — a sub-millisecond cap must not silently snap
+      // back to the 100 ms default on one side of the ctypes bridge only.
+      return d > 1.0 ? d : 1.0;
     }();
     timeout_ms = std::min(base * (double)(1 << std::min(idle_streak_, 6)),
                           std::max(cap_ms, base));

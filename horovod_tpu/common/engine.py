@@ -1338,7 +1338,7 @@ class PyEngine:
         # diverges").
         self._topk_error_feedback = (
             self._error_feedback
-            or os.environ.get("HOROVOD_COMPRESSION_ERROR_FEEDBACK", "")
+            or os.environ.get("HOROVOD_COMPRESSION_ERROR_FEEDBACK")
             in ("", None))
         # Distributed tracing (ISSUE 6, docs/tracing.md): per-rank span
         # recorder + per-name submission counters — the counter makes the

@@ -53,7 +53,10 @@ def get_logger() -> _pylog.Logger:
         level = _LEVELS.get(os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(), _pylog.WARNING)
         _logger.setLevel(level)
         handler = _pylog.StreamHandler(sys.stderr)
-        hide_time = os.environ.get("HOROVOD_LOG_HIDE_TIME", "").lower() not in ("", "0", "false")
+        # `or ""`: unset means the config.py default (False) — the two-arg
+        # get() form would register a second default for the knob
+        hide_time = (os.environ.get("HOROVOD_LOG_HIDE_TIME") or "").lower() \
+            not in ("", "0", "false")
         handler.setFormatter(_HvdFormatter(hide_time))
         _logger.addHandler(handler)
         _logger.propagate = False

@@ -1,0 +1,409 @@
+"""Conformance-analyzer tests (ISSUE 11, tools/analyze/, docs/analysis.md).
+
+Three layers:
+
+- parser units: the wire.h struct/enum extraction and the Python
+  dict-shape/env/metric extraction against synthetic sources — the
+  analyzer is only as good as these parsers, so they are pinned;
+- synthetic drift fixtures: each of the four passes must CATCH its
+  divergence class (an extra wire field, a default mismatch, a metric
+  missing from the schema, an unlocked shared write) — proving the gate
+  can actually fail;
+- the live tree: every pass runs green on this repo, and the checked-in
+  docs/protocol_spec.json + docs/config_registry.json regenerate
+  byte-identically (the CI invariant).
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.analyze import common, cpp, knobs, locks, metrics_lint, protocol  # noqa: E402
+from tools.analyze import pysrc  # noqa: E402
+
+
+# ------------------------------------------------------------ parser units
+
+WIRE_FIXTURE = """
+// comment with struct Fake { inside } and "struct InString {"
+struct Request {
+  int32_t rank = 0;
+  OpType op = OpType::ALLREDUCE;
+  DataType dtype = DataType::F32;  // trailing comment
+  std::string name;
+  uint8_t average = 1;
+  std::vector<int64_t> shape;
+  int64_t scratch_only = 0;  // never serialized
+
+  size_t elements() const {
+    size_t n = 1;
+    for (auto d : shape) n *= (size_t)d;
+    return n;
+  }
+
+  void write(Writer& w) const {
+    w.i32(rank);
+    w.u8((uint8_t)op);
+    w.u8((uint8_t)dtype);
+    w.str(name);
+    w.u8(average);
+    w.u8((uint8_t)shape.size());
+    for (auto d : shape) w.i64(d);
+  }
+};
+
+struct Plain {
+  uint8_t kind = 0;
+  std::vector<uint8_t> data;
+};
+"""
+
+
+def test_wire_struct_extraction():
+    structs = cpp.parse_structs(WIRE_FIXTURE)
+    req = structs["Request"]
+    assert req.member_names() == [
+        "rank", "op", "dtype", "name", "average", "shape", "scratch_only"]
+    # wire order comes from write(), not declaration order
+    assert req.wire_order == ["rank", "op", "dtype", "name", "average",
+                              "shape"]
+    assert req.scratch_members() == ["scratch_only"]
+    assert req.has_write
+    # a struct without write() is local-only: no wire order
+    assert structs["Plain"].wire_order == []
+    assert not structs["Plain"].has_write
+    # comments never leak struct names
+    assert "Fake" not in structs and "InString" not in structs
+
+
+def test_enum_extraction_explicit_and_implicit():
+    enums = cpp.parse_enums("""
+        enum class DataType : uint8_t { U8 = 0, I8, F32 = 6, F64 };
+        enum class OpType { ALLREDUCE, ALLGATHER };
+    """)
+    assert enums["DataType"] == {"U8": 0, "I8": 1, "F32": 6, "F64": 7}
+    assert enums["OpType"] == {"ALLREDUCE": 0, "ALLGATHER": 1}
+
+
+def test_cpp_getenv_default_idioms():
+    src = """
+    inline size_t cap_from_env() {
+      const char* v = std::getenv("HOROVOD_FIXTURE_CAP");
+      if (!v || !*v) return 1024;
+      long n = std::strtol(v, nullptr, 10);
+      return n > 0 ? (size_t)n : 0;   // clamp, NOT the default
+    }
+    inline uint64_t bytes_from_env() {
+      const char* env = std::getenv("HOROVOD_FIXTURE_BYTES");
+      uint64_t v = env ? std::strtoull(env, nullptr, 10) : (16u << 20);
+      return v;
+    }
+    void opaque() { const char* t = std::getenv("HOROVOD_FIXTURE_OPAQUE"); use(t); }
+    """
+    reads = {r.knob: r for r in cpp.find_getenv(src, "fixture.h")}
+    assert reads["HOROVOD_FIXTURE_CAP"].default == 1024      # guard-return
+    assert reads["HOROVOD_FIXTURE_BYTES"].default == 16 << 20  # env-ternary
+    assert not reads["HOROVOD_FIXTURE_OPAQUE"].default_known
+
+
+def test_cache_key_field_extraction():
+    fields = cpp.cache_key_fields("""
+        inline std::string cache_key(const Request& q) {
+          std::string k = q.name;
+          k.push_back((char)q.op);
+          k.append(std::to_string(q.root_rank));
+          for (int64_t d : q.shape) k.append(std::to_string(d));
+          return k;
+        }
+    """)
+    assert fields == ["name", "op", "root_rank", "shape"]
+
+
+def test_py_dict_shape_extraction():
+    mod = ast.parse(textwrap.dedent("""
+        def build(self, e):
+            req = {"name": e["name"], "op": e["op"], "shape": (1,),
+                   "dtype": "f4", "root": 0, "average": True}
+            if e.get("wire"):
+                req["wire"] = str(e["wire"])
+            return req
+    """))
+    shape = pysrc.find_dict_shape(
+        mod, {"name", "op", "shape", "dtype", "root", "average"})
+    assert shape.base_keys == ["name", "op", "shape", "dtype", "root",
+                               "average"]
+    assert shape.optional_keys == ["wire"]
+
+
+def test_py_env_read_extraction():
+    mod = ast.parse(textwrap.dedent('''
+        import os
+        DEFAULT_CAP = 16 << 20
+
+        def f():
+            """Docstring naming HOROVOD_FIXTURE_DOCONLY is not a read."""
+            a = os.environ.get("HOROVOD_FIXTURE_A", "8")
+            b = _env_int("HOROVOD_FIXTURE_B", DEFAULT_CAP)
+            c = _env_bool("HOROVOD_FIXTURE_C")
+            os.environ["HOROVOD_FIXTURE_W"] = "1"
+            table = {"x": "HOROVOD_FIXTURE_INDIRECT"}
+            return a, b, c, table
+    '''))
+    reads, writes = pysrc.find_env_reads(mod, "fixture.py")
+    by = {r.knob: r for r in reads}
+    assert common.normalize_default(by["HOROVOD_FIXTURE_A"].default) == 8
+    assert by["HOROVOD_FIXTURE_B"].default == 16 << 20  # const-folded Name
+    assert by["HOROVOD_FIXTURE_C"].default is False     # _env_bool implicit
+    assert by["HOROVOD_FIXTURE_INDIRECT"].indirect
+    assert "HOROVOD_FIXTURE_DOCONLY" not in by
+    assert [w[0] for w in writes] == ["HOROVOD_FIXTURE_W"]
+
+
+def test_py_metric_emission_extraction():
+    mod = ast.parse(textwrap.dedent('''
+        NATIVE_METRICS = ("alpha", "beta")
+
+        def f(reg, name):
+            reg.counter("horovod_fixture_total", help="h", op=op).inc()
+            _counter("horovod_fixture_wrapped_total", "help text")
+            reg.gauge(f"horovod_native_{name}").set(1)
+    '''))
+    ems, dynamic = pysrc.find_metric_emissions(mod, "fixture.py")
+    assert ("horovod_fixture_total", "counter", frozenset({"op"})) in [
+        (e.name, e.kind, e.labels) for e in ems]
+    # helper wrappers whose NAME contains counter/gauge/histogram count too
+    assert any(e.name == "horovod_fixture_wrapped_total" for e in ems)
+    assert [(d[0], d[1]) for d in dynamic] == [("horovod_native_", "gauge")]
+    expanded = pysrc.expand_dynamic(mod, "fixture.py", "horovod_native_",
+                                    "gauge", dynamic[0][2], "NATIVE_METRICS")
+    assert [e.name for e in expanded] == ["horovod_native_alpha",
+                                          "horovod_native_beta"]
+
+
+def test_suppressions_parse_and_reject():
+    entries = common.parse_suppressions(textwrap.dedent('''
+        # comment
+        [[suppress]]
+        key = "locks:unlocked-write:a.py:C.m:_x"
+        reason = "single-writer flag, readers tolerate staleness"
+    '''))
+    assert entries[0].key == "locks:unlocked-write:a.py:C.m:_x"
+    with pytest.raises(common.SuppressionError):
+        common.parse_suppressions('[[suppress]]\nkey = "k"\n')  # no reason
+    with pytest.raises(common.SuppressionError):
+        common.parse_suppressions('key = "orphan"\n')  # outside a table
+
+
+# --------------------------------------------------- drift fixtures (fail!)
+
+def _live_spec():
+    return protocol.extract(REPO)
+
+
+def test_protocol_drift_native_field_is_caught():
+    spec = _live_spec()
+    spec["native"]["messages"]["Request"]["wire_order"].append("priority")
+    found = protocol.check(REPO, spec)
+    assert any(f.code == "unmapped-native-field"
+               and "priority" in f.key for f in found)
+
+
+def test_protocol_drift_python_field_is_caught():
+    spec = _live_spec()
+    spec["python"]["request_optional_fields"].append("priority")
+    found = protocol.check(REPO, spec)
+    assert any(f.code == "unmapped-python-field"
+               and "priority" in f.key for f in found)
+
+
+def test_protocol_drift_op_id_is_caught():
+    spec = _live_spec()
+    spec["python"]["ops"]["allreduce"] = 3  # ctypes table flip
+    found = protocol.check(REPO, spec)
+    assert any(f.code == "op-id-mismatch" for f in found)
+
+
+def test_protocol_drift_dtype_order_is_caught():
+    spec = _live_spec()
+    d = spec["python"]["dtypes"]
+    d[0], d[1] = d[1], d[0]
+    found = protocol.check(REPO, spec)
+    assert any(f.code == "dtype-id-mismatch" for f in found)
+
+
+def test_knob_drift_is_caught():
+    ex = knobs.extract(REPO)
+    # undocumented knob
+    ex["knobs"]["HOROVOD_FIXTURE_NEW"] = {
+        "python": {"files": ["x.py"], "default": 1}, "documented": False}
+    # cross-engine default mismatch
+    ex["knobs"]["HOROVOD_FIXTURE_SPLIT"] = {
+        "python": {"files": ["x.py"], "default": 5},
+        "native": {"files": ["y.h"], "default": 7}, "documented": True}
+    # conflicting python defaults
+    ex["knobs"]["HOROVOD_FIXTURE_TWICE"] = {
+        "python": {"files": ["x.py", "z.py"], "defaults": [1, 2]},
+        "documented": True}
+    # documented-but-dead
+    ex["doc_mentions"] = set(ex["doc_mentions"]) | {"HOROVOD_FIXTURE_GONE"}
+    codes = {f.code for f in knobs.check(REPO, ex)
+             if "FIXTURE" in f.key}
+    assert codes == {"undocumented", "cross-default-mismatch",
+                     "py-default-conflict", "documented-dead"}
+
+
+def test_metric_drift_is_caught():
+    ex = metrics_lint.extract(REPO)
+    ex["emissions"].append(pysrc.MetricEmission(
+        "horovod_fixture_rogue_total", "counter", frozenset(), "x.py", 1))
+    ex["schema"][("horovod_fixture_orphan_total", frozenset())] = (
+        "counter", "fixture_counters", "horovod_fixture_orphan_total")
+    found = metrics_lint.check(REPO, ex)
+    assert any(f.code == "code-not-in-schema" and "rogue" in f.key
+               for f in found)
+    assert any(f.code == "schema-orphan" and "orphan" in f.key
+               for f in found)
+
+
+def test_metric_kind_mismatch_is_caught():
+    ex = metrics_lint.extract(REPO)
+    key = ("horovod_elastic_resets_total", frozenset())
+    assert key in ex["schema"]
+    ex["emissions"] = [pysrc.MetricEmission(key[0], "gauge", key[1],
+                                            "x.py", 1)]
+    ex["schema"] = {key: ex["schema"][key]}
+    found = metrics_lint.check(REPO, ex)
+    assert any(f.code == "kind-mismatch" for f in found)
+
+
+LOCK_RACE_FIXTURE = textwrap.dedent("""
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._items = []
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    self._count += 1
+                    self._items.append(self._count)
+
+        def reset(self):
+            self._count = 0          # RACE: unlocked write to guarded attr
+
+        def push_unlocked(self, x):
+            self._items.append(x)    # RACE: unlocked container mutation
+
+        def drain(self):
+            with self._lock:
+                out = list(self._items)
+                self._items.clear()
+            return out
+
+        def _helper(self):
+            self._count += 1         # held: only ever called under lock
+
+        def tick(self):
+            with self._lock:
+                self._helper()
+""")
+
+
+def test_lock_lint_catches_known_race_and_exempts_held_helpers():
+    found = locks.check_module(ast.parse(LOCK_RACE_FIXTURE), "fixture.py")
+    idents = {f.key for f in found}
+    assert "locks:unlocked-write:fixture.py:Worker.reset:_count" in idents
+    assert ("locks:unlocked-write:fixture.py:Worker.push_unlocked:_items"
+            in idents)
+    # __init__ writes and the callers-hold-lock helper are NOT findings
+    assert len(found) == 2
+
+
+def test_lock_lint_ignores_unthreaded_classes():
+    src = LOCK_RACE_FIXTURE.replace(
+        "self._thread = threading.Thread(target=self._loop, daemon=True)",
+        "self._thread = None")
+    assert locks.check_module(ast.parse(src), "fixture.py") == []
+
+
+# ------------------------------------------------- e2e drift fixture tree
+
+def test_protocol_extraction_failure_is_loud(tmp_path):
+    """A fixture tree whose anchors do not match must produce
+    extraction-failed findings, never a silent pass."""
+    root = tmp_path
+    (root / "horovod_tpu" / "cc" / "src").mkdir(parents=True)
+    (root / "horovod_tpu" / "common").mkdir(parents=True)
+    (root / "docs").mkdir()
+    for rel in (protocol.WIRE_H, protocol.COMMON_H, protocol.CACHE_H,
+                protocol.ENGINE_PY, protocol.RESPONSE_CACHE_PY,
+                protocol.NATIVE_ENGINE_PY):
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text("# nothing the anchors can match\n")
+    found = protocol.check(str(root))
+    assert found and all(f.code == "extraction-failed" for f in found)
+
+
+# ----------------------------------------------------- live-tree invariants
+
+def test_live_tree_protocol_green():
+    assert protocol.check(REPO) == []
+
+
+def test_live_tree_knobs_green():
+    assert knobs.check(REPO) == []
+
+
+def test_live_tree_metrics_green():
+    assert metrics_lint.check(REPO) == []
+
+
+def test_live_tree_locks_green_or_suppressed():
+    live, _, _ = common.apply_suppressions(
+        locks.check(REPO), common.load_suppressions(REPO))
+    assert live == []
+
+
+def test_spec_files_regenerate_byte_identical():
+    assert protocol.check_spec_file(REPO) == []
+    assert knobs.check_registry_file(REPO) == []
+    # and the renders themselves are deterministic
+    assert protocol.render(protocol.extract(REPO)) == \
+        protocol.render(protocol.extract(REPO))
+
+
+def test_spec_file_staleness_is_caught():
+    spec = protocol.extract(REPO)
+    spec["version"] = 2  # any content change
+    found = protocol.check_spec_file(REPO, spec)
+    assert found and found[0].code == "stale"
+
+
+def test_unused_suppression_detection():
+    live, supp, unused = common.apply_suppressions(
+        [common.make_finding("locks", "unlocked-write", "a.py:C.m:_x", "m")],
+        [common.Suppression("locks:unlocked-write:a.py:C.m:_x", "ok"),
+         common.Suppression("locks:unlocked-write:gone", "stale")])
+    assert live == [] and len(supp) == 1
+    assert [s.key for s in unused] == ["locks:unlocked-write:gone"]
+
+
+def test_cli_check_exits_zero_on_tree():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--check"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stderr

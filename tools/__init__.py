@@ -1,0 +1,3 @@
+# Makes tools/ importable so `python -m tools.analyze` works from the repo
+# root (the CI invocation). The smoke scripts in this directory remain plain
+# scripts run by path.

@@ -1,0 +1,69 @@
+"""Repo-wide conformance analyzer (docs/analysis.md) — the CI gate that
+keeps the two engines, the config surface, the metrics contract and the
+lock discipline machine-checked instead of hand-aligned.
+
+Four passes (ISSUE 11; ROADMAP item 2's first concrete step):
+
+1. **protocol** — wire/protocol parity between cc/src/wire.h and the
+   Python engine's request/exchange dict shapes; emits
+   docs/protocol_spec.json.
+2. **knobs** — the HOROVOD_*/HVD_* config registry with per-side defaults;
+   emits docs/config_registry.json; fails undocumented, dead, and
+   default-divergent knobs.
+3. **metrics** — every horovod_* series in code exists in
+   docs/metrics_schema.json with the same labels and kind, and vice versa.
+4. **locks** — unlocked writes to lock-protected shared attributes in the
+   threaded engine classes.
+
+Run ``python -m tools.analyze --check`` (CI) or ``--emit-spec`` after an
+intentional protocol/config change.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from . import knobs, locks, metrics_lint, protocol
+from .common import (Finding, Suppression, apply_suppressions,
+                     load_suppressions, make_finding, repo_root)
+
+PASSES = ("protocol", "knobs", "metrics", "locks")
+
+
+def run_checks(root: Optional[str] = None,
+               passes: Iterable[str] = PASSES,
+               check_specs: bool = True) -> list[Finding]:
+    """All raw findings (suppressions NOT yet applied)."""
+    root = root or repo_root()
+    passes = set(passes)
+    findings: list[Finding] = []
+    if "protocol" in passes:
+        spec = protocol.extract(root)
+        findings += protocol.check(root, spec)
+        if check_specs:
+            findings += protocol.check_spec_file(root, spec)
+    if "knobs" in passes:
+        extracted = knobs.extract(root)
+        findings += knobs.check(root, extracted)
+        if check_specs:
+            findings += knobs.check_registry_file(root, extracted)
+    if "metrics" in passes:
+        findings += metrics_lint.check(root)
+    if "locks" in passes:
+        findings += locks.check(root)
+    return findings
+
+
+def run(root: Optional[str] = None, passes: Iterable[str] = PASSES,
+        check_specs: bool = True
+        ) -> tuple[list[Finding], list[Finding], list[Suppression]]:
+    """-> (live, suppressed, unused_suppressions) after the allowlist."""
+    root = root or repo_root()
+    findings = run_checks(root, passes, check_specs)
+    sups = load_suppressions(root)
+    return apply_suppressions(findings, sups)
+
+
+def emit_specs(root: Optional[str] = None) -> list[str]:
+    root = root or repo_root()
+    return [protocol.emit(root), knobs.emit(root)]

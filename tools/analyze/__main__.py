@@ -1,0 +1,91 @@
+"""CLI for the conformance analyzer.
+
+    python -m tools.analyze --check                 # CI gate: rc 1 on any
+                                                    # unsuppressed finding
+    python -m tools.analyze --check --pass knobs    # one pass only
+    python -m tools.analyze --emit-spec             # regenerate the two
+                                                    # checked-in spec files
+    python -m tools.analyze --check --json          # machine-readable
+
+Reading a failure: every finding prints a one-line diagnosis plus its
+stable suppression ``key``. Fix the drift (the normal path), or — for a
+vetted exception — add the key to tools/analyze/suppressions.toml with a
+written reason (docs/analysis.md walks through both).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import PASSES, emit_specs, repo_root, run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="machine-checked protocol/knob/metric/lock conformance "
+                    "(docs/analysis.md)")
+    ap.add_argument("--check", action="store_true",
+                    help="run the conformance passes; exit 1 on any "
+                         "unsuppressed finding")
+    ap.add_argument("--emit-spec", action="store_true",
+                    help="regenerate docs/protocol_spec.json and "
+                         "docs/config_registry.json from the sources")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=PASSES, metavar="|".join(PASSES),
+                    help="restrict --check to one pass (repeatable)")
+    ap.add_argument("--no-spec-files", action="store_true",
+                    help="skip the generated-file freshness comparison "
+                         "(used by tests running against fixtures)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON lines")
+    ap.add_argument("--root", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    root = args.root or repo_root()
+    if not args.check and not args.emit_spec:
+        ap.error("nothing to do: pass --check and/or --emit-spec")
+
+    if args.emit_spec:
+        for path in emit_specs(root):
+            print(f"wrote {path}")
+        if not args.check:
+            return 0
+
+    live, suppressed, unused = run(root, args.passes or PASSES,
+                                   check_specs=not args.no_spec_files)
+    for s in unused:
+        # A stale allowlist entry is itself a finding: it claims to vet
+        # something that no longer exists.
+        from .common import make_finding
+
+        live.append(make_finding(
+            "spec", "unused-suppression", s.key,
+            f"suppression {s.key!r} (suppressions.toml:{s.line}) matches "
+            "no finding — delete the stale entry"))
+
+    if args.json:
+        for f in live:
+            print(json.dumps({"pass": f.pass_name, "code": f.code,
+                              "key": f.key, "message": f.message,
+                              "location": f.location}))
+    else:
+        for f in live:
+            print(f.render())
+        if suppressed:
+            print(f"[tools.analyze] {len(suppressed)} finding(s) suppressed "
+                  "by tools/analyze/suppressions.toml", file=sys.stderr)
+    if live:
+        print(f"[tools.analyze] FAIL: {len(live)} unsuppressed finding(s) — "
+              "see docs/analysis.md (\"CI says my knob/metric/protocol "
+              "drifted\")", file=sys.stderr)
+        return 1
+    print(f"[tools.analyze] OK: protocol/knobs/metrics/locks conformant "
+          f"({len(suppressed)} vetted suppression(s))", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

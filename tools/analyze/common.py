@@ -1,0 +1,261 @@
+"""Shared plumbing for the conformance analyzer (docs/analysis.md).
+
+Everything here is deliberately dependency-free (stdlib only, no jax, no
+numpy): the analyzer runs as a CI gate before anything heavy is importable,
+and it must parse the *sources* without executing them.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+KNOB_RE = re.compile(r"^(?:HOROVOD|HVD)_[A-Z0-9_]*[A-Z0-9]$")
+# Knob mentions in prose/docs: require a real final character so wildcard
+# spellings like ``HOROVOD_FAULT_NET_*`` or ``HOROVOD_CROSS_`` prefixes do
+# not register as (dead) knob names.
+KNOB_MENTION_RE = re.compile(r"\b(?:HOROVOD|HVD)_[A-Z0-9_]*[A-Z0-9]\b")
+
+
+def repo_root(start: Optional[str] = None) -> str:
+    """Repo root = nearest ancestor holding horovod_tpu/ and docs/."""
+    d = os.path.abspath(start or os.path.dirname(__file__))
+    while True:
+        if (os.path.isdir(os.path.join(d, "horovod_tpu"))
+                and os.path.isdir(os.path.join(d, "docs"))):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            raise RuntimeError("cannot locate repo root (horovod_tpu/ + docs/)")
+        d = parent
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One divergence. ``key`` is the stable identity a suppression matches
+    against — message text and line numbers stay out of it so suppressions
+    survive refactors."""
+
+    pass_name: str   # protocol | knobs | metrics | locks | spec
+    code: str        # machine-readable finding class within the pass
+    key: str         # "<pass>:<code>:<identity>" — the suppression handle
+    message: str     # human-readable one-liner
+    location: str = ""  # "path" or "path:line" — informational only
+
+    def render(self) -> str:
+        loc = f" [{self.location}]" if self.location else ""
+        return f"{self.pass_name}/{self.code}: {self.message}{loc}\n    key: {self.key}"
+
+
+def make_finding(pass_name: str, code: str, ident: str, message: str,
+                 location: str = "") -> Finding:
+    return Finding(pass_name, code, f"{pass_name}:{code}:{ident}", message,
+                   location)
+
+
+# --------------------------------------------------------------- suppressions
+
+@dataclass
+class Suppression:
+    key: str
+    reason: str
+    line: int = 0
+
+
+class SuppressionError(ValueError):
+    pass
+
+
+def parse_suppressions(text: str) -> list[Suppression]:
+    """Parse tools/analyze/suppressions.toml.
+
+    A deliberately tiny TOML subset — ``[[suppress]]`` tables with ``key``
+    and ``reason`` string values — parsed by hand so the analyzer has zero
+    third-party imports (this container has no tomllib). Every entry MUST
+    carry a non-empty reason: a suppression without a written rationale is
+    itself a finding (docs/analysis.md "Extending the allowlist").
+    """
+    entries: list[Suppression] = []
+    current: Optional[dict] = None
+    for i, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[suppress]]":
+            if current is not None:
+                entries.append(_close_suppression(current))
+            current = {"line": i}
+            continue
+        m = re.match(r'^(key|reason)\s*=\s*"((?:[^"\\]|\\.)*)"\s*(?:#.*)?$',
+                     line)
+        if m is None or current is None:
+            raise SuppressionError(
+                f"suppressions.toml:{i}: unparseable line {line!r} (only "
+                '[[suppress]] tables with key = "..." / reason = "..." are '
+                "supported)")
+        current[m.group(1)] = m.group(2).replace('\\"', '"')
+    if current is not None:
+        entries.append(_close_suppression(current))
+    return entries
+
+
+def _close_suppression(d: dict) -> Suppression:
+    if not d.get("key"):
+        raise SuppressionError(
+            f"suppressions.toml:{d['line']}: [[suppress]] entry without a key")
+    if not d.get("reason"):
+        raise SuppressionError(
+            f"suppressions.toml:{d['line']}: suppression {d['key']!r} has no "
+            "reason — every allowlist entry must explain WHY it is vetted")
+    return Suppression(key=d["key"], reason=d["reason"], line=d["line"])
+
+
+def load_suppressions(root: str) -> list[Suppression]:
+    path = os.path.join(root, "tools", "analyze", "suppressions.toml")
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        return parse_suppressions(f.read())
+
+
+def apply_suppressions(findings: Iterable[Finding],
+                       sups: Iterable[Suppression]
+                       ) -> tuple[list[Finding], list[Finding], list[Suppression]]:
+    """-> (live, suppressed, unused_suppressions). A suppression that no
+    longer matches anything is reported so the allowlist cannot accrete
+    stale vetted-years-ago entries."""
+    by_key: dict[str, Suppression] = {s.key: s for s in sups}
+    used: set[str] = set()
+    live, suppressed = [], []
+    for f in findings:
+        if f.key in by_key:
+            used.add(f.key)
+            suppressed.append(f)
+        else:
+            live.append(f)
+    unused = [s for s in sups if s.key not in used]
+    return live, suppressed, unused
+
+
+# --------------------------------------------------------------- source walks
+
+def py_files(root: str, tops: Iterable[str]) -> list[str]:
+    """Sorted .py files under the given top paths (files or directories),
+    relative to root. tools/analyze itself is always excluded: the
+    analyzer's own tables mention knob and series names and must never
+    satisfy a liveness check."""
+    out: list[str] = []
+    skip_prefix = os.path.join("tools", "analyze")
+    for top in tops:
+        abs_top = os.path.join(root, top)
+        if os.path.isfile(abs_top):
+            if top.endswith(".py"):
+                out.append(top)
+            continue
+        for dirpath, dirnames, filenames in os.walk(abs_top):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                if rel.startswith(skip_prefix):
+                    continue
+                out.append(rel)
+    return sorted(set(out))
+
+
+def parse_py(root: str, rel: str) -> ast.Module:
+    with open(os.path.join(root, rel), encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=rel)
+
+
+def read_text(root: str, rel: str) -> str:
+    with open(os.path.join(root, rel), encoding="utf-8") as f:
+        return f.read()
+
+
+# --------------------------------------------------------- constant folding
+
+def const_fold(node: ast.AST, module: ast.Module) -> object:
+    """Evaluate simple constant expressions: literals, module-level
+    ALL_CAPS names, +-*//<<-of-constants, unary minus, str()/int()/float()
+    of constants. Returns ``_UNRESOLVED`` when the expression is dynamic."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        for stmt in module.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and t.id == node.id:
+                        return const_fold(stmt.value, module)
+        return _UNRESOLVED
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = const_fold(node.operand, module)
+        return -v if isinstance(v, (int, float)) else _UNRESOLVED
+    if isinstance(node, ast.BinOp):
+        a = const_fold(node.left, module)
+        b = const_fold(node.right, module)
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+            try:
+                if isinstance(node.op, ast.Add):
+                    return a + b
+                if isinstance(node.op, ast.Sub):
+                    return a - b
+                if isinstance(node.op, ast.Mult):
+                    return a * b
+                if isinstance(node.op, ast.Div):
+                    return a / b
+                if isinstance(node.op, ast.FloorDiv):
+                    return a // b
+                if isinstance(node.op, ast.LShift):
+                    return a << b
+            except Exception:
+                return _UNRESOLVED
+        return _UNRESOLVED
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in ("str", "int", "float") and len(node.args) == 1):
+        v = const_fold(node.args[0], module)
+        if v is _UNRESOLVED:
+            return _UNRESOLVED
+        try:
+            return {"str": str, "int": int, "float": float}[node.func.id](v)
+        except Exception:
+            return _UNRESOLVED
+    return _UNRESOLVED
+
+
+class _Unresolved:
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return "<unresolved>"
+
+
+_UNRESOLVED = _Unresolved()
+UNRESOLVED = _UNRESOLVED
+
+
+def normalize_default(value: object) -> object:
+    """Knob defaults compare across languages as numbers where possible:
+    '120' (a Python str default fed to int()) and 120 (a C++ literal) are
+    the same default."""
+    if isinstance(value, bool) or value is None:
+        return value
+    if isinstance(value, (int, float)):
+        return value
+    if isinstance(value, str):
+        s = value.strip()
+        if s == "":
+            return ""
+        try:
+            return int(s)
+        except ValueError:
+            pass
+        try:
+            return float(s)
+        except ValueError:
+            pass
+        return s
+    return value

@@ -1,0 +1,297 @@
+"""C++ source extraction for the conformance analyzer.
+
+Parses the native engine's headers *as text* — no compiler, no libclang —
+which is enough because the wire layer (cc/src/wire.h) and the type layer
+(cc/src/hvd_common.h) are deliberately plain: ``enum class`` with explicit
+values, aggregate structs, and hand-rolled ``write()`` serializers. The
+parsers here are unit-tested against synthetic fixtures in
+tests/test_analyze.py so a layout change that breaks extraction fails
+loudly instead of silently extracting nothing.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def strip_comments(src: str) -> str:
+    """Remove // and /* */ comments, preserving string literals and line
+    structure (newlines inside removed block comments are kept so line
+    numbers stay meaningful)."""
+    out: list[str] = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c == '"':
+            j = i + 1
+            while j < n and src[j] != '"':
+                j += 2 if src[j] == "\\" else 1
+            out.append(src[i:min(j + 1, n)])
+            i = j + 1
+        elif c == "'":
+            j = i + 1
+            while j < n and src[j] != "'":
+                j += 2 if src[j] == "\\" else 1
+            out.append(src[i:min(j + 1, n)])
+            i = j + 1
+        elif src.startswith("//", i):
+            j = src.find("\n", i)
+            i = n if j < 0 else j
+        elif src.startswith("/*", i):
+            j = src.find("*/", i + 2)
+            seg = src[i:(n if j < 0 else j + 2)]
+            out.append("\n" * seg.count("\n"))
+            i = n if j < 0 else j + 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+# ------------------------------------------------------------------- enums
+
+def parse_enums(src: str) -> dict[str, dict[str, int]]:
+    """``enum class Name : type { A = 0, B = 1, };`` -> {Name: {A: 0, ...}}.
+    Implicit values continue from the previous member, C-style."""
+    out: dict[str, dict[str, int]] = {}
+    clean = strip_comments(src)
+    for m in re.finditer(
+            r"enum\s+(?:class\s+)?(\w+)\s*(?::\s*[\w:]+\s*)?\{([^}]*)\}",
+            clean):
+        name, body = m.group(1), m.group(2)
+        members: dict[str, int] = {}
+        nxt = 0
+        for part in body.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            mm = re.match(r"^(\w+)\s*(?:=\s*(-?\d+|0x[0-9a-fA-F]+))?$", part)
+            if not mm:
+                continue
+            if mm.group(2) is not None:
+                nxt = int(mm.group(2), 0)
+            members[mm.group(1)] = nxt
+            nxt += 1
+        out[name] = members
+    return out
+
+
+# ------------------------------------------------------------------ structs
+
+@dataclass
+class CppStruct:
+    name: str
+    #: declared data members in declaration order: (type, name, default|None)
+    members: list[tuple[str, str, Optional[str]]] = field(default_factory=list)
+    #: member names in the order ``write(Writer&)`` serializes them
+    #: (empty when the struct has no write() — a local-only message)
+    wire_order: list[str] = field(default_factory=list)
+    has_write: bool = False
+
+    def member_names(self) -> list[str]:
+        return [m[1] for m in self.members]
+
+    def scratch_members(self) -> list[str]:
+        """Declared members that never hit the wire (coordinator-local)."""
+        if not self.has_write:
+            return []
+        return [m for m in self.member_names() if m not in self.wire_order]
+
+
+def _match_brace(src: str, open_idx: int) -> int:
+    """Index just past the matching '}' for the '{' at open_idx."""
+    depth = 0
+    for i in range(open_idx, len(src)):
+        if src[i] == "{":
+            depth += 1
+        elif src[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    raise ValueError("unbalanced braces")
+
+
+def parse_structs(src: str) -> dict[str, CppStruct]:
+    clean = strip_comments(src)
+    out: dict[str, CppStruct] = {}
+    for m in re.finditer(r"\bstruct\s+(\w+)\s*\{", clean):
+        name = m.group(1)
+        open_idx = m.end() - 1
+        end = _match_brace(clean, open_idx)
+        body = clean[open_idx + 1:end - 1]
+        st = CppStruct(name=name)
+        _parse_members(body, st)
+        _parse_write(body, st)
+        out[name] = st
+    return out
+
+
+def _top_level_statements(body: str) -> list[str]:
+    """Split a struct body into depth-0 statements; a '{...}' block (method
+    body, nested enum) travels with its statement."""
+    stmts: list[str] = []
+    depth = 0
+    cur: list[str] = []
+    for ch in body:
+        cur.append(ch)
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                stmts.append("".join(cur))
+                cur = []
+        elif ch == ";" and depth == 0:
+            stmts.append("".join(cur))
+            cur = []
+    if "".join(cur).strip():
+        stmts.append("".join(cur))
+    return stmts
+
+
+_MEMBER_RE = re.compile(
+    r"^\s*((?:std::)?[\w:]+(?:<[^;=]*>)?(?:\s*[&*])?)\s+(\w+)\s*"
+    r"(?:=\s*([^;]+?)\s*)?;\s*$",
+    re.S,
+)
+
+
+def _parse_members(body: str, st: CppStruct) -> None:
+    for stmt in _top_level_statements(body):
+        s = stmt.strip()
+        if not s or "{" in s:
+            continue  # method bodies / nested enums / access specifiers
+        if "(" in s.split("=")[0]:
+            continue  # declarations with parens are functions
+        s_nolabels = re.sub(r"^\s*(public|private|protected)\s*:", "", s)
+        mm = _MEMBER_RE.match(s_nolabels)
+        if not mm:
+            continue
+        typ, nm, default = mm.group(1), mm.group(2), mm.group(3)
+        if typ in ("using", "typedef", "return", "enum", "struct", "class"):
+            continue
+        st.members.append((re.sub(r"\s+", " ", typ), nm,
+                           default.strip() if default else None))
+
+
+def _parse_write(body: str, st: CppStruct) -> None:
+    m = re.search(r"void\s+write\s*\([^)]*\)\s*const\s*\{", body)
+    if not m:
+        return
+    end = _match_brace(body, m.end() - 1)
+    wbody = body[m.end():end - 1]
+    st.has_write = True
+    names = st.member_names()
+    order: list[str] = []
+    # Each serializing statement references exactly one member: a direct
+    # codec call (w.u8((uint8_t)op)), a size prefix (w.u32(reqs.size())),
+    # a nested write (req.write(w)) or a serializing loop over a vector.
+    for stmt in re.split(r";", wbody):
+        words = re.findall(r"\b\w+\b", stmt)
+        for w in words:
+            if w in names and w not in order:
+                order.append(w)
+    st.wire_order = order
+
+
+# -------------------------------------------------------------- env knobs
+
+#: default-extraction idioms for ``getenv("X")`` sites, tried in order
+#: against the statement window following the call:
+#: 1. the explicit guard  ``if (!v || !*v) return <default>;``
+#: 2. a ternary whose condition tests the getenv result variable,
+#:    ``env ? parse(env) : <default>``  (clamp ternaries over the PARSED
+#:    value, like ``n > 0 ? n : 0``, are deliberately not defaults)
+_TERNARY_RE = re.compile(r"([^;{}\n?]*?)\?((?:[^:;?]|::)*):([^;]+);")
+_GUARD_RETURN_RE = re.compile(
+    r"if\s*\(\s*!\s*\w+\s*(?:\|\|\s*!\s*\*\s*\w+\s*)?\)\s*return\s+([^;]+);")
+
+
+def _parse_cpp_literal(expr: str) -> object:
+    """Numeric/bool/string literal, including shifted ints like
+    ``(uint64_t)8 << 30`` and ``16u << 20``. None when not a literal."""
+    e = re.sub(r"\((?:u?int\d+_t|size_t|unsigned|long|double|float)\)", "",
+               expr).strip()
+    while e.startswith("(") and e.endswith(")"):
+        inner = e[1:-1]
+        if inner.count("(") != inner.count(")"):
+            break
+        e = inner.strip()
+    if e in ("true", "false"):
+        return e == "true"
+    ms = re.match(r'^"((?:[^"\\]|\\.)*)"$', e)
+    if ms:
+        return ms.group(1)
+    mshift = re.match(r"^(\d+)[uUlL]*\s*<<\s*(\d+)$", e)
+    if mshift:
+        return int(mshift.group(1)) << int(mshift.group(2))
+    mnum = re.match(r"^-?(?:\d+\.\d*|\.\d+)$", e)
+    if mnum:
+        return float(e)
+    mint = re.match(r"^-?\d+[uUlL]*$", e)
+    if mint:
+        return int(re.sub(r"[uUlL]+$", "", e))
+    return None
+
+
+@dataclass
+class CppEnvRead:
+    knob: str
+    path: str
+    line: int
+    default: object = None       # parsed literal, or None when opaque
+    default_known: bool = False  # distinguishes "no default" from "None"
+
+
+def find_getenv(src: str, path: str) -> list[CppEnvRead]:
+    clean = strip_comments(src)
+    reads: list[CppEnvRead] = []
+    lines = clean.splitlines()
+    for i, line in enumerate(lines, 1):
+        for m in re.finditer(r'getenv\s*\(\s*"((?:HOROVOD|HVD)_[A-Z0-9_]+)"\s*\)',
+                             line):
+            knob = m.group(1)
+            window = "\n".join(lines[i - 1:i + 6])
+            var_m = re.search(r"(\w+)\s*=\s*(?:std::)?getenv", line)
+            var = var_m.group(1) if var_m else None
+            default, known = None, False
+            gm = _GUARD_RETURN_RE.search(window)
+            if gm:
+                lit = _parse_cpp_literal(gm.group(1))
+                if lit is not None:
+                    default, known = lit, True
+            if not known and var:
+                for tm in _TERNARY_RE.finditer(window):
+                    if not re.search(rf"\b{var}\b", tm.group(1)):
+                        continue
+                    lit = _parse_cpp_literal(tm.group(3))
+                    if lit is not None:
+                        default, known = lit, True
+                        break
+            reads.append(CppEnvRead(knob, path, i, default, known))
+    return reads
+
+
+# ----------------------------------------------------------- cache key
+
+def cache_key_fields(src: str) -> list[str]:
+    """Ordered unique Request fields referenced by cc/src/cache.h's
+    ``cache_key(const Request& q)`` — the native half of the signature
+    parity check against response_cache.request_key."""
+    clean = strip_comments(src)
+    m = re.search(
+        r"std::string\s+cache_key\s*\(\s*const\s+Request&\s*(\w+)\s*\)\s*\{",
+        clean)
+    if not m:
+        return []
+    var = m.group(1)
+    end = _match_brace(clean, m.end() - 1)
+    body = clean[m.end():end - 1]
+    fields: list[str] = []
+    for ref in re.finditer(rf"\b{var}\.(\w+)", body):
+        f = ref.group(1)
+        if f not in fields:
+            fields.append(f)
+    return fields

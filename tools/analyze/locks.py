@@ -1,0 +1,266 @@
+"""Pass 4 — concurrency lint over the threaded engine classes.
+
+A static, ThreadSanitizer-inspired discipline check (the native side gets
+the real TSan via the Makefile's sanitizer targets; this pass covers the
+Python side, where TSan cannot see):
+
+For every class in the target modules that owns BOTH a lock and a thread
+(``threading.Lock/RLock/Condition`` attribute + ``threading.Thread``
+creation), any attribute accessed *inside* a lock-held region is
+considered lock-protected shared state. A WRITE to such an attribute from
+an unlocked context — excluding ``__init__`` and other pre-thread-start
+construction — is flagged: it is exactly the shape of the
+unsynchronized-publish races TSan reports dynamically.
+
+Lock-held context is computed, not guessed:
+
+- code inside ``with self.<lock>:`` / ``with self.<cv>:`` is held;
+- a method whose ``self.<m>()`` call sites are ALL in held context is
+  itself held (callers-hold-lock helpers like _Coordinator._execute),
+  propagated to a fixpoint through the class-local call graph.
+
+The check is deliberately conservative-in, allowlist-out: vetted lock-free
+patterns (monotonic flags read racily by design, single-writer attrs) are
+suppressed in ``tools/analyze/suppressions.toml`` with a written reason
+each, so every exception to the discipline is enumerated and reviewable.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .common import Finding, make_finding, parse_py
+
+#: modules whose classes are held to the lock discipline — the engine /
+#: coordinator / client threads and the serving batcher's queue.
+TARGET_MODULES = (
+    os.path.join("horovod_tpu", "common", "engine.py"),
+    os.path.join("horovod_tpu", "metrics", "registry.py"),
+    os.path.join("horovod_tpu", "serving", "batcher.py"),
+)
+
+#: methods that run before any thread exists (construction / rebuild) —
+#: writes there publish via the Thread-start happens-before edge.
+_PRE_START_METHODS = {"__init__", "__post_init__"}
+
+#: mutating container-method names: calling one of these ON a shared
+#: attribute outside the lock mutates shared state just like assignment.
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
+    "clear", "add", "discard", "update", "setdefault", "put", "move_to_end",
+}
+
+
+@dataclass
+class _Access:
+    method: str
+    attr: str
+    line: int
+    kind: str      # assign | subscript-assign | delete | <mutator>() | read
+    locked: bool   # inside an explicit with-lock block
+
+
+@dataclass
+class ClassFacts:
+    name: str
+    path: str
+    lock_attrs: set = field(default_factory=set)
+    has_thread: bool = False
+    accesses: list = field(default_factory=list)          # [_Access]
+    #: method -> [(caller_method, locked_at_call_site)]
+    call_sites: dict = field(default_factory=dict)
+    methods: set = field(default_factory=set)
+
+    def held_methods(self) -> set:
+        """Methods whose every self-call site is lock-held (directly or
+        via another held method), to a fixpoint. Entry points (no self
+        call sites) are never held."""
+        held = set()
+        changed = True
+        while changed:
+            changed = False
+            for m in self.methods:
+                if m in held or m not in self.call_sites:
+                    continue
+                sites = self.call_sites[m]
+                if sites and all(locked or caller in held
+                                 for caller, locked in sites):
+                    held.add(m)
+                    changed = True
+        return held
+
+
+def _is_threading_call(node: ast.AST, names: set) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in names
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "threading")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One pass over a method body tracking with-self-lock nesting."""
+
+    def __init__(self, facts: ClassFacts, method: str) -> None:
+        self.facts = facts
+        self.method = method
+        self.depth = 0  # with-lock nesting
+
+    def _is_lock_ctx(self, item: ast.withitem) -> bool:
+        a = _self_attr(item.context_expr)
+        if a is None and isinstance(item.context_expr, ast.Call):
+            a = _self_attr(item.context_expr.func)
+        return a is not None and a in self.facts.lock_attrs
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(self._is_lock_ctx(i) for i in node.items)
+        if locked:
+            self.depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.depth -= 1
+
+    def _record(self, attr: str, line: int, kind: str) -> None:
+        self.facts.accesses.append(_Access(
+            self.method, attr, line, kind, self.depth > 0))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._visit_store_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._visit_store_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def _visit_store_target(self, t: ast.AST, line: int) -> None:
+        a = _self_attr(t)
+        if a is not None:
+            self._record(a, line, "assign")
+            return
+        # self.x[k] = v mutates the container self.x
+        if isinstance(t, ast.Subscript):
+            a = _self_attr(t.value)
+            if a is not None:
+                self._record(a, line, "subscript-assign")
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for elt in t.elts:
+                self._visit_store_target(elt, line)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            a = _self_attr(t) or (_self_attr(t.value)
+                                  if isinstance(t, ast.Subscript) else None)
+            if a is not None:
+                self._record(a, node.lineno, "delete")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # self._queue.append(...) — container mutation through a method
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATORS:
+                a = _self_attr(node.func.value)
+                if a is not None:
+                    self._record(a, node.lineno, f"{node.func.attr}()")
+            # self._helper(...) — class-local call graph edge
+            m = _self_attr(node.func)
+            if m is not None:
+                self.facts.call_sites.setdefault(m, []).append(
+                    (self.method, self.depth > 0))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        a = _self_attr(node)
+        if a is not None and isinstance(node.ctx, ast.Load):
+            self._record(a, node.lineno, "read")
+        self.generic_visit(node)
+
+
+def scan_class(cls: ast.ClassDef, path: str) -> ClassFacts:
+    facts = ClassFacts(name=cls.name, path=path)
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_threading_call(
+                node.value, {"Lock", "RLock", "Condition"}):
+            for t in node.targets:
+                a = _self_attr(t)
+                if a is not None:
+                    facts.lock_attrs.add(a)
+        if _is_threading_call(node, {"Thread"}):
+            facts.has_thread = True
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            facts.methods.add(item.name)
+            _MethodScan(facts, item.name).visit(item)
+    return facts
+
+
+def class_findings(facts: ClassFacts) -> list[Finding]:
+    if not facts.lock_attrs or not facts.has_thread:
+        return []  # discipline applies to lock-AND-thread owners only
+    held = facts.held_methods()
+
+    def effective_locked(acc: _Access) -> bool:
+        return acc.locked or acc.method in held
+
+    guarded = {a.attr for a in facts.accesses if effective_locked(a)}
+    findings: list[Finding] = []
+    seen: set = set()
+    for acc in facts.accesses:
+        if acc.kind == "read" or effective_locked(acc):
+            continue
+        if acc.method in _PRE_START_METHODS:
+            continue
+        if acc.attr not in guarded or acc.attr in facts.lock_attrs:
+            continue
+        ident = f"{facts.path}:{facts.name}.{acc.method}:{acc.attr}"
+        if ident in seen:
+            continue
+        seen.add(ident)
+        findings.append(make_finding(
+            "locks", "unlocked-write", ident,
+            f"{facts.name}.{acc.method} mutates self.{acc.attr} "
+            f"({acc.kind}) outside a lock-held region, but self.{acc.attr} "
+            "is lock-protected elsewhere in the class — take the lock or "
+            "allowlist the lock-free pattern with a reason",
+            f"{facts.path}:{acc.line}"))
+    return findings
+
+
+def check_module(module: ast.Module, path: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in module.body:
+        if isinstance(node, ast.ClassDef):
+            findings.extend(class_findings(scan_class(node, path)))
+    return findings
+
+
+def check(root: str) -> list[Finding]:
+    findings: list[Finding] = []
+    scanned = 0
+    for rel in TARGET_MODULES:
+        full = os.path.join(root, rel)
+        if not os.path.exists(full):
+            findings.append(make_finding(
+                "locks", "extraction-failed", rel,
+                f"lock-lint target module {rel} does not exist — update "
+                "tools/analyze/locks.TARGET_MODULES"))
+            continue
+        module = parse_py(root, rel)
+        findings.extend(check_module(module, rel.replace(os.sep, "/")))
+        scanned += 1
+    if scanned == 0:
+        findings.append(make_finding(
+            "locks", "extraction-failed", "all",
+            "no lock-lint target modules scanned"))
+    return findings

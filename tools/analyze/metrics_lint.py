@@ -1,0 +1,151 @@
+"""Pass 3 — metrics lint.
+
+Every ``horovod_*`` series incremented/set anywhere in horovod_tpu/ must
+exist in docs/metrics_schema.json's ``well_known_series`` contract with
+the same label-key set and the same kind (counter/gauge/histogram), and
+every schema series must have a live emission site — orphans in either
+direction fail CI.
+
+Matching is by (series name, label-KEY set): the schema pins enumerated
+label VALUES (``{plane="eager"}``) for dashboard writers, while code sites
+pass dynamic values — value-level agreement is the metrics smoke's job,
+this pass guards the shape.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional
+
+from . import pysrc
+from .common import Finding, make_finding, parse_py, py_files
+
+SCHEMA_REL = os.path.join("docs", "metrics_schema.json")
+PY_SCOPE = ("horovod_tpu",)
+
+#: dynamic f-string series families the extractor may resolve: the literal
+#: prefix maps to the module-level constant listing the member names, so a
+#: name added to the constant forces a schema entry too.
+DYNAMIC_FAMILIES = {
+    ("horovod_tpu/cc/native_engine.py", "horovod_native_"): "NATIVE_METRICS",
+}
+
+_SERIES_RE = re.compile(r'^([a-z0-9_]+)(\{(.*)\})?$')
+
+
+def parse_schema_series(entry: str) -> Optional[tuple[str, frozenset]]:
+    m = _SERIES_RE.match(entry.strip())
+    if not m:
+        return None
+    labels: set = set()
+    if m.group(3):
+        for part in m.group(3).split(","):
+            if "=" in part:
+                labels.add(part.split("=", 1)[0].strip())
+    return m.group(1), frozenset(labels)
+
+
+def extract(root: str) -> dict:
+    """-> {"emissions": [...], "unresolved_dynamic": [...],
+    "schema": {(name, labels) -> (kind, group, entry)}}"""
+    emissions: list[pysrc.MetricEmission] = []
+    unresolved: list[tuple[str, str, int]] = []
+    for rel in py_files(root, PY_SCOPE):
+        try:
+            module = parse_py(root, rel)
+        except SyntaxError:
+            continue
+        ems, dynamic = pysrc.find_metric_emissions(module, rel)
+        emissions.extend(ems)
+        for prefix, kind, line in dynamic:
+            const = DYNAMIC_FAMILIES.get((rel.replace(os.sep, "/"), prefix))
+            expanded = None
+            if const:
+                expanded = pysrc.expand_dynamic(module, rel, prefix, kind,
+                                                line, const)
+            if expanded is None:
+                unresolved.append((rel, prefix, line))
+            else:
+                emissions.extend(expanded)
+
+    schema: dict[tuple[str, frozenset], tuple[str, str, str]] = {}
+    bad_entries: list[tuple[str, str]] = []
+    with open(os.path.join(root, SCHEMA_REL), encoding="utf-8") as f:
+        doc = json.load(f)
+    for group, entries in doc.get("well_known_series", {}).items():
+        if group.startswith("$comment") or not isinstance(entries, list):
+            continue
+        kind = ("counter" if group.endswith("counters")
+                else "gauge" if group.endswith("gauges")
+                else "histogram" if group.endswith("histograms") else "")
+        for entry in entries:
+            parsed = parse_schema_series(entry)
+            if parsed is None or not kind:
+                bad_entries.append((group, entry))
+                continue
+            schema[parsed] = (kind, group, entry)
+    return {"emissions": emissions, "unresolved_dynamic": unresolved,
+            "schema": schema, "bad_entries": bad_entries}
+
+
+def _ident(name: str, labels: frozenset) -> str:
+    return name + ("{" + ",".join(sorted(labels)) + "}" if labels else "")
+
+
+def check(root: str, extracted: Optional[dict] = None) -> list[Finding]:
+    if extracted is None:
+        extracted = extract(root)
+    findings: list[Finding] = []
+    emissions = extracted["emissions"]
+    schema = extracted["schema"]
+
+    if not emissions or not schema:
+        return [make_finding(
+            "metrics", "extraction-failed", "all",
+            f"extracted {len(emissions)} emissions / {len(schema)} schema "
+            "series — the extractor or the schema layout broke")]
+    for group, entry in extracted["bad_entries"]:
+        findings.append(make_finding(
+            "metrics", "schema-unparseable", f"{group}:{entry}",
+            f"well_known_series group {group!r} entry {entry!r} is not "
+            "name{label=\"v\"} shaped (or the group name does not end in "
+            "counters/gauges/histograms)", SCHEMA_REL))
+    for rel, prefix, line in extracted["unresolved_dynamic"]:
+        findings.append(make_finding(
+            "metrics", "dynamic-unresolved", f"{rel}:{prefix}",
+            f"dynamic series name f\"{prefix}...\" cannot be resolved to a "
+            "constant name list — register it in "
+            "tools/analyze/metrics_lint.DYNAMIC_FAMILIES",
+            f"{rel}:{line}"))
+
+    seen: set[tuple[str, frozenset]] = set()
+    for em in emissions:
+        key = (em.name, em.labels)
+        entry = schema.get(key)
+        if entry is None:
+            if key not in seen:
+                findings.append(make_finding(
+                    "metrics", "code-not-in-schema", _ident(*key),
+                    f"{_ident(*key)} is emitted at {em.path}:{em.line} but "
+                    f"has no {SCHEMA_REL} well_known_series entry with that "
+                    "label set", f"{em.path}:{em.line}"))
+        elif entry[0] != em.kind:
+            findings.append(make_finding(
+                "metrics", "kind-mismatch", _ident(*key),
+                f"{_ident(*key)} is a {em.kind} at {em.path}:{em.line} but "
+                f"schema group {entry[1]!r} declares a {entry[0]}",
+                f"{em.path}:{em.line}"))
+        seen.add(key)
+
+    for key, (kind, group, entry) in sorted(
+            schema.items(), key=lambda kv: (kv[0][0], sorted(kv[0][1]))):
+        if key not in seen:
+            findings.append(make_finding(
+                "metrics", "schema-orphan", _ident(*key),
+                f"schema lists {entry!r} (group {group}) but nothing in "
+                "horovod_tpu/ emits that series with that label set — "
+                "remove the stale contract entry or restore the emission",
+                SCHEMA_REL))
+    return findings

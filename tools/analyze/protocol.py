@@ -1,0 +1,375 @@
+"""Pass 1 — wire/protocol parity between the two engines.
+
+Extracts the native wire format (cc/src/wire.h structs + hvd_common.h
+enums + cache.h cache_key) and the Python engine's protocol dict shapes
+(common/engine.py request dict, _Client exchange envelope/response keys,
+common/response_cache.request_key, cc/native_engine.py ctypes tables) into
+ONE machine-readable spec — ``docs/protocol_spec.json`` — and fails on any
+field/tag/dtype divergence between the two engines.
+
+The correspondence between native struct fields and Python dict keys is
+the explicit tables below. A field added on either side that has no entry
+here is a finding: the table IS the protocol contract, and this file is
+the seed of ROADMAP item 2's shared protocol core — when the engines
+unify, these tables become the single spec both interpret.
+
+Mapping value grammar:
+- ``"pykey"``               — direct correspondence
+- ``"@<why>"``              — deliberately one-sided (rationale required)
+- ``"@<why>:<pykey>"``      — semantically shifted correspondence (e.g. the
+  native dtype/orig_dtype pair vs the python dtype/wire tag pair)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from . import cpp, pysrc
+from .common import Finding, make_finding, parse_py, read_text
+
+SPEC_REL = os.path.join("docs", "protocol_spec.json")
+
+WIRE_H = os.path.join("horovod_tpu", "cc", "src", "wire.h")
+COMMON_H = os.path.join("horovod_tpu", "cc", "src", "hvd_common.h")
+CACHE_H = os.path.join("horovod_tpu", "cc", "src", "cache.h")
+ENGINE_PY = os.path.join("horovod_tpu", "common", "engine.py")
+RESPONSE_CACHE_PY = os.path.join("horovod_tpu", "common", "response_cache.py")
+NATIVE_ENGINE_PY = os.path.join("horovod_tpu", "cc", "native_engine.py")
+
+# ---------------------------------------------------------------- mappings
+
+# wire.h Request (one negotiation entry) <-> engine.py full-request dict.
+# The compression tagging is intentionally shifted between the engines:
+# the native Request moves/reduces at `dtype` and remembers the caller's
+# `orig_dtype`; the python dict keeps the caller dtype in `dtype` and tags
+# the wire format in `wire` (absent = dense). cache bits distinguish the
+# two the same way on both sides.
+REQUEST_FIELD_MAP = {
+    "rank": "@tick envelope carries the rank once (msg['rank'])",
+    "op": "op",
+    "dtype": "@wire/working dtype; python tags the format instead:wire",
+    "orig_dtype": "dtype",
+    "name": "name",
+    "root_rank": "root",
+    "average": "average",
+    "trace_seq": "trace",
+    "shape": "shape",
+}
+
+# wire.h TickRequest (per-tick rank->coordinator frame) <-> the python
+# exchange message envelope (_Client.exchange msg dict).
+TICK_FIELD_MAP = {
+    "rank": "rank",
+    "shutdown": "@python sends a distinct {'kind': 'bye'} message instead",
+    "reqs": "requests",
+    "cache_bits": "bits",
+}
+PY_TICK_ONLY = {
+    "kind": "envelope discriminator — the python control channel is a "
+            "tagged pickle stream, the native stream is positional",
+    "arrays": "star-relay data plane payloads; the native engine's data "
+              "plane is always the peer ring (tensor bytes never transit "
+              "the native coordinator)",
+    "redo_results": "rung-2 plane-demotion replay (ISSUE 8) — implemented "
+                    "by the python engine only",
+}
+
+# wire.h ResponseList (coordinator per-tick broadcast) <-> the python
+# exchange RESPONSE dict keys read by _Client.exchange.
+RESPONSE_FIELD_MAP = {
+    "shutdown": "@python closes the connection on 'bye' instead of a "
+                "shutdown broadcast",
+    "knob_version": "@native-only: autotuner knob sync rides the response "
+                    "broadcast (reference ParameterManager::SyncParams)",
+    "fusion_threshold": "@native-only: autotuner knob sync",
+    "cycle_time_ms": "@native-only: autotuner knob sync",
+    "hier_allreduce": "@native-only: autotuner categorical knob sync",
+    "hier_allgather": "@native-only: autotuner categorical knob sync",
+    "stall_warnings": "@native-only: the python engine surfaces stall "
+                      "reports through the metrics watchdog thread",
+    "entries": "results",
+    "cache_evict": "evict",
+    "cache_assign": "assign",
+}
+PY_RESPONSE_ONLY = {
+    "plane": "demote/re-promote epochs (ISSUE 8 escalation ladder) — "
+             "python resilience plane only",
+    "redo": "redo-request names (ISSUE 8) — python resilience plane only",
+    "results": "direct correspondence target of ResponseList.entries",
+    "assign": "direct correspondence target of ResponseList.cache_assign",
+    "evict": "direct correspondence target of ResponseList.cache_evict",
+    "__per_rank__": "per-rank result envelope (reducescatter / alltoall) "
+                    "unwrapped client-side; native returns per-rank slices "
+                    "from the ring directly",
+}
+
+# cache.h cache_key(Request) <-> response_cache.request_key(dict): the two
+# response-cache signatures must cover the same request facets or a bit
+# bound by one engine would not invalidate under the other's rules.
+CACHE_KEY_MAP = {
+    "name": "name",
+    "op": "op",
+    "dtype": "@wire/working dtype; python keys the format tag:wire",
+    "orig_dtype": "dtype",
+    "average": "average",
+    "root_rank": "root",
+    "shape": "shape",
+}
+
+# hvd_common.h DataType member -> numpy dtype name in native_engine.DTYPES
+DTYPE_NAME_MAP = {
+    "U8": "uint8", "I8": "int8", "I32": "int32", "I64": "int64",
+    "F16": "float16", "BF16": "bfloat16", "F32": "float32",
+    "F64": "float64", "BOOL": "bool",
+}
+
+
+def _map_target(v: str) -> Optional[str]:
+    """python key a mapping value points at, None for one-sided entries."""
+    if not v.startswith("@"):
+        return v
+    if ":" in v:
+        tail = v.rsplit(":", 1)[1]
+        return tail or None
+    return None
+
+
+# -------------------------------------------------------------- extraction
+
+def extract(root: str) -> dict:
+    """Pull both engines' protocol surfaces into one spec dict (the
+    content of docs/protocol_spec.json, minus formatting)."""
+    wire_src = read_text(root, WIRE_H)
+    structs = cpp.parse_structs(wire_src)
+    enums = cpp.parse_enums(read_text(root, COMMON_H))
+    cache_fields = cpp.cache_key_fields(read_text(root, CACHE_H))
+
+    engine_mod = parse_py(root, ENGINE_PY)
+    cache_mod = parse_py(root, RESPONSE_CACHE_PY)
+    native_mod = parse_py(root, NATIVE_ENGINE_PY)
+
+    request_shape = pysrc.find_dict_shape(
+        engine_mod, {"name", "op", "shape", "dtype", "root", "average"})
+    exchange_shape = pysrc.find_dict_shape(
+        engine_mod, {"kind", "rank", "requests"}, func_hint="exchange")
+    response_keys = [
+        k for k in pysrc.find_subscript_reads(engine_mod, "exchange",
+                                              class_name="_Client")
+        if k != "kind"]
+    request_key_fields = pysrc.find_subscript_reads(cache_mod, "request_key")
+
+    native_msgs = {}
+    for name in sorted(structs):
+        st = structs[name]
+        native_msgs[name] = {
+            "members": [
+                {"name": m[1], "type": m[0],
+                 **({"default": m[2]} if m[2] is not None else {})}
+                for m in st.members
+            ],
+            "wire_order": st.wire_order,
+            "serialized": st.has_write,
+            **({"scratch": st.scratch_members()}
+               if st.scratch_members() else {}),
+        }
+
+    return {
+        "$comment": (
+            "GENERATED by `python -m tools.analyze --emit-spec` — the "
+            "machine-extracted protocol shared by the python engine "
+            "(common/engine.py) and the native engine (cc/src/wire.h). "
+            "CI regenerates this file and fails on any diff "
+            "(docs/analysis.md). Do not edit by hand."),
+        "version": 1,
+        "native": {
+            "enums": {k: enums[k] for k in sorted(enums)},
+            "messages": native_msgs,
+            "cache_key_fields": cache_fields,
+        },
+        "python": {
+            "request_fields": request_shape.base_keys if request_shape else [],
+            "request_optional_fields":
+                request_shape.optional_keys if request_shape else [],
+            "exchange_request_fields":
+                exchange_shape.all_keys() if exchange_shape else [],
+            "exchange_response_fields": response_keys,
+            "request_key_fields": request_key_fields,
+            "ops": pysrc.module_constant(native_mod, "OPS") or {},
+            "dtypes": pysrc.module_constant(native_mod, "DTYPES") or [],
+            "status_names": {
+                str(k): v
+                for k, v in sorted((pysrc.module_constant(
+                    native_mod, "_STATUS_NAMES") or {}).items())},
+        },
+        "parity": {
+            "request_field_map": REQUEST_FIELD_MAP,
+            "tick_field_map": TICK_FIELD_MAP,
+            "python_tick_only": PY_TICK_ONLY,
+            "response_field_map": RESPONSE_FIELD_MAP,
+            "python_response_only": PY_RESPONSE_ONLY,
+            "cache_key_map": CACHE_KEY_MAP,
+            "dtype_name_map": DTYPE_NAME_MAP,
+        },
+    }
+
+
+def render(spec: dict) -> str:
+    return json.dumps(spec, indent=2, ensure_ascii=False) + "\n"
+
+
+# ------------------------------------------------------------------ checks
+
+def _check_mapping(findings: list, spec_side: str, native_fields: list,
+                   py_fields: list, mapping: dict, py_only: dict,
+                   ident_prefix: str) -> None:
+    targets = {_map_target(v) for v in mapping.values()} - {None}
+    for f in native_fields:
+        if f not in mapping:
+            findings.append(make_finding(
+                "protocol", "unmapped-native-field", f"{ident_prefix}.{f}",
+                f"native {spec_side} serializes field {f!r} with no python "
+                f"correspondence declared in tools/analyze/protocol.py — "
+                "add the python half (or a one-sided '@' rationale)",
+                WIRE_H))
+    for k in py_fields:
+        if k not in targets and k not in py_only:
+            findings.append(make_finding(
+                "protocol", "unmapped-python-field", f"{ident_prefix}.{k}",
+                f"python {spec_side} carries key {k!r} with no native "
+                f"correspondence declared in tools/analyze/protocol.py — "
+                "add the wire.h half (or a one-sided '@' rationale)",
+                ENGINE_PY))
+
+
+def check(root: str, spec: Optional[dict] = None) -> list[Finding]:
+    findings: list[Finding] = []
+    if spec is None:
+        spec = extract(root)
+    native = spec["native"]
+    py = spec["python"]
+
+    # -- extraction health: an anchor that stops matching is itself drift
+    for what, got in (
+            ("python request dict", py["request_fields"]),
+            ("python exchange envelope", py["exchange_request_fields"]),
+            ("python exchange response keys",
+             py["exchange_response_fields"]),
+            ("python request_key signature", py["request_key_fields"]),
+            ("native wire.h structs", native["messages"]),
+            ("native enums", native["enums"]),
+            ("native cache_key fields", native["cache_key_fields"])):
+        if not got:
+            findings.append(make_finding(
+                "protocol", "extraction-failed", what.replace(" ", "-"),
+                f"could not extract the {what} — the analyzer's anchor no "
+                "longer matches the source; fix the extractor or the code"))
+    if findings:
+        return findings
+
+    msgs = native["messages"]
+
+    # -- Request <-> request dict
+    req_wire = msgs.get("Request", {}).get("wire_order", [])
+    py_req = py["request_fields"] + py["request_optional_fields"]
+    _check_mapping(findings, "Request", req_wire, py_req,
+                   REQUEST_FIELD_MAP, {}, "Request")
+
+    # -- TickRequest <-> exchange envelope
+    tick_wire = msgs.get("TickRequest", {}).get("wire_order", [])
+    _check_mapping(findings, "TickRequest", tick_wire,
+                   py["exchange_request_fields"], TICK_FIELD_MAP,
+                   PY_TICK_ONLY, "TickRequest")
+
+    # -- ResponseList <-> exchange response
+    resp_wire = msgs.get("ResponseList", {}).get("wire_order", [])
+    _check_mapping(findings, "ResponseList", resp_wire,
+                   py["exchange_response_fields"], RESPONSE_FIELD_MAP,
+                   PY_RESPONSE_ONLY, "ResponseList")
+
+    # -- cache signature parity
+    _check_mapping(findings, "cache_key", native["cache_key_fields"],
+                   py["request_key_fields"], CACHE_KEY_MAP, {}, "cache_key")
+
+    # -- enum <-> ctypes table parity
+    ops = py["ops"]
+    optype = native["enums"].get("OpType", {})
+    for cname, cval in optype.items():
+        if ops.get(cname.lower()) != cval:
+            findings.append(make_finding(
+                "protocol", "op-id-mismatch", cname,
+                f"OpType::{cname}={cval} (hvd_common.h) vs "
+                f"OPS[{cname.lower()!r}]={ops.get(cname.lower())!r} "
+                "(native_engine.py) — the ctypes op table diverged",
+                NATIVE_ENGINE_PY))
+    for pname in ops:
+        if pname.upper() not in optype:
+            findings.append(make_finding(
+                "protocol", "op-id-mismatch", pname.upper(),
+                f"OPS[{pname!r}] (native_engine.py) has no OpType::"
+                f"{pname.upper()} in hvd_common.h", NATIVE_ENGINE_PY))
+
+    dtypes = py["dtypes"]
+    dtenum = native["enums"].get("DataType", {})
+    for cname, cval in dtenum.items():
+        expect = DTYPE_NAME_MAP.get(cname)
+        actual = dtypes[cval] if 0 <= cval < len(dtypes) else None
+        if expect is None or actual != expect:
+            findings.append(make_finding(
+                "protocol", "dtype-id-mismatch", cname,
+                f"DataType::{cname}={cval} (hvd_common.h) must be "
+                f"DTYPES[{cval}]={expect!r} in native_engine.py, found "
+                f"{actual!r}", NATIVE_ENGINE_PY))
+    if len(dtypes) != len(dtenum):
+        findings.append(make_finding(
+            "protocol", "dtype-id-mismatch", "length",
+            f"DTYPES has {len(dtypes)} entries but DataType has "
+            f"{len(dtenum)} — the dtype id spaces diverged",
+            NATIVE_ENGINE_PY))
+
+    status = py["status_names"]
+    stenum = native["enums"].get("StatusType", {})
+    by_val = {v: k for k, v in stenum.items()}
+    for code_s, pyname in status.items():
+        cname = by_val.get(int(code_s))
+        if (cname is None
+                or cname.replace("_", "").casefold()
+                != pyname.replace("_", "").casefold()):
+            findings.append(make_finding(
+                "protocol", "status-mismatch", code_s,
+                f"_STATUS_NAMES[{code_s}]={pyname!r} vs StatusType value "
+                f"{code_s} = {cname!r} in hvd_common.h",
+                NATIVE_ENGINE_PY))
+    return findings
+
+
+def check_spec_file(root: str, spec: Optional[dict] = None) -> list[Finding]:
+    """The checked-in docs/protocol_spec.json must regenerate
+    byte-identically from the current sources."""
+    if spec is None:
+        spec = extract(root)
+    rendered = render(spec)
+    path = os.path.join(root, SPEC_REL)
+    if not os.path.exists(path):
+        return [make_finding(
+            "spec", "missing", "protocol_spec",
+            f"{SPEC_REL} is missing — run `python -m tools.analyze "
+            "--emit-spec` and commit the result", SPEC_REL)]
+    with open(path, encoding="utf-8") as f:
+        on_disk = f.read()
+    if on_disk != rendered:
+        return [make_finding(
+            "spec", "stale", "protocol_spec",
+            f"{SPEC_REL} does not match the protocol extracted from the "
+            "current sources — run `python -m tools.analyze --emit-spec` "
+            "and commit the regenerated file", SPEC_REL)]
+    return []
+
+
+def emit(root: str) -> str:
+    spec = extract(root)
+    path = os.path.join(root, SPEC_REL)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(render(spec))
+    return path

@@ -1,0 +1,334 @@
+"""Python source extraction for the conformance analyzer.
+
+AST-only — never imports the modules it analyzes (the analyzer must run on
+a box with no jax and gate CI before anything is built). Three extractors:
+
+- env-knob reads (``os.environ.get/os.getenv/_env_int/..`` call sites with
+  constant-foldable defaults, plus indirect string references such as the
+  ``ServeConfig._ENV`` field->knob table);
+- metric-series emissions (``*.counter/gauge/histogram("horovod_...")``
+  in any spelling, including helper wrappers like resilience._counter and
+  the ``f"horovod_native_{name}"`` dynamic family);
+- protocol dict shapes (the engine's request dict, the client's exchange
+  envelope and response keys, response_cache.request_key) — anchored on
+  structural signatures, not line numbers, so refactors move with them.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .common import KNOB_RE, UNRESOLVED, const_fold
+
+# ------------------------------------------------------------------ knobs
+
+#: call names that read an env var as their first argument
+_READER_NAME_RE = re.compile(r"(^|_)env(_|$)|^knob$|^getenv$")
+
+
+@dataclass
+class PyEnvRead:
+    knob: str
+    path: str
+    line: int
+    default: object = None
+    default_known: bool = False
+    indirect: bool = False  # string reference, not a recognized read call
+
+
+class _EnvReadVisitor(ast.NodeVisitor):
+    def __init__(self, path: str, module: ast.Module) -> None:
+        self.path = path
+        self.module = module
+        self.reads: list[PyEnvRead] = []
+        self.writes: list[tuple[str, int]] = []
+        self.read_positions: set[tuple[int, int]] = set()
+
+    def _fname(self, func: ast.AST) -> str:
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        if isinstance(func, ast.Name):
+            return func.id
+        return ""
+
+    def _is_environ_get(self, func: ast.AST) -> bool:
+        if not isinstance(func, ast.Attribute):
+            return False
+        if func.attr == "getenv":
+            return True
+        return (func.attr in ("get", "pop")
+                and isinstance(func.value, ast.Attribute)
+                and func.value.attr == "environ")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fname = self._fname(node.func)
+        if (self._is_environ_get(node.func)
+                or _READER_NAME_RE.search(fname)):
+            if (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and KNOB_RE.match(node.args[0].value)):
+                default, known = None, False
+                if len(node.args) > 1:
+                    v = const_fold(node.args[1], self.module)
+                    if v is not UNRESOLVED:
+                        default, known = v, True
+                elif fname == "_env_bool":
+                    # config._env_bool's implicit default
+                    default, known = False, True
+                self.reads.append(PyEnvRead(
+                    node.args[0].value, self.path, node.lineno,
+                    default, known))
+                self.read_positions.add(
+                    (node.args[0].lineno, node.args[0].col_offset))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if (isinstance(node.value, ast.Attribute)
+                and node.value.attr == "environ"
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)
+                and KNOB_RE.match(node.slice.value)):
+            if isinstance(node.ctx, ast.Load):
+                self.reads.append(PyEnvRead(
+                    node.slice.value, self.path, node.lineno))
+                self.read_positions.add(
+                    (node.slice.lineno, node.slice.col_offset))
+            else:
+                self.writes.append((node.slice.value, node.lineno))
+        self.generic_visit(node)
+
+
+def find_env_reads(module: ast.Module, path: str
+                   ) -> tuple[list[PyEnvRead], list[tuple[str, int]]]:
+    """-> (reads, writes). ``reads`` includes *indirect* references: any
+    non-docstring string constant that names a knob but is not the first
+    argument of a recognized read call (e.g. values of a field->env-name
+    mapping later fed to os.environ.get). Indirect references carry no
+    default and only establish liveness."""
+    v = _EnvReadVisitor(path, module)
+    v.visit(module)
+    docstring_positions = _docstring_positions(module)
+    seen_direct = {(r.knob, r.line) for r in v.reads}
+    consumed = set(v.read_positions)
+    for node in ast.walk(module):
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and KNOB_RE.match(node.value)
+                and (node.lineno, node.col_offset) not in consumed
+                and node.lineno not in docstring_positions
+                and (node.value, node.lineno) not in seen_direct):
+            v.reads.append(PyEnvRead(node.value, path, node.lineno,
+                                     indirect=True))
+    return v.reads, v.writes
+
+
+def _docstring_positions(module: ast.Module) -> set[int]:
+    """Line spans of every docstring in the module (module, class, def)."""
+    out: set[int] = set()
+    for node in ast.walk(module):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = node.body
+            if (body and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)):
+                c = body[0].value
+                out.update(range(c.lineno, (c.end_lineno or c.lineno) + 1))
+    return out
+
+
+# ---------------------------------------------------------------- metrics
+
+@dataclass(frozen=True)
+class MetricEmission:
+    name: str
+    kind: str                 # counter | gauge | histogram
+    labels: frozenset
+    path: str
+    line: int
+
+
+_METRIC_KIND_RE = re.compile(r"(counter|gauge|histogram)", re.I)
+_NON_LABEL_KWARGS = {"help", "buckets", "help_"}
+
+
+def find_metric_emissions(module: ast.Module, path: str
+                          ) -> tuple[list[MetricEmission], list[tuple[str, str, int]]]:
+    """-> (emissions, dynamic). ``dynamic`` lists f-string series names as
+    (literal_prefix, kind, line); the caller resolves them against a
+    module-level constant tuple (see expand_dynamic)."""
+    emissions: list[MetricEmission] = []
+    dynamic: list[tuple[str, str, int]] = []
+    for node in ast.walk(module):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fname = ""
+        if isinstance(node.func, ast.Attribute):
+            fname = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            fname = node.func.id
+        km = _METRIC_KIND_RE.search(fname)
+        if not km:
+            continue
+        kind = km.group(1).lower()
+        a = node.args[0]
+        labels = frozenset(kw.arg for kw in node.keywords
+                           if kw.arg and kw.arg not in _NON_LABEL_KWARGS)
+        if (isinstance(a, ast.Constant) and isinstance(a.value, str)
+                and a.value.startswith("horovod_")):
+            emissions.append(MetricEmission(a.value, kind, labels, path,
+                                            node.lineno))
+        elif isinstance(a, ast.JoinedStr) and a.values:
+            first = a.values[0]
+            if (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                    and first.value.startswith("horovod_")):
+                dynamic.append((first.value, kind, node.lineno))
+    return emissions, dynamic
+
+
+def expand_dynamic(module: ast.Module, path: str, prefix: str, kind: str,
+                   line: int, const_name: str
+                   ) -> Optional[list[MetricEmission]]:
+    """Resolve a dynamic ``f"{prefix}{name}"`` series family against the
+    module-level tuple/list ``const_name`` of string constants. None when
+    the constant is missing or not all-strings (caller emits a finding)."""
+    for stmt in module.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and t.id == const_name:
+                    if isinstance(stmt.value, (ast.Tuple, ast.List)):
+                        names = []
+                        for elt in stmt.value.elts:
+                            if (isinstance(elt, ast.Constant)
+                                    and isinstance(elt.value, str)):
+                                names.append(elt.value)
+                            else:
+                                return None
+                        return [MetricEmission(prefix + n, kind,
+                                               frozenset(), path, line)
+                                for n in names]
+    return None
+
+
+# --------------------------------------------------------- protocol shapes
+
+@dataclass
+class DictShape:
+    """A protocol dict extracted from source: literal keys in authoring
+    order plus keys added conditionally afterwards (``d["k"] = ...``)."""
+    base_keys: list[str] = field(default_factory=list)
+    optional_keys: list[str] = field(default_factory=list)
+    function: str = ""
+    line: int = 0
+
+    def all_keys(self) -> list[str]:
+        return self.base_keys + self.optional_keys
+
+
+def _literal_str_keys(d: ast.Dict) -> list[str]:
+    keys = []
+    for k in d.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys.append(k.value)
+    return keys
+
+
+def find_dict_shape(module: ast.Module, required_keys: set,
+                    func_hint: Optional[str] = None) -> Optional[DictShape]:
+    """Locate the (unique) dict literal whose string keys are a superset of
+    ``required_keys``; collect conditional subscript-assign extensions to
+    the same variable within the enclosing function. The anchor is the KEY
+    SET, so the extraction survives the dict moving between methods."""
+    for fn in ast.walk(module):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if func_hint and fn.name != func_hint:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+                keys = _literal_str_keys(node.value)
+                if not required_keys.issubset(keys):
+                    continue
+                var = None
+                if len(node.targets) == 1 and isinstance(node.targets[0],
+                                                         ast.Name):
+                    var = node.targets[0].id
+                shape = DictShape(base_keys=keys, function=fn.name,
+                                  line=node.lineno)
+                if var:
+                    for sub in ast.walk(fn):
+                        if (isinstance(sub, ast.Assign)
+                                and len(sub.targets) == 1
+                                and isinstance(sub.targets[0], ast.Subscript)
+                                and isinstance(sub.targets[0].value, ast.Name)
+                                and sub.targets[0].value.id == var
+                                and isinstance(sub.targets[0].slice,
+                                               ast.Constant)
+                                and isinstance(sub.targets[0].slice.value,
+                                               str)):
+                            k = sub.targets[0].slice.value
+                            if (k not in shape.base_keys
+                                    and k not in shape.optional_keys):
+                                shape.optional_keys.append(k)
+                return shape
+    return None
+
+
+def find_subscript_reads(module: ast.Module, func_name: str,
+                         class_name: Optional[str] = None) -> list[str]:
+    """Ordered unique string keys a function reads via ``x["k"]`` or
+    ``x.get("k", ...)`` — used for the exchange-response keys and the
+    request_key signature fields."""
+    target = _find_function(module, func_name, class_name)
+    if target is None:
+        return []
+    keys: list[str] = []
+    for node in ast.walk(target):
+        k = None
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)
+                and isinstance(node.ctx, ast.Load)):
+            k = node.slice.value
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "get" and node.args
+              and isinstance(node.args[0], ast.Constant)
+              and isinstance(node.args[0].value, str)):
+            k = node.args[0].value
+        if k is not None and k not in keys:
+            keys.append(k)
+    return keys
+
+
+def _find_function(module: ast.Module, func_name: str,
+                   class_name: Optional[str]) -> Optional[ast.AST]:
+    for node in ast.walk(module):
+        if isinstance(node, ast.ClassDef):
+            if class_name is not None and node.name != class_name:
+                continue
+            for sub in node.body:
+                if (isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and sub.name == func_name):
+                    return sub
+        elif (class_name is None
+              and isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+              and node.name == func_name):
+            return node
+    return None
+
+
+def module_constant(module: ast.Module, name: str) -> object:
+    """Value of a module-level assignment of literal dict/tuple/list/str."""
+    for stmt in module.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    try:
+                        return ast.literal_eval(stmt.value)
+                    except (ValueError, SyntaxError):
+                        return None
+    return None

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""CI sanitizer leg (ISSUE 11, docs/analysis.md "Sanitizer-hardened
+native builds").
+
+Builds the three sanitizer variants of the native core (`make asan`/
+`ubsan`/`tsan` — build success is itself a gate) and runs the shm/ring
+engine test subset against the ASan+UBSan build:
+
+- the engine loads the sanitized library via ``HVD_NATIVE_LIB`` (the
+  cc/__init__.py override), which test subprocesses inherit;
+- ASan's runtime must be LD_PRELOADed into python; libstdc++ rides along
+  so the __cxa_throw interceptor resolves (the engine throws through
+  auth/shutdown paths by design — without the preload every throw trips
+  an ASan CHECK, not a real finding);
+- ``detect_leaks=0`` because CPython itself "leaks" interned objects at
+  exit; everything else is hard-fail (``-fno-sanitize-recover`` in the
+  build, ``abort_on_error=1`` at runtime);
+- stderr of the whole run is swept for sanitizer report markers — a
+  report that didn't crash the test (e.g. in a killed subprocess) still
+  fails the leg, unless its key is vetted in
+  tools/analyze/suppressions.toml (``sanitizer:<tool>:<frame>`` keys).
+
+TSan is built but not run here: CPython under libtsan preload drowns the
+signal in allocator noise on this image; drive it manually with
+``HVD_NATIVE_LIB=.../libhvd_core.tsan.so LD_PRELOAD=$(g++
+-print-file-name=libtsan.so)`` against a single test.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CC_DIR = os.path.join(REPO, "horovod_tpu", "cc")
+
+#: the shm/ring-engine subset the sanitizers sweep (fast tier; the slow
+#: tier runs under SLOW=1 locally, same command with -m slow)
+TESTS = ["tests/test_ring_engine.py", "tests/test_native_engine.py"]
+
+_REPORT_RE = re.compile(
+    r"ERROR: AddressSanitizer|ERROR: LeakSanitizer|runtime error:|"
+    r"AddressSanitizer CHECK failed|ERROR: ThreadSanitizer")
+
+
+def run(cmd, **kw):
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.run(cmd, **kw)
+
+
+def gcc_file(name: str) -> str:
+    out = subprocess.run(["g++", f"-print-file-name={name}"],
+                         capture_output=True, text=True).stdout.strip()
+    return out if os.path.sep in out and os.path.exists(out) else ""
+
+
+def load_sanitizer_suppressions() -> set:
+    sys.path.insert(0, REPO)
+    from tools.analyze.common import load_suppressions
+
+    return {s.key for s in load_suppressions(REPO)
+            if s.key.startswith("sanitizer:")}
+
+
+def main() -> int:
+    # 1. all three sanitizer variants must BUILD (the tsan/ubsan targets
+    # stay honest even though only asan runs here)
+    for target in ("asan", "ubsan", "tsan"):
+        r = run(["make", "-C", CC_DIR, target])
+        if r.returncode != 0:
+            print(f"FAIL: make {target} did not build", flush=True)
+            return 1
+
+    asan_rt = gcc_file("libasan.so")
+    stdcpp = gcc_file("libstdc++.so.6")
+    if not asan_rt:
+        # The gate must not silently pass on an image without the ASan
+        # runtime — fail loudly so CI owners notice the gap.
+        print("FAIL: libasan.so not found next to g++ — the sanitizer leg "
+              "cannot run on this image", flush=True)
+        return 1
+
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        HVD_NATIVE_LIB=os.path.join(CC_DIR, "libhvd_core.asan.so"),
+        LD_PRELOAD=" ".join(x for x in (asan_rt, stdcpp) if x),
+        ASAN_OPTIONS="detect_leaks=0:abort_on_error=1",
+        UBSAN_OPTIONS="print_stacktrace=1:halt_on_error=1",
+    )
+    r = run([sys.executable, "-m", "pytest", *TESTS, "-q", "-m", "not slow",
+             "-p", "no:cacheprovider"],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=540)
+    sys.stdout.write(r.stdout[-4000:])
+    combined = r.stdout + r.stderr
+
+    reports = [ln for ln in combined.splitlines() if _REPORT_RE.search(ln)]
+    vetted = load_sanitizer_suppressions()
+    live = [ln for ln in reports
+            if not any(key.split(":", 1)[1] in ln for key in vetted)]
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-4000:])
+        print("FAIL: shm/ring tests failed under ASan+UBSan", flush=True)
+        return 1
+    if live:
+        print("FAIL: sanitizer report(s) in test output:", flush=True)
+        for ln in live[:20]:
+            print("   ", ln, flush=True)
+        print("(vet a false positive in tools/analyze/suppressions.toml "
+              "with a sanitizer:<tool>:<frame> key — docs/analysis.md)",
+              flush=True)
+        return 1
+    print("sanitize smoke OK: asan/ubsan/tsan build; shm/ring tests pass "
+          "under ASan+UBSan with 0 reports", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
