@@ -1107,6 +1107,157 @@ def serve_bench_main() -> None:
     budget.emit(out)
 
 
+def serve_llm_bench_main() -> None:
+    """bench.py --serve-llm: token-latency mode over the LLM serving
+    plane (ISSUE 12). Stands up a 1-prefill + 1-decode LLMServer (TinyLM;
+    replicas are numpy-only so bring-up never negotiates a backend) and
+    drives closed-loop /v1/generate clients with mixed-length prompts.
+    The JSON line reports decode tokens/s as the headline plus TTFT/TPOT
+    p50/p99 and goodput-under-SLO (completed requests whose end-to-end
+    latency stayed inside their deadline, per second) — the
+    serving-plane figures ROADMAP item 3 names. Always one JSON line
+    (budget watchdog + bounded backend probe in main()), like every
+    other mode."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    budget = _Budget.install("serve_llm_bench_decode_tokens_per_s", "tok/s")
+    smoke = _smoke_on()
+    budget.stage("server-start")
+
+    from horovod_tpu.serving.config import LLMConfig, ServeConfig
+    from horovod_tpu.serving.llm import LLMServer
+
+    slo_ms = float(os.environ.get("HOROVOD_SERVE_LLM_SLO_MS", "") or 30000.0)
+    cfg = ServeConfig.from_env(port=0, slo_ms=slo_ms)
+    llm_cfg = LLMConfig.from_env(
+        colocated=0,
+        prefill_replicas=int(os.environ.get(
+            "HVD_SERVE_BENCH_LLM_PREFILL", "1")),
+        decode_replicas=int(os.environ.get(
+            "HVD_SERVE_BENCH_LLM_DECODE", "1")))
+    server = LLMServer(config=cfg, llm_config=llm_cfg).start()
+    out = {"metric": "serve_llm_bench_decode_tokens_per_s", "value": 0.0,
+           "unit": "tok/s", "smoke": smoke,
+           "prefill_replicas": llm_cfg.prefill_replicas,
+           "decode_replicas": llm_cfg.decode_replicas,
+           "kv_blocks": llm_cfg.num_blocks,
+           "block_size": llm_cfg.block_size, "sweep": []}
+    try:
+        if not server.wait_ready(min(60.0,
+                                     max(budget.remaining() - 30, 10))):
+            out.update({"partial": True,
+                        "reason": "no llm replica became ready"})
+            budget.emit(out)
+            return
+        url = f"http://127.0.0.1:{server.port}/v1/generate"
+        max_new = 8 if smoke else 24
+        prompt_lens = (1, 4, 9) if smoke else (1, 4, 9, 16, 25)
+
+        def drive(concurrency: int, seconds: float) -> dict:
+            lock = threading.Lock()
+            lat_ms: list[float] = []
+            ttft_ms: list[float] = []
+            tpot_ms: list[float] = []
+            tokens = [0]
+            codes: dict[int, int] = {}
+            in_slo = [0]
+            stop_t = time.monotonic() + seconds
+
+            def client(ci: int):
+                j = 0
+                while time.monotonic() < stop_t:
+                    j += 1
+                    n = prompt_lens[(ci + j) % len(prompt_lens)]
+                    body = json.dumps({
+                        "prompt": [(ci * 11 + j + k) % llm_cfg.vocab
+                                   for k in range(n)],
+                        "max_tokens": max_new,
+                        "deadline_ms": slo_ms}).encode()
+                    t0 = time.monotonic()
+                    try:
+                        r = urllib.request.urlopen(urllib.request.Request(
+                            url, data=body,
+                            headers={"Content-Type": "application/json"}),
+                            timeout=slo_ms / 1000.0 + 5)
+                        resp = json.loads(r.read())
+                        code = r.status
+                    except urllib.error.HTTPError as e:
+                        code, resp = e.code, {}
+                    except OSError:
+                        code, resp = -1, {}
+                    ms = (time.monotonic() - t0) * 1e3
+                    with lock:
+                        codes[code] = codes.get(code, 0) + 1
+                        if code == 200:
+                            lat_ms.append(ms)
+                            ttft_ms.append(resp.get("ttft_ms", 0.0))
+                            if resp.get("tpot_ms") is not None:
+                                tpot_ms.append(resp["tpot_ms"])
+                            tokens[0] += resp.get("n_tokens", 0)
+                            if ms <= slo_ms:
+                                in_slo[0] += 1
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(concurrency)]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.monotonic() - t0
+
+            def pct(vals, p):
+                if not vals:
+                    return 0.0
+                s = sorted(vals)
+                return round(s[min(int(len(s) * p / 100), len(s) - 1)], 3)
+
+            # TPOT excludes the first token, so decode tokens/s counts
+            # generated-past-first (n_tokens - 1 per request)
+            decode_tok = max(tokens[0] - codes.get(200, 0), 0)
+            return {"concurrency": concurrency,
+                    "decode_tokens_per_s": round(decode_tok / dt, 2),
+                    "goodput_rps": round(in_slo[0] / dt, 2),
+                    "requests_ok": codes.get(200, 0),
+                    "shed_429": codes.get(429, 0),
+                    "errors": sum(v for k, v in codes.items()
+                                  if k not in (200, 429)),
+                    "ttft_p50_ms": pct(ttft_ms, 50),
+                    "ttft_p99_ms": pct(ttft_ms, 99),
+                    "tpot_p50_ms": pct(tpot_ms, 50),
+                    "tpot_p99_ms": pct(tpot_ms, 99),
+                    "latency_p50_ms": pct(lat_ms, 50),
+                    "latency_p99_ms": pct(lat_ms, 99)}
+
+        budget.stage("sweep")
+        levels = (2, 6) if smoke else (2, 6, 12)
+        per_level_s = 2.0 if smoke else 5.0
+        drive(2, 0.5)   # warmup
+        for c in levels:
+            if budget.skip_if_low(f"load-{c}", per_level_s + 10):
+                break
+            out["sweep"].append(drive(c, per_level_s))
+        llm_stats = server.stats()["serving"]["llm"]
+        best = max(out["sweep"], key=lambda s: s["decode_tokens_per_s"],
+                   default=None)
+        if best:
+            out.update({
+                "value": best["decode_tokens_per_s"],
+                "goodput_rps_at_best": best["goodput_rps"],
+                "ttft_p50_ms": best["ttft_p50_ms"],
+                "ttft_p99_ms": best["ttft_p99_ms"],
+                "tpot_p50_ms": best["tpot_p50_ms"],
+                "tpot_p99_ms": best["tpot_p99_ms"],
+                "mean_batch_occupancy": llm_stats["mean_batch_occupancy"],
+                "preemptions": llm_stats["preemptions_total"],
+            })
+    finally:
+        server.stop()
+    budget.emit(out)
+
+
 def main() -> None:
     if "--eager-worker" in sys.argv:
         return eager_worker_main()
@@ -1125,6 +1276,7 @@ def main() -> None:
         "--autotune": ("autotune_best_config", "steps/s"),
         "--buckets-ab": ("buckets_ab_images_per_sec", "img/s"),
         "--roofline": ("resnet50_roofline", "GB/s"),
+        "--serve-llm": ("serve_llm_bench_decode_tokens_per_s", "tok/s"),
         "--serve": ("serve_bench_throughput_rps", "req/s"),
         "--scaling": ("scaling_suite", "n/a"),
     }
@@ -1149,6 +1301,8 @@ def main() -> None:
 
     import horovod_tpu as hvd
 
+    if "--serve-llm" in sys.argv:
+        return serve_llm_bench_main()
     if "--serve" in sys.argv:
         return serve_bench_main()
     if "--autotune" in sys.argv:

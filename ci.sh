@@ -97,6 +97,13 @@ python tools/perf_gate.py --current /tmp/hvd_serve_smoke.log \
   --require-metric serve_smoke_throughput_rps \
   --min-abs serve_smoke_throughput_rps=25 --allow-missing-baseline
 
+echo "== llm smoke (ISSUE 12 token-level serving: 1-prefill + 1-decode topology, every generation oracle-exact (zero cross-request contamination), mean decode-batch occupancy > 1 under mixed-length load, TTFT p99 under the smoke SLO, decode-replica SIGKILL recovers via re-prefill requeue with zero failed client requests) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/llm_smoke.py | tee /tmp/hvd_llm_smoke.log
+python tools/perf_gate.py --current /tmp/hvd_llm_smoke.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric llm_smoke_decode_tokens_per_s \
+  --min-abs llm_smoke_decode_tokens_per_s=150 --allow-missing-baseline
+
 echo "== fast tier (includes the launcher e2e: test_run_happy_path) =="
 python -m pytest tests/ -m fast -q
 

@@ -223,6 +223,12 @@ def wire_dtype_for_bucket(compression, dtype, nbytes: int, op,
 # One-shot warning latch: topk on the compiled plane runs dense (see the
 # resolution block in fused_allreduce); say so once, not per trace.
 _TOPK_COMPILED_WARNED = False
+# Same latch for 'adaptive': the compiled plane substitutes its dense
+# tier table (ici=none, dcn=bf16) for the eager policy's topk tier. The
+# warning fires once; the counter fires per substituting trace so the
+# fallback is visible in pod snapshots long after the log line scrolled
+# away (ROADMAP known-satellite; ISSUE 12).
+_ADAPTIVE_COMPILED_WARNED = False
 
 
 def fused_allreduce(
@@ -285,6 +291,26 @@ def fused_allreduce(
                     "HOROVOD_COMPRESSION=topk applies to the eager engines "
                     "only; the compiled plane ships dense buckets (use "
                     "bf16/adaptive for a compiled-plane wire cut)")
+        if _comp_name == "adaptive":
+            from ..metrics import registry as _metrics_registry
+
+            _metrics_registry().counter(
+                "horovod_compiled_adaptive_fallback_total",
+                help="compiled-plane traces where 'adaptive' fell back to "
+                     "its dense tier table (ici=none, dcn=bf16) because "
+                     "XLA collectives cannot ship runtime-sparse topk "
+                     "frames").inc()
+            global _ADAPTIVE_COMPILED_WARNED
+            if not _ADAPTIVE_COMPILED_WARNED:
+                _ADAPTIVE_COMPILED_WARNED = True
+                from ..utils.logging import log
+
+                log("warning",
+                    "HOROVOD_COMPRESSION=adaptive on the compiled plane "
+                    "falls back to its dense tier table (ici=none, "
+                    "dcn=bf16): topk tiers are eager-only "
+                    "(horovod_compiled_adaptive_fallback_total counts "
+                    "these traces)")
         if dcn_compression is None:
             dcn_compression = (os.environ.get("HOROVOD_DCN_COMPRESSION", "")
                                or _dcn_fmt)

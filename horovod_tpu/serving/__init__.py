@@ -35,3 +35,4 @@ from .model import (  # noqa: F401
     resolve_builder,
 )
 from .server import DEFAULT_BUILDER, InferenceServer, serve  # noqa: F401
+from . import llm  # noqa: F401  (token-level plane: serving.llm.LLMServer)

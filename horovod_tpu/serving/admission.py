@@ -92,3 +92,95 @@ class AdmissionController:
         return {"slo_ms": self.cfg.slo_ms,
                 "drain_rate_per_replica": rate,
                 "shed_total": self._shed_c.value}
+
+
+class KVAdmission:
+    """Projected-block-availability admission for the token-level plane
+    (ISSUE 12): the currency switches from queue depth to KV blocks.
+
+    A generate request needs ``blocks_for(prompt + max_tokens)`` blocks
+    of decode-pool memory over its lifetime. The controller keeps an EWMA
+    of the pool's block *release* rate (blocks freed by retiring
+    sequences per second, fed from decode-replica stats deltas) and
+    projects how long a request arriving NOW would wait for its blocks:
+    everything already queued ahead of it plus its own demand, minus what
+    is free above the watermark, drained at the observed release rate. A
+    projected wait beyond the TTFT SLO budget sheds 429 — same
+    Clipper-style math as :class:`AdmissionController`, denominated in
+    memory instead of requests.
+
+    Cold start admits everything (no release observed -> no estimate ->
+    never shed), exactly like the request-rate controller.
+    """
+
+    def __init__(self, llm_cfg, reg=None):
+        self.llm = llm_cfg
+        self.ttft_budget_s = llm_cfg.ttft_slo_ms / 1000.0
+        reg = reg or _registry()
+        self._lock = threading.Lock()
+        self._release_rate: Optional[float] = None   # blocks/s, pool-wide
+        self._shed_c = reg.counter(
+            "horovod_serve_shed_total",
+            help="requests shed (429) because the projected queue wait "
+                 "exceeded the SLO")
+        self._shed_429 = reg.counter(
+            "horovod_serve_requests_total",
+            help="terminal request outcomes by HTTP-style code", code="429")
+        self._wait_gauge = reg.gauge(
+            "horovod_serve_projected_wait_seconds",
+            help="projected queue wait at the last admission decision")
+
+    def observe_release(self, n_blocks: int, dt_s: float) -> None:
+        """Decode stats tick: ``n_blocks`` were freed by retirements over
+        ``dt_s`` seconds. Zero-release ticks still decay the EWMA —
+        a stalled pool must stop looking fast."""
+        if dt_s <= 0 or n_blocks < 0:
+            return
+        rate = n_blocks / dt_s
+        with self._lock:
+            self._release_rate = rate if self._release_rate is None else \
+                (1 - _EWMA_ALPHA) * self._release_rate + _EWMA_ALPHA * rate
+
+    def release_rate(self) -> Optional[float]:
+        with self._lock:
+            return self._release_rate
+
+    def projected_wait_s(self, blocks_needed: int, free_blocks: int,
+                         queued_blocks: int) -> float:
+        """Seconds until ``blocks_needed`` become available above the
+        watermark, with ``queued_blocks`` of earlier demand ahead. 0 when
+        it fits now; +inf when blocked with a zero release estimate."""
+        deficit = blocks_needed + queued_blocks \
+            - max(free_blocks - (self.llm.num_blocks
+                                 - self.llm.usable_blocks()), 0)
+        if deficit <= 0:
+            return 0.0
+        with self._lock:
+            rate = self._release_rate
+        if rate is None:
+            return 0.0          # cold start: no estimate, never shed
+        if rate <= 0:
+            return float("inf")
+        return deficit / rate
+
+    def admit(self, blocks_needed: int, free_blocks: int,
+              queued_blocks: int,
+              budget_s: Optional[float] = None) -> Tuple[bool, float]:
+        """(admitted, projected_wait_s); sheds 429 when the projected
+        block wait exceeds the TTFT budget."""
+        wait = self.projected_wait_s(blocks_needed, free_blocks,
+                                     queued_blocks)
+        self._wait_gauge.set(wait)
+        if wait > (budget_s if budget_s is not None
+                   else self.ttft_budget_s):
+            self._shed_c.inc()
+            self._shed_429.inc()
+            return False, wait
+        return True, wait
+
+    def report(self) -> dict:
+        with self._lock:
+            rate = self._release_rate
+        return {"ttft_slo_ms": self.llm.ttft_slo_ms,
+                "block_release_rate": rate,
+                "shed_total": self._shed_c.value}

@@ -111,3 +111,125 @@ class ServeConfig:
                 f"{self.min_replicas}..{self.max_replicas}")
         if self.slo_ms <= 0:
             raise ValueError(f"slo_ms must be > 0, got {self.slo_ms}")
+
+
+@dataclass
+class LLMConfig:
+    """Knobs of the token-level serving plane (``serving/llm/``,
+    docs/inference.md "Token-level serving"). Same contract as
+    :class:`ServeConfig`: env-resolved once by :meth:`from_env`,
+    programmatic overrides win, and :meth:`to_env` round-trips the
+    resolved config into replica-process environments so pools agree on
+    model shape and KV geometry without a side channel."""
+
+    # -- paged KV cache (per decode replica) ---------------------------------
+    block_size: int = 16      # HOROVOD_SERVE_LLM_BLOCK_SIZE: tokens/block
+    num_blocks: int = 256     # HOROVOD_SERVE_LLM_NUM_BLOCKS: pool size
+    watermark: float = 0.05   # HOROVOD_SERVE_LLM_WATERMARK: fraction of
+    #                           blocks reserved for running sequences'
+    #                           growth; admissions never touch it
+    # -- iteration-level scheduler -------------------------------------------
+    max_active: int = 8       # HOROVOD_SERVE_LLM_MAX_ACTIVE: decode batch
+    #                           slot cap (memory is the real bound)
+    max_new_tokens: int = 32  # HOROVOD_SERVE_LLM_MAX_TOKENS: default and
+    #                           cap for a request's generated tokens
+    admission_window: int = 64  # HOROVOD_SERVE_LLM_ADMISSION_WINDOW:
+    #                             iterations a queued prefill may starve
+    #                             before force-admission preempts the
+    #                             newest running sequence
+    eos_id: int = -1          # HOROVOD_SERVE_LLM_EOS: retire-on-token id
+    #                           (-1 = only max_tokens retires)
+    # -- prefill/decode disaggregation ---------------------------------------
+    prefill_replicas: int = 1  # HOROVOD_SERVE_LLM_PREFILL_REPLICAS
+    decode_replicas: int = 1   # HOROVOD_SERVE_LLM_DECODE_REPLICAS
+    colocated: int = 0         # HOROVOD_SERVE_LLM_COLOCATED: 1 = one
+    #                            both-role pool, prefill runs inside the
+    #                            decode engine (same-process fast path)
+    # -- SLOs -----------------------------------------------------------------
+    slo_ms: float = 30000.0    # HOROVOD_SERVE_LLM_SLO_MS: default
+    #                            end-to-end deadline for /v1/generate
+    ttft_slo_ms: float = 2000.0  # HOROVOD_SERVE_LLM_TTFT_SLO_MS: the
+    #                              admission budget — shed when projected
+    #                              block wait exceeds it
+    # -- reference model shape (TinyLM builder contract) ---------------------
+    vocab: int = 64            # HOROVOD_SERVE_LLM_VOCAB
+    dim: int = 16              # HOROVOD_SERVE_LLM_DIM
+    max_context: int = 512     # HOROVOD_SERVE_LLM_MAX_CONTEXT
+    seed: int = 0              # HOROVOD_SERVE_LLM_SEED
+
+    _ENV = {
+        "block_size": "HOROVOD_SERVE_LLM_BLOCK_SIZE",
+        "num_blocks": "HOROVOD_SERVE_LLM_NUM_BLOCKS",
+        "watermark": "HOROVOD_SERVE_LLM_WATERMARK",
+        "max_active": "HOROVOD_SERVE_LLM_MAX_ACTIVE",
+        "max_new_tokens": "HOROVOD_SERVE_LLM_MAX_TOKENS",
+        "admission_window": "HOROVOD_SERVE_LLM_ADMISSION_WINDOW",
+        "eos_id": "HOROVOD_SERVE_LLM_EOS",
+        "prefill_replicas": "HOROVOD_SERVE_LLM_PREFILL_REPLICAS",
+        "decode_replicas": "HOROVOD_SERVE_LLM_DECODE_REPLICAS",
+        "colocated": "HOROVOD_SERVE_LLM_COLOCATED",
+        "slo_ms": "HOROVOD_SERVE_LLM_SLO_MS",
+        "ttft_slo_ms": "HOROVOD_SERVE_LLM_TTFT_SLO_MS",
+        "vocab": "HOROVOD_SERVE_LLM_VOCAB",
+        "dim": "HOROVOD_SERVE_LLM_DIM",
+        "max_context": "HOROVOD_SERVE_LLM_MAX_CONTEXT",
+        "seed": "HOROVOD_SERVE_LLM_SEED",
+    }
+
+    @classmethod
+    def from_env(cls, **overrides) -> "LLMConfig":
+        kw = {}
+        for f in fields(cls):
+            raw = os.environ.get(cls._ENV.get(f.name, ""), "")
+            if f.name in overrides:
+                kw[f.name] = overrides.pop(f.name)
+            elif raw:
+                t = f.type if isinstance(f.type, type) \
+                    else {"int": int, "float": float, "str": str}.get(
+                        str(f.type), str)
+                kw[f.name] = t(raw)
+        if overrides:
+            raise TypeError(f"unknown LLMConfig overrides: "
+                            f"{sorted(overrides)}")
+        cfg = cls(**kw)
+        cfg.validate()
+        return cfg
+
+    def to_env(self) -> dict:
+        """The resolved config as the env contract a replica process
+        re-reads with :meth:`from_env` — how the router pins programmatic
+        overrides (tests, bench) across the process boundary."""
+        return {env: str(getattr(self, name))
+                for name, env in self._ENV.items()}
+
+    def usable_blocks(self) -> int:
+        """Blocks an ADMISSION may claim (total minus the watermark
+        reserve) — the bound a request's prompt+max_tokens must fit for
+        the lone-sequence-always-completes guarantee to hold."""
+        import math
+
+        return self.num_blocks - int(math.ceil(
+            self.num_blocks * self.watermark))
+
+    def validate(self) -> None:
+        if self.block_size < 1 or self.num_blocks < 1:
+            raise ValueError(
+                f"need block_size >= 1 and num_blocks >= 1, got "
+                f"{self.block_size}/{self.num_blocks}")
+        if not 0.0 <= self.watermark < 1.0:
+            raise ValueError(
+                f"watermark must be in [0, 1), got {self.watermark}")
+        if self.max_active < 1 or self.max_new_tokens < 1:
+            raise ValueError(
+                f"need max_active >= 1 and max_new_tokens >= 1, got "
+                f"{self.max_active}/{self.max_new_tokens}")
+        if self.decode_replicas < 1 or (not self.colocated
+                                        and self.prefill_replicas < 1):
+            raise ValueError(
+                f"need decode_replicas >= 1 (and prefill_replicas >= 1 "
+                f"unless colocated), got {self.prefill_replicas}/"
+                f"{self.decode_replicas}")
+        if self.slo_ms <= 0 or self.ttft_slo_ms <= 0:
+            raise ValueError(
+                f"SLOs must be > 0, got slo_ms={self.slo_ms} "
+                f"ttft_slo_ms={self.ttft_slo_ms}")

@@ -70,7 +70,7 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?")[0]
         srv = self.server_ref
         if path == "/healthz":
-            n = srv.manager.serving_count()
+            n = srv.ready_count()
             self._reply(200 if n >= 1 else 503,
                         {"ok": n >= 1, "replicas": n})
         elif path == "/stats":
@@ -80,12 +80,32 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802
         path = self.path.split("?")[0]
-        if path != "/v1/infer":
+        if path not in ("/v1/infer", "/v1/generate"):
             self._reply(404, {"error": f"no route {path}"})
             return
         if not self._authenticated():
             self._reply(401, {"error": "missing or wrong bearer token "
                                        "(HOROVOD_SERVE_TOKEN)"})
+            return
+        if path == "/v1/generate":
+            # Token-level plane (serving/llm/): the LLM server owns the
+            # whole request lifecycle; stateless servers have no route.
+            fn = getattr(self.server_ref, "handle_generate_http", None)
+            if fn is None:
+                self._reply(404, {"error": "/v1/generate requires the "
+                                           "LLM serving plane (LLMServer; "
+                                           "docs/inference.md)"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, TypeError) as e:
+                self._reply(400, {"error": f"malformed request: {e}"})
+                return
+            status, obj, headers = fn(body)
+            self._reply(status, obj, headers=headers)
             return
         try:
             n = int(self.headers.get("Content-Length", "0"))

@@ -1,0 +1,58 @@
+"""Prefill → decode KV handoff — the disaggregation wire format.
+
+A prefill replica computes the prompt's K/V pages and the first generated
+token; the router forwards that state to a decode replica as a
+``submit_seq`` request over the same authenticated ``BasicService``
+channel every replica already speaks (HMAC-framed, session-keyed —
+runner/network.py). The payload is self-describing: contiguous
+``[prompt_len, dim]`` float32 K and V arrays plus the token ids, which
+the decode side re-pages into ITS OWN block allocator on admission
+(kv_cache.PagedKVCache.load) — block ids are replica-local, so the
+"block table" crosses the wire as the ordered page *contents*, not ids.
+
+``pack_kv``/``unpack_kv`` bound the format in one place and give the
+router its byte accounting (``horovod_serve_llm_handoff_bytes_total``).
+When prefill and decode are colocated in one replica (role ``both``,
+HOROVOD_SERVE_LLM_COLOCATED=1) none of this serializes: the sequence
+prefills inside the decode engine itself — the same-process fast path,
+counted as ``horovod_serve_llm_handoffs_total{path="local"}`` vs
+``{path="wire"}``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pack_kv(tokens, k_arr: np.ndarray, v_arr: np.ndarray,
+            first_token: int) -> dict:
+    """The wire payload for one prefilled sequence. Arrays are forced to
+    contiguous float32 so the byte count below is the true wire cost."""
+    k = np.ascontiguousarray(k_arr, dtype=np.float32)
+    v = np.ascontiguousarray(v_arr, dtype=np.float32)
+    if k.shape != v.shape or k.ndim != 2 or len(k) != len(tokens):
+        raise ValueError(
+            f"malformed KV payload: k{k.shape} v{v.shape} for "
+            f"{len(tokens)} tokens")
+    return {"tokens": [int(t) for t in tokens], "k": k, "v": v,
+            "first_token": int(first_token)}
+
+
+def handoff_nbytes(payload: dict) -> int:
+    """Tensor bytes this handoff moves (the metric the smoke reports;
+    token ids and framing are noise next to the pages)."""
+    return int(payload["k"].nbytes + payload["v"].nbytes)
+
+
+def unpack_kv(payload: dict) -> tuple:
+    """-> (tokens, k, v, first_token); validates shape agreement so a
+    truncated/corrupted payload fails loudly at the decode side instead
+    of decoding garbage context."""
+    k = np.asarray(payload["k"], dtype=np.float32)
+    v = np.asarray(payload["v"], dtype=np.float32)
+    tokens = [int(t) for t in payload["tokens"]]
+    if k.shape != v.shape or k.ndim != 2 or len(k) != len(tokens):
+        raise ValueError(
+            f"malformed KV payload: k{k.shape} v{v.shape} for "
+            f"{len(tokens)} tokens")
+    return tokens, k, v, int(payload["first_token"])

@@ -1,0 +1,204 @@
+"""Paged KV-cache allocator — memory as the serving plane's admission
+currency (vLLM-style; Kwon et al., SOSP '23).
+
+The device KV cache is carved into ``num_blocks`` fixed-size blocks of
+``block_size`` token slots each. A sequence owns an ordered *block table*
+(block ids, one per ``block_size`` tokens of context); token position
+``p`` lives in ``table[p // block_size]`` at slot ``p % block_size``.
+Because any free block can serve any sequence, there is no external
+fragmentation: capacity freed by a retiring sequence is usable by the
+next admission immediately, whatever the interleaving history.
+
+Two-tier availability policy:
+
+- **admission allocations** (:meth:`BlockAllocator.alloc`) must leave the
+  *watermark reserve* untouched — ``ceil(num_blocks * watermark)`` blocks
+  held back so sequences already running can keep growing;
+- **growth allocations** (:meth:`BlockAllocator.extend`) may dip into the
+  reserve. When even the reserve is exhausted the caller preempts the
+  newest running sequence and requeues it (scheduler.py) — preemption
+  instead of OOM is the whole point of paging.
+
+The allocator is pure bookkeeping (block ids, no tensor data) so the
+property tests can hammer it standalone; :class:`PagedKVCache` pairs it
+with the actual K/V block storage and the gather/scatter used by the
+block-table decode step (serving/model.py).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+
+def blocks_for(n_tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``n_tokens`` of context."""
+    if n_tokens <= 0:
+        return 0
+    return -(-n_tokens // block_size)
+
+
+class BlockAllocator:
+    """Free-list allocator for fixed-size KV blocks with per-sequence
+    block tables and a watermark reserve. NOT thread-safe: the owning
+    scheduler/engine serializes access under its own lock."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 watermark: float = 0.05) -> None:
+        if num_blocks < 1 or block_size < 1:
+            raise ValueError(
+                f"need num_blocks >= 1 and block_size >= 1, got "
+                f"{num_blocks}/{block_size}")
+        if not 0.0 <= watermark < 1.0:
+            raise ValueError(f"watermark must be in [0, 1), got {watermark}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.reserve = int(np.ceil(num_blocks * watermark))
+        self._free: deque[int] = deque(range(num_blocks))
+        self._tables: dict[object, list[int]] = {}
+        self.preemptions_total = 0
+
+    # -- views ---------------------------------------------------------------
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def table(self, seq_id) -> list[int]:
+        """The sequence's block table (a copy; ordered by token position)."""
+        return list(self._tables[seq_id])
+
+    def owned(self, seq_id) -> int:
+        t = self._tables.get(seq_id)
+        return len(t) if t is not None else 0
+
+    def capacity(self, seq_id) -> int:
+        """Token positions the sequence's current table can hold."""
+        return self.owned(seq_id) * self.block_size
+
+    def can_alloc(self, n_blocks: int) -> bool:
+        """Would an ADMISSION allocation of ``n_blocks`` succeed (i.e.
+        without dipping into the watermark reserve)?"""
+        return len(self._free) - self.reserve >= n_blocks
+
+    # -- the three mutations ---------------------------------------------------
+
+    def alloc(self, seq_id, n_tokens: int) -> Optional[list[int]]:
+        """Admission-time allocation: a table for ``n_tokens`` of context.
+        None when granting it would eat into the reserve (the caller keeps
+        the sequence queued or preempts). A sequence id may hold at most
+        one table."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already holds a table "
+                             f"(alloc after alloc without free/preempt)")
+        need = blocks_for(n_tokens, self.block_size)
+        if not self.can_alloc(need):
+            return None
+        table = [self._free.popleft() for _ in range(need)]
+        self._tables[seq_id] = table
+        return list(table)
+
+    def extend(self, seq_id, n_tokens: int) -> bool:
+        """Grow the table so it can hold ``n_tokens`` of context. Growth
+        MAY consume the watermark reserve (that is what the reserve is
+        for); False when the free list is empty — the caller preempts."""
+        table = self._tables.get(seq_id)
+        if table is None:
+            raise ValueError(f"extend of unknown sequence {seq_id!r}")
+        need = blocks_for(n_tokens, self.block_size) - len(table)
+        if need <= 0:
+            return True
+        if len(self._free) < need:
+            return False
+        for _ in range(need):
+            table.append(self._free.popleft())
+        return True
+
+    def free(self, seq_id) -> int:
+        """Return every block the sequence owns to the free list (retire
+        path). Double-free raises — a block on the free list twice would
+        silently hand one sequence's KV to two owners."""
+        table = self._tables.pop(seq_id, None)
+        if table is None:
+            raise ValueError(f"free of unknown sequence {seq_id!r} "
+                             f"(double free?)")
+        self._free.extend(table)
+        return len(table)
+
+    def preempt(self, seq_id) -> int:
+        """Free-with-intent-to-requeue: identical block motion to
+        :meth:`free`, counted separately (``preemptions_total`` feeds
+        ``horovod_serve_llm_preemptions_total``)."""
+        n = self.free(seq_id)
+        self.preemptions_total += 1
+        return n
+
+    def check_invariants(self) -> None:
+        """Every block is EITHER free or in exactly one table (the
+        no-leak / no-double-own invariant the property test asserts after
+        every random operation)."""
+        seen = list(self._free)
+        for t in self._tables.values():
+            seen.extend(t)
+        if len(seen) != self.num_blocks or \
+                set(seen) != set(range(self.num_blocks)):
+            raise AssertionError(
+                f"block accounting broken: {len(seen)} accounted "
+                f"(free={len(self._free)}, "
+                f"tables={ {k: len(v) for k, v in self._tables.items()} }) "
+                f"of {self.num_blocks}")
+
+
+class PagedKVCache:
+    """Block allocator + the K/V block storage + the gather/scatter the
+    paged decode step uses.
+
+    Storage is ``[num_blocks, block_size, dim]`` per tensor; a sequence's
+    contiguous-context view is the concatenation of its table's blocks
+    truncated to its token count — :meth:`gather` materializes exactly
+    that, which is what makes paged decode bitwise identical to decode
+    over a contiguous cache (same values, same order, same reduction)."""
+
+    def __init__(self, num_blocks: int, block_size: int, dim: int,
+                 watermark: float = 0.05, dtype=np.float32) -> None:
+        self.alloc = BlockAllocator(num_blocks, block_size, watermark)
+        self.block_size = block_size
+        self.k = np.zeros((num_blocks, block_size, dim), dtype)
+        self.v = np.zeros((num_blocks, block_size, dim), dtype)
+
+    def write(self, seq_id, pos: int, k_vec: np.ndarray,
+              v_vec: np.ndarray) -> None:
+        """Scatter one token's K/V into the sequence's block for position
+        ``pos`` (the table must already cover it — ensure/extend first)."""
+        table = self.alloc._tables[seq_id]
+        b = table[pos // self.block_size]
+        s = pos % self.block_size
+        self.k[b, s] = k_vec
+        self.v[b, s] = v_vec
+
+    def gather(self, seq_id, length: int) -> tuple:
+        """The first ``length`` context positions as contiguous
+        ``[length, dim]`` K and V arrays, in token order."""
+        table = self.alloc._tables[seq_id]
+        need = blocks_for(length, self.block_size)
+        ks = self.k[table[:need]].reshape(-1, self.k.shape[-1])[:length]
+        vs = self.v[table[:need]].reshape(-1, self.v.shape[-1])[:length]
+        return ks, vs
+
+    def load(self, seq_id, k_arr: np.ndarray, v_arr: np.ndarray) -> bool:
+        """Handoff restore: admission-allocate a table for ``len(k_arr)``
+        tokens and scatter the prefilled K/V into it. False when the
+        allocation would dip under the watermark (caller keeps the
+        sequence queued)."""
+        n = len(k_arr)
+        if self.alloc.alloc(seq_id, n) is None:
+            return False
+        for pos in range(n):
+            self.write(seq_id, pos, k_arr[pos], v_arr[pos])
+        return True

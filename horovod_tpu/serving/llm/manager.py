@@ -1,0 +1,201 @@
+"""Prefill/decode pool manager — PR 10's replica supervision shell with
+role-specific dispatch workers.
+
+One :class:`PoolManager` supervises one POOL (prefill, decode, or the
+colocated ``both``): the spawn/ready-file/ping bring-up, dead-replica
+detection, blacklist and respawn machinery are inherited verbatim from
+:class:`~..manager.ReplicaManager`; what changes per role is the worker
+loop a live replica gets:
+
+- **prefill worker**: pulls queued :class:`~.generator.GenRequest`\\ s,
+  runs the ``prefill`` RPC (TTFT is this round trip — the first
+  generated token rides the response), packs the KV pages and puts the
+  handoff on the router's handoff queue;
+- **decode worker**: feeds handed-off sequences (``submit_seq``, the
+  wire handoff) — or raw prompts (``generate``) when this pool is the
+  colocated fast path — into the replica's iteration scheduler, then
+  ``poll``\\ s: finished sequences resolve their requests, per-sequence
+  progress drives colocated TTFT observation, and scheduler stats feed
+  the router's ``horovod_serve_llm_*`` mirrors and the block-release
+  EWMA behind KV admission.
+
+Death recovery is the serving plane's bar (zero failed client requests
+across a SIGKILL): every sequence a decode replica holds is registered
+here at submit; ``_mark_dead`` — reached from the worker's wire fault OR
+the supervisor's process poll, whichever first, and idempotent — drains
+the registry back to the PREFILL queue front. Re-prefill on survivors
+(or the respawn) regenerates identical KV, so the retried generation is
+token-for-token the one the dead replica was computing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from ..manager import ReplicaManager, _Replica
+from .handoff import pack_kv
+
+_FEED_BATCH = 16          # sequences fed to a decode replica per cycle
+_POLL_IDLE_SLEEP_S = 0.02
+
+
+class PoolManager(ReplicaManager):
+    replica_module = "horovod_tpu.serving.llm.replica"
+
+    def __init__(self, cfg, server, role: str, n_replicas: int,
+                 reg=None) -> None:
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(f"unknown pool role {role!r}")
+        pool_cfg = dataclasses.replace(
+            cfg, min_replicas=n_replicas, max_replicas=n_replicas)
+        super().__init__(pool_cfg, batcher=None, admission=None,
+                         checkpoint=server.checkpoint,
+                         builder=server.builder,
+                         replica_env=server.replica_env, reg=reg)
+        self.server = server
+        self.role = role
+        # rep.rid -> {req.rid -> GenRequest}: sequences a decode replica
+        # currently owns (guarded by the manager lock; the death path
+        # drains it exactly once thanks to _mark_dead's idempotence)
+        self._inflight: dict[int, dict] = {}
+
+    # -- hooks into the base supervision loop --------------------------------
+
+    def _replica_env_extra(self, rid: int) -> dict:
+        env = {"HVD_SERVE_LLM_ROLE": self.role}
+        env.update(self.server.llm.to_env())
+        return env
+
+    def _queue_depth(self) -> int:
+        return self.server.prefill_q.depth() if self.role == "prefill" \
+            else self.server.handoff_q.depth()
+
+    def _mark_dead(self, rep: _Replica, reason: str) -> None:
+        if rep.state == "dead":
+            return
+        super()._mark_dead(rep, reason)
+        with self._lock:
+            lost = list(self._inflight.pop(rep.rid, {}).values())
+        if lost:
+            self.server.retry_or_fail(lost)
+
+    # -- role workers --------------------------------------------------------
+
+    def _worker(self, rep: _Replica) -> None:
+        if self.role == "prefill":
+            self._prefill_worker(rep)
+        else:
+            self._decode_worker(rep)
+        if rep.state == "draining":
+            rep.drained.set()
+
+    def _prefill_worker(self, rep: _Replica) -> None:
+        while not self._closed.is_set() and rep.state == "serving":
+            req = self.server.prefill_q.take(0.25)
+            if req is None:
+                continue
+            if req.expired():
+                if req.fail(504, "deadline exceeded awaiting prefill"):
+                    self.server.count_code(504)
+                continue
+            try:
+                resp = rep.client.request(
+                    {"kind": "prefill", "tokens": req.prompt})
+            except Exception as e:  # noqa: BLE001 - any wire fault = death
+                self.server.retry_or_fail([req])
+                self._mark_dead(rep, f"prefill dispatch failed: {e}")
+                break
+            if not resp.get("ok"):
+                # Deterministic model error: retrying elsewhere would
+                # fail identically. The replica lives.
+                if req.fail(503, f"prefill error: {resp.get('error')}"):
+                    self.server.count_code(503)
+                continue
+            rep.requests_done += 1
+            self.server.on_prefilled(req, pack_kv(
+                req.prompt, resp["k"], resp["v"], resp["next_token"]))
+
+    def _decode_worker(self, rep: _Replica) -> None:
+        last_poll_t = time.monotonic()
+        while not self._closed.is_set() and rep.state == "serving":
+            in_hand = None
+            try:
+                fed = 0
+                while fed < _FEED_BATCH:
+                    item = self.server.take_decode_feed()
+                    if item is None:
+                        break
+                    req, payload = item
+                    in_hand = req
+                    if req.expired():
+                        if req.fail(504,
+                                    "deadline exceeded awaiting decode"):
+                            self.server.count_code(504)
+                        in_hand = None
+                        continue
+                    if payload is None:   # colocated: prompt straight in
+                        resp = rep.client.request(
+                            {"kind": "generate", "rid": req.rid,
+                             "tokens": req.prompt,
+                             "max_new_tokens": req.max_new_tokens,
+                             "front": req.retries > 0})
+                    else:                 # wire handoff from the prefill pool
+                        resp = rep.client.request(
+                            {"kind": "submit_seq", "rid": req.rid,
+                             "payload": payload,
+                             "max_new_tokens": req.max_new_tokens,
+                             "front": req.retries > 0})
+                    if not resp.get("ok"):
+                        if req.fail(503,
+                                    f"submit error: {resp.get('error')}"):
+                            self.server.count_code(503)
+                        in_hand = None
+                        continue
+                    with self._lock:
+                        self._inflight.setdefault(rep.rid, {})[
+                            req.rid] = req
+                    in_hand = None
+                    fed += 1
+                    self.server.count_handoff(req, payload)
+                resp = rep.client.request({"kind": "poll"})
+            except Exception as e:  # noqa: BLE001 - any wire fault = death
+                if in_hand is not None:
+                    self.server.retry_or_fail([in_hand])
+                self._mark_dead(rep, f"decode dispatch failed: {e}")
+                break
+            if not resp.get("ok"):
+                # A handler-level error with a live transport: log and
+                # keep polling (the engine thread may still be healthy).
+                from ...utils.logging import log
+
+                log("warning", f"llm decode replica {rep.rid} poll error: "
+                               f"{resp.get('error')}")
+                time.sleep(_POLL_IDLE_SLEEP_S)
+                continue
+            now = time.monotonic()
+            busy = self._handle_poll(rep, resp, now - last_poll_t)
+            last_poll_t = now
+            if not fed and not busy:
+                time.sleep(_POLL_IDLE_SLEEP_S)
+
+    def _handle_poll(self, rep: _Replica, resp: dict,
+                     dt_s: float) -> bool:
+        with self._lock:
+            mine = self._inflight.get(rep.rid, {})
+            finished = [(rec, mine.pop(rec["rid"], None))
+                        for rec in resp.get("finished", [])]
+            progressing = [mine.get(rid)
+                           for rid, n in resp.get("progress", {}).items()
+                           if n >= 1]
+        for rec, req in finished:
+            self.server.on_finished(req, rec)
+            if req is not None:
+                rep.requests_done += 1
+        for req in progressing:
+            if req is not None:
+                req.mark_first_token()
+        self.server.mirror_stats(rep.rid, resp.get("stats", {}), dt_s)
+        stats = resp.get("stats", {})
+        return bool(finished or resp.get("progress")
+                    or stats.get("waiting"))

@@ -1,0 +1,191 @@
+"""LLM replica worker process — one prefill, decode, or both-role engine
+behind the router.
+
+Spawned by :class:`~.manager.PoolManager` as ``python -m
+horovod_tpu.serving.llm.replica`` with the PR 10 replica envelope
+(HVD_SERVE_REPLICA_ID / _SECRET / _READY_FILE / _CHECKPOINT / _BUILDER)
+plus ``HVD_SERVE_LLM_ROLE`` and the serialized :class:`~..config.
+LLMConfig` env contract. Pure numpy: an LLM replica never imports jax,
+so bring-up is the interpreter start plus weight derivation — seconds,
+not a backend negotiation (which is also what makes the kill-mid-load
+recovery bar in tools/llm_smoke.py cheap to clear).
+
+Service protocol (authenticated ``BasicService``, one router worker
+channel per replica):
+
+- ``prefill``   (roles prefill/both): prompt tokens -> the KV pages and
+  the first generated token — the handoff payload;
+- ``submit_seq`` (roles decode/both): a prefilled sequence (tokens + KV
+  pages) enters the iteration scheduler's waiting queue;
+- ``generate``  (role both): a raw prompt enters the scheduler; prefill
+  happens inside the decode engine — the colocated fast path;
+- ``poll``      (roles decode/both): drain finished sequences, report
+  per-sequence progress (the router's TTFT observation for colocated
+  mode) and scheduler stats (the router's KV/occupancy telemetry);
+- ``ping`` / ``stats``: bring-up and observability.
+
+Chaos rides the elastic fault hooks exactly like PR 10:
+``HOROVOD_FAULT_INJECT_STEP=N`` kills this replica at its N-th
+*model-touching* request (prefill/submit/generate — poll is a clock
+tick, counting it would make N meaningless).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+from ...elastic import fault
+from ...runner.network import BasicService
+from ...utils.logging import log
+from ..config import LLMConfig
+from .generator import DecodeEngine
+from .handoff import unpack_kv
+from .kv_cache import PagedKVCache
+from .scheduler import IterationScheduler
+
+
+class LLMReplicaService(BasicService):
+    def __init__(self, key: bytes, role: str, params: dict, engine,
+                 llm_cfg: LLMConfig, replica_id: int,
+                 host: str = "127.0.0.1") -> None:
+        self.role = role
+        self.params = params
+        self.engine = engine          # None on a pure prefill replica
+        self.llm = llm_cfg
+        self.replica_id = replica_id
+        self._requests = 0
+        self._prefills = 0
+        super().__init__(key, host=host, port=0)
+
+    def handle(self, request, client_addr):
+        kind = request.get("kind")
+        try:
+            if kind == "ping":
+                return {"ok": True, "replica": self.replica_id,
+                        "role": self.role}
+            if kind == "stats":
+                stats = self.engine.stats() if self.engine else {}
+                return {"ok": True, "replica": self.replica_id,
+                        "role": self.role, "prefills": self._prefills,
+                        "stats": stats}
+            if kind == "prefill":
+                return self._prefill(request)
+            if kind == "submit_seq":
+                return self._submit_seq(request)
+            if kind == "generate":
+                return self._generate(request)
+            if kind == "poll":
+                if self.engine is None:
+                    return {"ok": False, "error":
+                            f"poll on a {self.role} replica"}
+                resp = self.engine.poll()
+                resp["ok"] = True
+                return resp
+            return {"ok": False, "error": f"unknown kind {kind!r}"}
+        except Exception:  # noqa: BLE001 - forwarded to the router verbatim
+            return {"ok": False, "error": traceback.format_exc(limit=20)}
+
+    def _chaos_tick(self) -> None:
+        self._requests += 1
+        fault.maybe_die(self._requests)
+
+    def _prefill(self, request):
+        if self.role == "decode":
+            return {"ok": False, "error": "prefill on a decode replica"}
+        self._chaos_tick()
+        from ..model import lm_prefill
+
+        tokens = [int(t) for t in request["tokens"]]
+        k, v, nxt = lm_prefill(self.params, tokens)
+        self._prefills += 1
+        return {"ok": True, "k": k, "v": v, "next_token": nxt,
+                "n_tokens": len(tokens)}
+
+    def _submit_seq(self, request):
+        if self.engine is None:
+            return {"ok": False, "error":
+                    f"submit_seq on a {self.role} replica"}
+        self._chaos_tick()
+        tokens, k, v, first = unpack_kv(request["payload"])
+        self.engine.submit(
+            int(request["rid"]), tokens,
+            int(request["max_new_tokens"]), self.llm.eos_id,
+            first_token=first, handoff=(k, v),
+            front=bool(request.get("front")))
+        return {"ok": True}
+
+    def _generate(self, request):
+        if self.engine is None:
+            return {"ok": False, "error":
+                    f"generate on a {self.role} replica"}
+        self._chaos_tick()
+        self.engine.submit(
+            int(request["rid"]),
+            [int(t) for t in request["tokens"]],
+            int(request["max_new_tokens"]), self.llm.eos_id,
+            front=bool(request.get("front")))
+        return {"ok": True}
+
+
+def _watch_parent(ppid: int) -> None:
+    while True:
+        time.sleep(0.5)
+        if os.getppid() != ppid:
+            log("warning", "llm replica: router process died; exiting")
+            os._exit(0)
+
+
+def main() -> int:
+    replica_id = int(os.environ["HVD_SERVE_REPLICA_ID"])
+    secret = bytes.fromhex(os.environ["HVD_SERVE_SECRET"])
+    ready_file = os.environ["HVD_SERVE_READY_FILE"]
+    role = os.environ.get("HVD_SERVE_LLM_ROLE", "both")
+    ckpt = os.environ.get("HVD_SERVE_CHECKPOINT", "")
+    # mode-local fallback (the pool manager always sets the envelope;
+    # the `or` spelling keeps the authoritative default in
+    # serving/replica.py per the config-registry convention)
+    builder_spec = os.environ.get("HVD_SERVE_BUILDER") \
+        or "horovod_tpu.serving.model:lm_builder"
+    llm_cfg = LLMConfig.from_env()
+
+    from ..model import load_for_serving, resolve_builder
+
+    builder = resolve_builder(builder_spec)
+    state = load_for_serving(ckpt) if ckpt else None
+    params = builder(state)
+
+    engine = None
+    if role in ("decode", "both"):
+        cache = PagedKVCache(llm_cfg.num_blocks, llm_cfg.block_size,
+                             int(params["dim"]),
+                             watermark=llm_cfg.watermark)
+        engine = DecodeEngine(IterationScheduler(
+            cache, params, max_active=llm_cfg.max_active,
+            admission_window=llm_cfg.admission_window)).start()
+    elif role != "prefill":
+        raise ValueError(f"unknown HVD_SERVE_LLM_ROLE {role!r}")
+
+    svc = LLMReplicaService(secret, role, params, engine, llm_cfg,
+                            replica_id)
+    ppid = os.getppid()
+    threading.Thread(target=_watch_parent, args=(ppid,), daemon=True).start()
+
+    tmp = ready_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"port": svc.port, "pid": os.getpid()}, f)
+    os.rename(tmp, ready_file)
+    log("info", f"llm replica {replica_id} ({role}) ready on port "
+        f"{svc.port} (blocks={llm_cfg.num_blocks}x{llm_cfg.block_size}, "
+        f"max_active={llm_cfg.max_active})")
+
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

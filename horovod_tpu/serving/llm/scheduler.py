@@ -1,0 +1,286 @@
+"""Iteration-level scheduler — requests join and leave the batch at
+TOKEN granularity (the actual Orca contribution; Yu et al., OSDI '22).
+
+One :meth:`IterationScheduler.step` is one engine iteration:
+
+1. **admit** — move waiting sequences into the running batch while slots
+   (``max_active``) and KV blocks (above the allocator's watermark) allow.
+   A handed-off sequence loads its prefilled K/V into freshly allocated
+   blocks; a fresh or preempted sequence prefills locally. FAIRNESS: a
+   waiting sequence that has sat out more than ``admission_window``
+   iterations force-admits by preempting the newest running sequence —
+   a long generation can never starve queued prefills indefinitely.
+2. **decode** — one token for EVERY running sequence: gather its context
+   through the block table, run the decode step, scatter the new K/V,
+   append the token. A sequence crossing a block boundary extends its
+   table (allowed to dip into the watermark reserve); if even the reserve
+   is dry, the newest running sequence is preempted-and-requeued — memory
+   pressure degrades to queueing, never to OOM.
+3. **retire** — a sequence that emitted EOS or reached ``max_new_tokens``
+   leaves the batch *this* iteration and frees its blocks immediately (no
+   padded-batch head-of-line blocking: the freed slot and blocks are
+   available to the very next admission).
+
+Determinism: greedy decode over per-sequence state means the running
+batch's composition cannot change any sequence's tokens — every output
+must equal the sequential oracle (``serving/model.py:lm_generate``),
+which is the cross-contamination check the tests and smoke enforce.
+
+Preemption picks the NEWEST running sequence (most recent admission):
+it has the least decode progress to re-prefill, and FCFS age ordering is
+what makes the fairness window meaningful. A preempted sequence keeps
+its generated tokens, drops its blocks, and re-enters the waiting queue
+FRONT; on re-admission it re-prefills ``prompt + out[:-1]`` and
+continues — bitwise identically, because the model is deterministic.
+
+Single-threaded by design (the engine's lock lives in
+``generator.DecodeEngine``); no metrics registry here — counters are
+plain ints in :meth:`stats` and the ROUTER process mirrors them into the
+``horovod_serve_llm_*`` series (same split as PR 10's recompile counter).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ..model import lm_context_step, lm_prefill
+from .kv_cache import PagedKVCache, blocks_for
+
+WAITING = "waiting"
+RUNNING = "running"
+PREEMPTED = "preempted"
+FINISHED = "finished"
+FAILED = "failed"
+
+
+class Sequence:
+    """One generation in flight inside the engine. ``out`` accumulates
+    generated tokens; ``kv_len`` counts context positions with K/V
+    materialized (= ``len(prompt) + len(out) - 1`` while running: the
+    latest generated token is fed NEXT step, its K/V not yet written)."""
+
+    __slots__ = ("seq_id", "prompt", "max_new_tokens", "eos_id", "out",
+                 "state", "waited", "preemptions", "kv_len", "handoff",
+                 "submit_t", "first_token_rel_s", "error", "admit_order")
+
+    def __init__(self, seq_id, prompt, max_new_tokens: int,
+                 eos_id: int = -1, first_token: Optional[int] = None,
+                 handoff: Optional[tuple] = None) -> None:
+        self.seq_id = seq_id
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = int(eos_id)
+        self.out: list[int] = [] if first_token is None else [
+            int(first_token)]
+        self.state = WAITING
+        self.waited = 0          # iterations spent waiting for admission
+        self.preemptions = 0
+        self.kv_len = 0
+        self.handoff = handoff   # (K, V) arrays from a prefill replica
+        self.submit_t = 0.0      # engine-local monotonic, set by the engine
+        self.first_token_rel_s: Optional[float] = None
+        self.error = ""
+        self.admit_order = -1
+
+    @property
+    def tokens(self) -> list:
+        return self.prompt + self.out
+
+    def is_done(self) -> bool:
+        return bool(self.out) and (self.out[-1] == self.eos_id
+                                   or len(self.out) >= self.max_new_tokens)
+
+
+class IterationScheduler:
+    def __init__(self, cache: PagedKVCache, params: dict,
+                 max_active: int = 8, admission_window: int = 64) -> None:
+        if max_active < 1:
+            raise ValueError(f"max_active must be >= 1, got {max_active}")
+        self.cache = cache
+        self.params = params
+        self.max_active = max_active
+        self.admission_window = admission_window
+        self.waiting: deque[Sequence] = deque()
+        self.running: list[Sequence] = []
+        self.finished: list[Sequence] = []
+        self._admit_seq = 0
+        # plain-int telemetry, mirrored by the router (see module doc)
+        self.tokens_prefill_total = 0
+        self.tokens_decode_total = 0
+        self.iterations_total = 0     # iterations that decoded >= 1 token
+        self.occupancy_sum = 0        # sum of decode-batch sizes over those
+        self.finished_total = 0
+        self.blocks_freed_total = 0   # by RETIREMENT (feeds the release
+        #                               EWMA behind KV admission; preempt
+        #                               churn deliberately excluded)
+
+    # -- intake ---------------------------------------------------------------
+
+    def submit(self, seq: Sequence, front: bool = False) -> None:
+        max_ctx = len(self.params["pos"])
+        total = len(seq.prompt) + seq.max_new_tokens
+        usable = self.cache.alloc.num_blocks - self.cache.alloc.reserve
+        if total > max_ctx or blocks_for(
+                total, self.cache.block_size) > usable:
+            # The single-sequence-always-completes guarantee requires the
+            # WORST case (a preempted resume re-prefilling nearly
+            # prompt+max_tokens of context) to fit an admission-time
+            # allocation, and admissions never touch the watermark
+            # reserve — so the bound is against the usable pool.
+            seq.state = FAILED
+            seq.error = (f"prompt+max_tokens={total} exceeds capacity "
+                         f"(max_context={max_ctx}, kv blocks="
+                         f"{self.cache.alloc.num_blocks}x"
+                         f"{self.cache.block_size})")
+            self.finished.append(seq)
+            return
+        (self.waiting.appendleft if front else self.waiting.append)(seq)
+
+    # -- the engine iteration -------------------------------------------------
+
+    def step(self) -> int:
+        """One iteration: admit -> decode one token per running sequence
+        -> retire. Returns the number of tokens decoded (0 = idle)."""
+        self._admit_phase()
+        decoded = self._decode_phase()
+        if decoded:
+            self.iterations_total += 1
+            self.occupancy_sum += decoded
+        for seq in self.waiting:
+            seq.waited += 1
+        return decoded
+
+    def _admit_phase(self) -> None:
+        while self.waiting and len(self.running) < self.max_active:
+            seq = self.waiting[0]
+            if not self._materialize(seq):
+                # Not enough blocks above the watermark. Past the fairness
+                # window, preempt the newest running sequence and retry;
+                # otherwise the head keeps waiting.
+                if seq.waited > self.admission_window and self.running:
+                    self._preempt(self._preempt_victim())
+                    # _preempt requeues the victim at the waiting FRONT,
+                    # ahead of the starved sequence we are clearing room
+                    # for — swap them so the head admits first (otherwise
+                    # the victim re-takes its own blocks and the head
+                    # starves forever).
+                    if self.waiting[0] is not seq:
+                        v = self.waiting.popleft()
+                        self.waiting.insert(1, v)
+                    continue
+                break
+            self.waiting.popleft()
+            seq.state = RUNNING
+            seq.waited = 0
+            seq.admit_order = self._admit_seq
+            self._admit_seq += 1
+            self.running.append(seq)
+            if seq.is_done():   # e.g. max_new_tokens=1: prefill said it all
+                self._retire(seq)
+
+    def _materialize(self, seq: Sequence) -> bool:
+        """Give the sequence KV state: load the handed-off pages, or
+        (re-)prefill locally. False = blocks unavailable, stay queued."""
+        if seq.handoff is not None:
+            k_arr, v_arr = seq.handoff
+            if not self.cache.load(seq.seq_id, np.asarray(k_arr),
+                                   np.asarray(v_arr)):
+                return False
+            seq.handoff = None
+            seq.kv_len = len(k_arr)
+            return True
+        # Local prefill: context is everything but the newest token (the
+        # newest token is fed as the next decode step). For a fresh
+        # sequence that is the prompt; for a preempted resume it is
+        # prompt + out[:-1] — deterministic, so the resume is bitwise
+        # identical to never having been preempted.
+        ctx = seq.tokens[:-1] if seq.out else seq.prompt
+        if self.cache.alloc.alloc(seq.seq_id, len(ctx)) is None:
+            return False
+        k_arr, v_arr, nxt = lm_prefill(self.params, ctx)
+        for pos in range(len(ctx)):
+            self.cache.write(seq.seq_id, pos, k_arr[pos], v_arr[pos])
+        seq.kv_len = len(ctx)
+        self.tokens_prefill_total += len(ctx)
+        if not seq.out:
+            seq.out.append(nxt)
+            if seq.first_token_rel_s is None:
+                seq.first_token_rel_s = time.monotonic() - seq.submit_t
+        return True
+
+    def _decode_phase(self) -> int:
+        decoded = 0
+        for seq in list(self.running):
+            if seq.state is not RUNNING:
+                continue   # preempted mid-iteration by a neighbor's growth
+            pos = seq.kv_len
+            while not self.cache.alloc.extend(seq.seq_id, pos + 1):
+                victim = self._preempt_victim()
+                self._preempt(victim)
+                if victim is seq:
+                    break
+            if seq.state is not RUNNING:
+                continue
+            k_ctx, v_ctx = self.cache.gather(seq.seq_id, pos)
+            nxt, k_vec, v_vec = lm_context_step(
+                self.params, seq.tokens[-1], pos, k_ctx, v_ctx)
+            self.cache.write(seq.seq_id, pos, k_vec, v_vec)
+            seq.kv_len = pos + 1
+            seq.out.append(nxt)
+            decoded += 1
+            self.tokens_decode_total += 1
+            if seq.first_token_rel_s is None:
+                seq.first_token_rel_s = time.monotonic() - seq.submit_t
+            if seq.is_done():
+                self._retire(seq)
+        return decoded
+
+    # -- transitions ----------------------------------------------------------
+
+    def _preempt_victim(self) -> Sequence:
+        """Newest admission loses its blocks first; the growing sequence
+        itself is preempted only when it IS the newest (then its own
+        retry re-prefills later — progress is guaranteed because the
+        submit-time capacity check means a lone sequence always fits)."""
+        return max(self.running, key=lambda s: s.admit_order)
+
+    def _preempt(self, seq: Sequence) -> None:
+        self.cache.alloc.preempt(seq.seq_id)
+        self.running.remove(seq)
+        seq.state = WAITING
+        seq.kv_len = 0
+        seq.waited = 0
+        seq.preemptions += 1
+        self.waiting.appendleft(seq)
+
+    def _retire(self, seq: Sequence) -> None:
+        self.blocks_freed_total += self.cache.alloc.free(seq.seq_id)
+        self.running.remove(seq)
+        seq.state = FINISHED
+        self.finished.append(seq)
+        self.finished_total += 1
+
+    # -- telemetry ------------------------------------------------------------
+
+    def stats(self) -> dict:
+        alloc = self.cache.alloc
+        return {
+            "active": len(self.running),
+            "waiting": len(self.waiting),
+            "blocks_used": alloc.used_count,
+            "blocks_free": alloc.free_count,
+            "waiting_blocks_needed": sum(
+                blocks_for(len(s.tokens) or 1, self.cache.block_size)
+                for s in self.waiting),
+            "preemptions_total": alloc.preemptions_total,
+            "tokens_prefill_total": self.tokens_prefill_total,
+            "tokens_decode_total": self.tokens_decode_total,
+            "iterations_total": self.iterations_total,
+            "occupancy_sum": self.occupancy_sum,
+            "finished_total": self.finished_total,
+            "blocks_freed_total": self.blocks_freed_total,
+        }

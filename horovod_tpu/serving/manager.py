@@ -89,6 +89,10 @@ class _Replica:
 
 
 class ReplicaManager:
+    #: module spawned as ``python -m <replica_module>`` — subclasses
+    #: (the LLM plane's PoolManager) point this at their own worker.
+    replica_module = "horovod_tpu.serving.replica"
+
     def __init__(self, cfg, batcher, admission, checkpoint: str = "",
                  builder: str = "horovod_tpu.serving.model:mlp_builder",
                  replica_env: Optional[dict] = None, reg=None) -> None:
@@ -221,9 +225,10 @@ class ReplicaManager:
             # replica's id plays that role (chaos hooks for free).
             "HOROVOD_TASK_INDEX": str(rid),
         })
+        env.update(self._replica_env_extra(rid))
         log_file = open(log_path, "w")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "horovod_tpu.serving.replica"],
+            [sys.executable, "-m", self.replica_module],
             env=env, stdout=log_file, stderr=subprocess.STDOUT)
         rep = _Replica(rid, proc, ready, log_path, log_file)
         with self._lock:
@@ -262,7 +267,7 @@ class ReplicaManager:
                 with self._lock:
                     self._replicas.pop(rep.rid, None)
         # -- autoscale + repair ---------------------------------------------
-        depth = self.batcher.depth()
+        depth = self._queue_depth()
         if depth > 0:
             self._last_busy_t = now
         decision = autoscale_decision(depth, self._desired, self.cfg, now,
@@ -332,6 +337,17 @@ class ReplicaManager:
         rep.worker.start()
         log("info", f"serving replica {rep.rid} live on port {rep.port} "
                     f"after {now - rep.spawned_t:.1f}s")
+
+    # -- subclass hooks ------------------------------------------------------
+
+    def _replica_env_extra(self, rid: int) -> dict:
+        """Extra env for a spawning replica (role tags, plane-specific
+        config contracts); the base plane needs none."""
+        return {}
+
+    def _queue_depth(self) -> int:
+        """The pending-work figure the autoscaler steers on."""
+        return self.batcher.depth()
 
     # -- dispatch worker (one per live replica) ------------------------------
 

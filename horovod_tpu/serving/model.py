@@ -20,6 +20,8 @@ import importlib
 import re
 from typing import Any, Callable
 
+import numpy as np
+
 
 def load_for_serving(path: str, template: Any = None) -> Any:
     """Restore a serving checkpoint written by
@@ -105,6 +107,129 @@ def shard_batch(x, mesh=None):
     if mesh is None:
         mesh = jax.make_mesh((n_dev,), ("batch",))
     return jax.device_put(x, NamedSharding(mesh, PartitionSpec("batch")))
+
+
+# -- token-level serving: the reference LM + the paged decode step ----------
+#
+# The LLM plane (serving/llm/, ISSUE 12) needs a *deterministic*
+# autoregressive model whose paged-KV decode can be checked bitwise
+# against a contiguous-cache oracle, and whose prefill/decode replicas —
+# separate processes — derive identical weights with no checkpoint
+# shipping. TinyLM is that reference: a single-head attention LM in plain
+# numpy (replica processes never pay a jax/XLA backend start), weights
+# seeded from HOROVOD_SERVE_LLM_SEED, greedy argmax decoding (ties to the
+# lowest index) so every token is a pure function of the prompt. Real
+# deployments point HVD_SERVE_BUILDER at their own params loader; the
+# decode-step contract below is what the scheduler drives either way.
+
+
+def tiny_lm_params(vocab: int = 64, dim: int = 16, max_context: int = 512,
+                   seed: int = 0) -> dict:
+    """Deterministic TinyLM weights: embedding, positional table, one
+    attention head (wq/wk/wv) and the output head (wo). Same (vocab, dim,
+    max_context, seed) -> bitwise-identical weights in every process —
+    the property that makes prefill->decode handoff and kill->re-prefill
+    recovery exact."""
+    rs = np.random.RandomState(seed)
+    s = 1.0 / np.sqrt(dim)
+    return {
+        "vocab": vocab, "dim": dim, "max_context": max_context,
+        "embed": rs.uniform(-s, s, (vocab, dim)).astype(np.float32),
+        "pos": rs.uniform(-s, s, (max_context, dim)).astype(np.float32),
+        "wq": rs.uniform(-s, s, (dim, dim)).astype(np.float32),
+        "wk": rs.uniform(-s, s, (dim, dim)).astype(np.float32),
+        "wv": rs.uniform(-s, s, (dim, dim)).astype(np.float32),
+        "wo": rs.uniform(-s, s, (dim, vocab)).astype(np.float32),
+    }
+
+
+def _lm_softmax(x: np.ndarray) -> np.ndarray:
+    e = np.exp(x - np.max(x))
+    return e / np.sum(e)
+
+
+def lm_context_step(params: dict, token: int, pos: int,
+                    k_ctx: np.ndarray, v_ctx: np.ndarray) -> tuple:
+    """ONE decode step against an explicit gathered context — the
+    decode-step fn the paged scheduler drives with block-table-gathered
+    K/V (kv_cache.PagedKVCache.gather): feed ``token`` at position
+    ``pos`` attending over ``k_ctx``/``v_ctx`` (positions 0..pos-1) plus
+    itself; returns ``(next_token, k_vec, v_vec)`` where k/v are this
+    position's cache entries. Because the gather materializes the same
+    values in the same order a contiguous cache holds, paged and
+    contiguous decode are bitwise identical."""
+    if pos >= len(params["pos"]):
+        raise ValueError(f"position {pos} exceeds max_context "
+                         f"{len(params['pos'])}")
+    h = params["embed"][token] + params["pos"][pos]
+    k = h @ params["wk"]
+    v = h @ params["wv"]
+    q = h @ params["wq"]
+    ks = np.concatenate([k_ctx, k[None]]) if len(k_ctx) else k[None]
+    vs = np.concatenate([v_ctx, v[None]]) if len(v_ctx) else v[None]
+    att = _lm_softmax((ks @ q) / np.sqrt(len(h)).astype(np.float32)) @ vs
+    logits = (h + att) @ params["wo"]
+    return int(np.argmax(logits)), k, v
+
+
+def lm_prefill(params: dict, tokens) -> tuple:
+    """Run the prompt through the model sequentially: returns
+    ``(K, V, next_token)`` with K/V of shape ``[len(tokens), dim]`` —
+    the payload a prefill replica hands off to the decode pool (the last
+    position's logits already name the first generated token, so TTFT is
+    the prefill round trip)."""
+    if not len(tokens):
+        raise ValueError("prefill needs at least one prompt token")
+    dim = params["dim"]
+    n = len(tokens)
+    ks = np.zeros((n, dim), np.float32)
+    vs = np.zeros((n, dim), np.float32)
+    nxt = -1
+    for i, t in enumerate(tokens):
+        nxt, ks[i], vs[i] = lm_context_step(params, int(t), i,
+                                            ks[:i], vs[:i])
+    return ks, vs, nxt
+
+
+def lm_generate(params: dict, prompt, max_new_tokens: int,
+                eos_id: int = -1) -> list:
+    """The sequential oracle: greedy generation over a contiguous cache,
+    no paging, no batching, no scheduler. The serving plane must
+    reproduce this token-for-token for every request — ANY cross-sequence
+    KV contamination, block-table corruption, or preempt/resume drift
+    changes some argmax and diverges from it (the smoke's
+    zero-contamination bar)."""
+    k, v, nxt = lm_prefill(params, prompt)
+    out = [nxt]
+    ks, vs = list(k), list(v)
+    while nxt != eos_id and len(out) < max_new_tokens:
+        pos = len(ks)
+        nxt, kv_k, kv_v = lm_context_step(
+            params, out[-1], pos,
+            np.asarray(ks, np.float32), np.asarray(vs, np.float32))
+        ks.append(kv_k)
+        vs.append(kv_v)
+        out.append(nxt)
+    return out
+
+
+def lm_builder(state: Any) -> dict:
+    """Builder for the LLM serving plane (``HVD_SERVE_BUILDER`` default
+    for llm replicas): returns the TinyLM params dict. A checkpointed
+    state supplies ``state["lm_params"]`` verbatim; with no checkpoint the
+    weights derive from the HOROVOD_SERVE_LLM_{VOCAB,DIM,MAX_CONTEXT,
+    SEED} env contract — which is how prefill and decode pool processes
+    agree bitwise with zero weight shipping."""
+    import os
+
+    if state is not None and "lm_params" in state:
+        return state["lm_params"]
+    return tiny_lm_params(
+        vocab=int(os.environ.get("HOROVOD_SERVE_LLM_VOCAB", "") or 64),
+        dim=int(os.environ.get("HOROVOD_SERVE_LLM_DIM", "") or 16),
+        max_context=int(
+            os.environ.get("HOROVOD_SERVE_LLM_MAX_CONTEXT", "") or 512),
+        seed=int(os.environ.get("HOROVOD_SERVE_LLM_SEED", "") or 0))
 
 
 def mlp_builder(state: Any) -> Callable:

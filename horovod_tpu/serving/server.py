@@ -71,6 +71,11 @@ class InferenceServer:
                     f"{self.cfg.min_replicas}..{self.cfg.max_replicas})")
         return self
 
+    def ready_count(self) -> int:
+        """Replicas currently serving — the /healthz readiness figure
+        (the LLM server overrides this with its per-pool gating)."""
+        return self.manager.serving_count()
+
     def wait_ready(self, timeout: float = 120.0) -> bool:
         """Block until at least one replica serves (jax import + restore
         in the replica bounds this; see replica_start_timeout_s)."""

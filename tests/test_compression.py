@@ -1006,6 +1006,44 @@ def test_residual_not_double_folded_across_demotion(compression):
         "demotion escalated to an elastic reset")
 
 
+def test_compiled_adaptive_fallback_counted(mesh8):
+    """ISSUE 12 satellite: 'adaptive' on the compiled plane substitutes
+    its dense tier table — each substituting trace increments
+    horovod_compiled_adaptive_fallback_total so the fallback is visible
+    in pod snapshots, not just in a warn-once log line."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu import metrics as hvd_metrics
+    from horovod_tpu.compat import shard_map
+    from horovod_tpu.parallel import fusion
+
+    counter = hvd_metrics.registry().counter(
+        "horovod_compiled_adaptive_fallback_total",
+        help="compiled-plane traces where 'adaptive' fell back to "
+             "its dense tier table (ici=none, dcn=bf16) because "
+             "XLA collectives cannot ship runtime-sparse topk frames")
+    before = counter.value
+    tree = {"a": jnp.arange(1024, dtype=jnp.float32) / 7}
+
+    def run(compression):
+        f = lambda t: fusion.fused_allreduce(  # noqa: E731
+            t, "hvd", threshold=1 << 20, compression=compression)
+        return jax.jit(shard_map(f, mesh=mesh8, in_specs=(P(),),
+                                 out_specs=P(), check_vma=False))(tree)
+
+    run("adaptive")
+    assert counter.value == before + 1, \
+        "adaptive substitution did not increment the fallback counter"
+    run("bf16")
+    assert counter.value == before + 1, \
+        "a non-adaptive trace must not touch the fallback counter"
+    run("adaptive")
+    assert counter.value == before + 2, \
+        "the counter fires per substituting trace, not warn-once"
+
+
 def test_autotune_topk_ratio_joins_compression_dimension():
     """tune(compressions=...) accepts 'topk@<ratio>' specs on the
     categorical compression dimension (ISSUE 9): the factory receives the

@@ -1,0 +1,272 @@
+"""Iteration-level scheduler (ISSUE 12): token-granularity join/leave,
+fairness under KV pressure, preempt/resume exactness, and the
+cross-contamination oracle (every scheduled output must equal the
+sequential contiguous-cache generation, whatever the batch did)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from horovod_tpu.serving.llm.kv_cache import PagedKVCache, blocks_for
+from horovod_tpu.serving.llm.scheduler import (
+    FAILED,
+    IterationScheduler,
+    Sequence,
+)
+from horovod_tpu.serving.model import (
+    lm_context_step,
+    lm_generate,
+    lm_prefill,
+    tiny_lm_params,
+)
+
+PARAMS = tiny_lm_params()
+
+
+def _run(sched, max_steps=2000, until=None):
+    for _ in range(max_steps):
+        sched.step()
+        if sched.finished_total >= (until or 0) and until is not None:
+            return
+        if not sched.waiting and not sched.running:
+            return
+    raise AssertionError(f"scheduler did not drain: {sched.stats()}")
+
+
+def _outputs(sched) -> dict:
+    return {s.seq_id: list(s.out) for s in sched.finished}
+
+
+# -- model sanity -------------------------------------------------------------
+
+
+def test_lm_is_deterministic_across_processes_by_construction():
+    p1, p2 = tiny_lm_params(seed=3), tiny_lm_params(seed=3)
+    for k in ("embed", "pos", "wq", "wk", "wv", "wo"):
+        np.testing.assert_array_equal(p1[k], p2[k])
+    assert lm_generate(p1, [5, 6], 8) == lm_generate(p2, [5, 6], 8)
+
+
+def test_lm_prefill_equals_stepwise():
+    k, v, nxt = lm_prefill(PARAMS, [4, 9, 11])
+    ks, vs = np.zeros((0, 16), np.float32), np.zeros((0, 16), np.float32)
+    for i, t in enumerate([4, 9, 11]):
+        n2, kv_k, kv_v = lm_context_step(PARAMS, t, i, ks, vs)
+        ks = np.concatenate([ks, kv_k[None]])
+        vs = np.concatenate([vs, kv_v[None]])
+    np.testing.assert_array_equal(k, ks)
+    np.testing.assert_array_equal(v, vs)
+    assert nxt == n2
+
+
+# -- token-granularity join/leave ---------------------------------------------
+
+
+def test_single_sequence_matches_oracle():
+    cache = PagedKVCache(32, 4, 16)
+    s = IterationScheduler(cache, PARAMS, max_active=4)
+    s.submit(Sequence(0, [3, 17, 5], 16))
+    _run(s, until=1)
+    assert _outputs(s)[0] == lm_generate(PARAMS, [3, 17, 5], 16)
+    assert cache.alloc.used_count == 0      # retired blocks all freed
+
+
+def test_eos_retires_immediately():
+    """A sequence retires the iteration EOS appears — no trailing decode
+    up to max_new_tokens."""
+    oracle = lm_generate(PARAMS, [3, 17, 5], 32)
+    eos = oracle[4]                          # force an early stop
+    cache = PagedKVCache(32, 4, 16)
+    s = IterationScheduler(cache, PARAMS, max_active=2)
+    seq = Sequence(0, [3, 17, 5], 32, eos_id=eos)
+    s.submit(seq)
+    _run(s, until=1)
+    out = _outputs(s)[0]
+    assert out == oracle[:5]                 # cut AT the eos token
+    assert len(out) < 32
+
+
+def test_mid_stream_admission_and_retirement():
+    """Short sequences join a long generation's batch mid-stream, finish
+    first, and leave — the no-head-of-line-blocking core. Mean occupancy
+    must exceed 1 and every output must match its oracle."""
+    cache = PagedKVCache(128, 4, 16)
+    s = IterationScheduler(cache, PARAMS, max_active=8)
+    s.submit(Sequence("long", [1, 2, 3], 40))
+    # run the long one alone for a few iterations, then add late joiners
+    for _ in range(5):
+        s.step()
+    assert [q.seq_id for q in s.running] == ["long"]
+    for i in range(3):
+        s.submit(Sequence(i, [10 + i, 20 + i], 5))
+    _run(s, until=4)
+    outs = _outputs(s)
+    assert outs["long"] == lm_generate(PARAMS, [1, 2, 3], 40)
+    for i in range(3):
+        assert outs[i] == lm_generate(PARAMS, [10 + i, 20 + i], 5)
+    # the short ones joined AND left while the long one kept running
+    finish_order = [q.seq_id for q in s.finished]
+    assert finish_order.index("long") == 3
+    st = s.stats()
+    assert st["occupancy_sum"] / st["iterations_total"] > 1.0
+
+
+def test_batch_outputs_equal_oracle_under_churn():
+    """The contamination oracle at scale: 12 overlapping sequences with
+    mixed lengths through a pool small enough to force block reuse —
+    every token of every output must equal the isolated sequential run."""
+    rng = np.random.RandomState(5)
+    cache = PagedKVCache(48, 4, 16)
+    s = IterationScheduler(cache, PARAMS, max_active=4,
+                           admission_window=16)
+    prompts = {}
+    for i in range(12):
+        pr = [int(t) for t in rng.randint(0, 64, rng.randint(1, 9))]
+        prompts[i] = pr
+        s.submit(Sequence(i, pr, int(rng.randint(2, 14))))
+    _run(s, until=12)
+    outs = _outputs(s)
+    for i, pr in prompts.items():
+        seq = next(q for q in s.finished if q.seq_id == i)
+        assert outs[i] == lm_generate(PARAMS, pr, seq.max_new_tokens), \
+            f"sequence {i} diverged from its oracle (contamination)"
+    cache.alloc.check_invariants()
+    assert cache.alloc.used_count == 0
+
+
+# -- fairness under KV pressure -----------------------------------------------
+
+
+def test_admission_window_bounds_prefill_starvation():
+    """Generations hogging every block cannot starve a queued prefill
+    past the admission window: once the window expires, force-admission
+    preempts the newest running sequence and the starved prefill starts
+    — while every output (including the victim's) stays oracle-exact."""
+    window = 3
+    cache = PagedKVCache(12, 2, 16, watermark=1 / 12)   # reserve = 1
+    s = IterationScheduler(cache, PARAMS, max_active=4,
+                           admission_window=window)
+    hogs = {"hog1": [1] * 6, "hog2": [2] * 6}
+    for sid, pr in hogs.items():
+        s.submit(Sequence(sid, pr, 10))    # each grows toward 8 blocks
+    # run until growth has exhausted admission headroom
+    for _ in range(200):
+        s.step()
+        if not cache.alloc.can_alloc(1):
+            break
+    assert not cache.alloc.can_alloc(1), "pool never saturated"
+    assert len(s.running) >= 1 and s.finished_total == 0
+    s.submit(Sequence("late", [7, 8], 4))
+    late = next(q for q in s.waiting if q.seq_id == "late")
+    waited_iters = 0
+    while late.state == "waiting":
+        s.step()
+        waited_iters += 1
+        assert waited_iters <= 3 * (window + 2), \
+            "late prefill starved past the admission window"
+    assert cache.alloc.preemptions_total >= 1
+    _run(s, until=3)
+    for q in s.finished:
+        pr = dict(hogs, late=[7, 8])[q.seq_id]
+        assert q.out == lm_generate(PARAMS, pr, q.max_new_tokens), \
+            f"{q.seq_id} diverged after preemption churn"
+
+
+def test_preempted_sequence_resumes_bitwise_identically():
+    """The satellite bar: preempt mid-generation, requeue, resume — the
+    final tokens equal the never-preempted run exactly."""
+    prompt, max_new = [3, 17, 5], 12
+    oracle = lm_generate(PARAMS, prompt, max_new)
+
+    cache = PagedKVCache(32, 4, 16)
+    s = IterationScheduler(cache, PARAMS, max_active=2)
+    seq = Sequence(0, prompt, max_new)
+    s.submit(seq)
+    for _ in range(4):                     # some decode progress
+        s.step()
+    assert seq.state == "running" and len(seq.out) >= 2
+    mid = list(seq.out)
+    s._preempt(seq)                        # forced preemption
+    assert seq.state == "waiting" and seq.preemptions == 1
+    assert cache.alloc.used_count == 0
+    _run(s, until=1)
+    assert seq.out == oracle
+    assert seq.out[:len(mid)] == mid       # the prefix was preserved
+
+
+def test_preemption_on_block_exhaustion_never_fails_a_sequence():
+    """Tiny pool, many sequences: exhaustion degrades to preempt+requeue
+    and everything completes exactly (never OOM, never wrong)."""
+    cache = PagedKVCache(8, 2, 16, watermark=0.125)
+    s = IterationScheduler(cache, PARAMS, max_active=3,
+                           admission_window=8)
+    prompts = {i: [int(i) + 1, int(i) + 2] for i in range(6)}
+    for i, pr in prompts.items():
+        s.submit(Sequence(i, pr, 5))
+    _run(s, until=6, max_steps=4000)
+    for i, pr in prompts.items():
+        out = _outputs(s)[i]
+        assert out == lm_generate(PARAMS, pr, 5)
+    cache.alloc.check_invariants()
+
+
+def test_oversized_request_fails_fast_not_deadlocks():
+    cache = PagedKVCache(4, 2, 16, watermark=0.25)   # 3 usable blocks
+    s = IterationScheduler(cache, PARAMS, max_active=2)
+    seq = Sequence(0, [1] * 5, 4)                    # needs 9 > 6 tokens
+    s.submit(seq)
+    assert seq.state == FAILED
+    assert "exceeds capacity" in seq.error
+    assert s.finished and s.finished[0] is seq
+
+
+def test_retired_slot_reuse_does_not_contaminate():
+    """Serial reuse of the same tiny cache across many sequences: block
+    tables from retired sequences are recycled; outputs stay exact."""
+    cache = PagedKVCache(6, 2, 16, watermark=0.0)
+    s = IterationScheduler(cache, PARAMS, max_active=1)
+    for i in range(8):
+        pr = [(3 * i) % 64, (5 * i + 1) % 64]
+        s.submit(Sequence(i, pr, 4))
+    _run(s, until=8)
+    for i in range(8):
+        pr = [(3 * i) % 64, (5 * i + 1) % 64]
+        assert _outputs(s)[i] == lm_generate(PARAMS, pr, 4)
+
+
+def test_handoff_admission_matches_local_prefill():
+    """A sequence entering via KV handoff (prefill-pool path) decodes
+    exactly like one prefilled in-engine (colocated path)."""
+    prompt, max_new = [9, 30, 2], 10
+    k, v, first = lm_prefill(PARAMS, prompt)
+
+    via_handoff = IterationScheduler(PagedKVCache(16, 4, 16), PARAMS)
+    via_handoff.submit(Sequence(0, prompt, max_new, first_token=first,
+                                handoff=(k, v)))
+    _run(via_handoff, until=1)
+
+    local = IterationScheduler(PagedKVCache(16, 4, 16), PARAMS)
+    local.submit(Sequence(0, prompt, max_new))
+    _run(local, until=1)
+
+    oracle = lm_generate(PARAMS, prompt, max_new)
+    assert _outputs(via_handoff)[0] == oracle
+    assert _outputs(local)[0] == oracle
+
+
+def test_stats_shape_and_block_accounting():
+    cache = PagedKVCache(16, 4, 16)
+    s = IterationScheduler(cache, PARAMS, max_active=2)
+    s.submit(Sequence(0, [1, 2], 3))
+    _run(s, until=1)
+    st = s.stats()
+    for key in ("active", "waiting", "blocks_used", "blocks_free",
+                "waiting_blocks_needed", "preemptions_total",
+                "tokens_prefill_total", "tokens_decode_total",
+                "iterations_total", "occupancy_sum", "finished_total",
+                "blocks_freed_total"):
+        assert key in st, key
+    assert st["blocks_free"] == 16 and st["blocks_used"] == 0
+    assert st["blocks_freed_total"] == blocks_for(2 + 3 - 1, 4)
+    assert st["tokens_decode_total"] == 2    # 3 new tokens, 1 via prefill

@@ -1,0 +1,336 @@
+"""LLM serving plane (ISSUE 12): config/admission/handoff units, request
+plumbing, and the disaggregated + colocated end-to-end paths including
+the decode-replica kill recovery bar."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu.serving.admission import KVAdmission
+from horovod_tpu.serving.config import LLMConfig, ServeConfig
+from horovod_tpu.serving.llm import LLMServer
+from horovod_tpu.serving.llm.generator import GenQueue, GenRequest
+from horovod_tpu.serving.llm.handoff import (
+    handoff_nbytes,
+    pack_kv,
+    unpack_kv,
+)
+from horovod_tpu.serving.model import (
+    lm_builder,
+    lm_generate,
+    lm_prefill,
+    tiny_lm_params,
+)
+
+PARAMS = tiny_lm_params()
+
+
+# -- config -------------------------------------------------------------------
+
+
+def test_llm_config_env_overrides_and_roundtrip(monkeypatch):
+    monkeypatch.setenv("HOROVOD_SERVE_LLM_BLOCK_SIZE", "8")
+    monkeypatch.setenv("HOROVOD_SERVE_LLM_NUM_BLOCKS", "99")
+    monkeypatch.setenv("HOROVOD_SERVE_LLM_WATERMARK", "0.2")
+    cfg = LLMConfig.from_env(max_active=3)
+    assert (cfg.block_size, cfg.num_blocks, cfg.max_active) == (8, 99, 3)
+    assert cfg.watermark == 0.2
+    # env round trip: a replica re-reading to_env() gets the same config
+    env = cfg.to_env()
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    assert LLMConfig.from_env() == cfg
+    with pytest.raises(TypeError, match="unknown LLMConfig overrides"):
+        LLMConfig.from_env(nope=1)
+
+
+def test_llm_config_validation():
+    with pytest.raises(ValueError, match="watermark"):
+        LLMConfig.from_env(watermark=1.5)
+    with pytest.raises(ValueError, match="decode_replicas"):
+        LLMConfig.from_env(colocated=0, prefill_replicas=0)
+    assert LLMConfig.from_env(colocated=1, prefill_replicas=0)  # ok
+    with pytest.raises(ValueError, match="SLO"):
+        LLMConfig.from_env(ttft_slo_ms=0)
+
+
+def test_usable_blocks_excludes_watermark_reserve():
+    cfg = LLMConfig.from_env(num_blocks=100, watermark=0.05)
+    assert cfg.usable_blocks() == 95
+    assert LLMConfig.from_env(num_blocks=10,
+                              watermark=0.0).usable_blocks() == 10
+
+
+def test_lm_builder_reads_env_contract(monkeypatch):
+    monkeypatch.setenv("HOROVOD_SERVE_LLM_SEED", "7")
+    monkeypatch.setenv("HOROVOD_SERVE_LLM_DIM", "8")
+    p = lm_builder(None)
+    assert p["dim"] == 8
+    np.testing.assert_array_equal(
+        p["embed"], tiny_lm_params(dim=8, seed=7)["embed"])
+    # checkpointed params win verbatim
+    assert lm_builder({"lm_params": PARAMS}) is PARAMS
+
+
+# -- KV admission -------------------------------------------------------------
+
+
+def _adm(**kw):
+    kw.setdefault("num_blocks", 100)
+    kw.setdefault("watermark", 0.0)
+    return KVAdmission(LLMConfig.from_env(**kw))
+
+
+def test_kv_admission_cold_start_admits_everything():
+    adm = _adm()
+    ok, wait = adm.admit(blocks_needed=1000, free_blocks=0,
+                         queued_blocks=1000)
+    assert ok and wait == 0.0
+
+
+def test_kv_admission_fit_now_admits_without_estimate_pressure():
+    adm = _adm()
+    adm.observe_release(1, 10.0)           # slow: 0.1 blocks/s
+    ok, wait = adm.admit(blocks_needed=5, free_blocks=50, queued_blocks=10)
+    assert ok and wait == 0.0
+
+
+def test_kv_admission_sheds_on_projected_block_wait():
+    adm = _adm(ttft_slo_ms=1000.0)
+    adm.observe_release(10, 1.0)           # 10 blocks/s
+    # deficit = 30 needed + 0 queued - 10 free = 20 -> 2s > 1s budget
+    ok, wait = adm.admit(blocks_needed=30, free_blocks=10, queued_blocks=0)
+    assert not ok and wait == pytest.approx(2.0)
+    # same deficit with a 3s request budget passes
+    ok, _ = adm.admit(30, 10, 0, budget_s=3.0)
+    assert ok
+
+
+def test_kv_admission_respects_watermark_and_queue_demand():
+    adm = _adm(num_blocks=100, watermark=0.1, ttft_slo_ms=100.0)
+    adm.observe_release(1, 1.0)
+    # 20 free but 10 reserved; 8 queued ahead: 5 + 8 > 10 usable -> wait
+    ok, wait = adm.admit(blocks_needed=5, free_blocks=20, queued_blocks=8)
+    assert not ok and wait == pytest.approx(3.0)
+
+
+def test_kv_admission_ewma_tracks_release_rate():
+    adm = _adm()
+    for _ in range(60):
+        adm.observe_release(20, 1.0)
+    assert adm.release_rate() == pytest.approx(20.0, rel=0.05)
+
+
+# -- handoff ------------------------------------------------------------------
+
+
+def test_handoff_pack_unpack_roundtrip_and_bytes():
+    k, v, first = lm_prefill(PARAMS, [3, 17, 5])
+    payload = pack_kv([3, 17, 5], k, v, first)
+    assert handoff_nbytes(payload) == k.nbytes + v.nbytes
+    tokens, k2, v2, first2 = unpack_kv(payload)
+    assert tokens == [3, 17, 5] and first2 == first
+    np.testing.assert_array_equal(k, k2)
+    np.testing.assert_array_equal(v, v2)
+
+
+def test_handoff_rejects_malformed_payloads():
+    k, v, first = lm_prefill(PARAMS, [3, 17])
+    with pytest.raises(ValueError, match="malformed"):
+        pack_kv([3], k, v, first)            # token/page count mismatch
+    bad = pack_kv([3, 17], k, v, first)
+    bad["k"] = bad["k"][:1]
+    with pytest.raises(ValueError, match="malformed"):
+        unpack_kv(bad)
+
+
+# -- request/queue plumbing ---------------------------------------------------
+
+
+def test_gen_request_terminal_state_single_assignment():
+    req = GenRequest([1, 2], 8)
+    assert req.finish([5, 6, 7])
+    assert not req.fail(504, "late timeout")
+    assert req.code == 200 and req.tokens == [5, 6, 7]
+    req2 = GenRequest([1], 4)
+    assert req2.fail(504, "deadline")
+    assert not req2.finish([9])
+    assert req2.code == 504
+
+
+def test_gen_request_ttft_and_tpot_math():
+    req = GenRequest([1], 8)
+    req.mark_first_token(req.enqueue_t + 0.5)
+    req.mark_first_token(req.enqueue_t + 9.0)   # second mark is a no-op
+    assert req.ttft_s == pytest.approx(0.5, abs=0.01)
+    assert req.finish([1, 2, 3])
+    tpot = req.tpot_s()
+    assert tpot is not None and tpot >= 0.0
+    assert GenRequest([1], 4).tpot_s() is None   # unfinished -> None
+
+
+def test_gen_queue_fifo_front_cap_and_close():
+    q = GenQueue(cap=2)
+    assert q.put("a") and q.put("b") and not q.put("c")
+    q.put_front(["x", "y"])                 # order preserved: x, y, a, b
+    assert [q.take(0.01) for _ in range(4)] == ["x", "y", "a", "b"]
+    assert q.take(0.01) is None
+    q2 = GenQueue()
+    q2.put("z")
+    assert q2.close() == ["z"]
+    assert not q2.put("w")                  # closed
+
+
+# -- e2e ----------------------------------------------------------------------
+
+
+def _post(port, payload, timeout=60.0, path="/v1/generate"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_disaggregated_e2e_oracle_kill_and_stats():
+    """1 prefill + 1 decode replica: HTTP generations match the
+    sequential oracle token-for-token, /stats carries a schema-valid
+    snapshot with the llm series, and a SIGKILL'd decode replica
+    recovers by re-prefill + requeue with ZERO failed requests."""
+    cfg = ServeConfig.from_env(port=0, slo_ms=60000.0, max_retries=3)
+    llm_cfg = LLMConfig.from_env(colocated=0, prefill_replicas=1,
+                                 decode_replicas=1)
+    server = LLMServer(config=cfg, llm_config=llm_cfg).start()
+    try:
+        assert server.wait_ready(60), \
+            {r: p.describe() for r, p in server.pools.items()}
+        st, body = _post(server.port, {"prompt": [3, 17, 5],
+                                       "max_tokens": 16})
+        assert st == 200
+        assert body["tokens"] == lm_generate(PARAMS, [3, 17, 5], 16)
+        assert body["ttft_ms"] > 0 and body["n_tokens"] == 16
+
+        # malformed requests answer 400, not 500
+        for bad in ({"prompt": []}, {"prompt": [999]},
+                    {"prompt": [1], "max_tokens": 10 ** 6}, {}):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(server.port, bad)
+            assert ei.value.code == 400
+
+        # kill the decode replica mid-load: every request still answers
+        # 200 with oracle-exact tokens (re-prefill regenerates KV). The
+        # load is time-based so requests are in flight across the kill.
+        failures: list = []
+        oks = []
+        stop_t = time.monotonic() + 3.0
+
+        def load(i):
+            j = 0
+            while time.monotonic() < stop_t:
+                j += 1
+                pr = [(i * 7 + j) % 64, (i * 3 + 1) % 64]
+                try:
+                    stc, b = _post(server.port,
+                                   {"prompt": pr, "max_tokens": 10})
+                    if stc != 200 or b["tokens"] != lm_generate(
+                            PARAMS, pr, 10):
+                        failures.append((stc, pr, b))
+                    else:
+                        oks.append(time.monotonic())
+                except Exception as e:  # noqa: BLE001
+                    failures.append(repr(e))
+
+        threads = [threading.Thread(target=load, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        dec = server.pools["decode"]
+        victim = next(r for r in dec.describe()["replicas"].values()
+                      if r["state"] == "serving")
+        kill_t = time.monotonic()
+        os.kill(victim["pid"], 9)
+        for t in threads:
+            t.join()
+        assert not failures, failures[:5]
+        assert any(t0 > kill_t for t0 in oks), \
+            "no request completed after the kill — chaos leg proved nothing"
+
+        deadline = time.monotonic() + 60
+        while dec.serving_count() < 1 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert dec.serving_count() >= 1, "decode replica never respawned"
+        assert dec.blacklist.blacklisted(), "victim not blacklisted"
+
+        from horovod_tpu.metrics import validate_snapshot
+
+        stats = server.stats()
+        assert validate_snapshot(stats["metrics"]) == []
+        cs = stats["metrics"]["counters"]
+        assert cs.get("horovod_serve_replica_deaths_total", 0) >= 1
+        assert cs.get("horovod_serve_replica_respawns_total", 0) >= 1
+        assert cs.get('horovod_serve_llm_handoffs_total{path="wire"}',
+                      0) >= 10
+        assert cs.get("horovod_serve_llm_handoff_bytes_total", 0) > 0
+        assert cs.get('horovod_serve_llm_tokens_total{phase="decode"}',
+                      0) > 0
+        assert stats["serving"]["llm"]["ttft_p99_ms"] > 0
+    finally:
+        server.stop()
+
+
+def test_colocated_e2e_local_fast_path():
+    """HOROVOD_SERVE_LLM_COLOCATED=1: one both-role replica, prefill
+    inside the decode engine, handoffs counted as path=local with zero
+    wire bytes."""
+    cfg = ServeConfig.from_env(port=0, slo_ms=60000.0)
+    llm_cfg = LLMConfig.from_env(colocated=1, decode_replicas=1)
+    server = LLMServer(config=cfg, llm_config=llm_cfg).start()
+    # the registry is process-global: assert DELTAS, not absolutes
+    before = dict(server.reg.snapshot()["counters"])
+    try:
+        assert server.wait_ready(60)
+        st, body = _post(server.port, {"prompt": [9, 2], "max_tokens": 12})
+        assert st == 200
+        assert body["tokens"] == lm_generate(PARAMS, [9, 2], 12)
+        cs = server.stats()["metrics"]["counters"]
+
+        def delta(series):
+            return cs.get(series, 0) - before.get(series, 0)
+
+        assert delta('horovod_serve_llm_handoffs_total{path="local"}') >= 1
+        assert delta('horovod_serve_llm_handoffs_total{path="wire"}') == 0
+        assert delta("horovod_serve_llm_handoff_bytes_total") == 0
+    finally:
+        server.stop()
+
+
+def test_generate_route_absent_on_stateless_server(tmp_path):
+    """POST /v1/generate against the PR 10 stateless plane answers 404
+    naming the LLM server (route delegation, not a crash)."""
+    from horovod_tpu.serving.frontend import ServeFrontend
+
+    class _Stub:
+        cfg = ServeConfig.from_env(port=0)
+
+        def ready_count(self):
+            return 0
+
+    stub = _Stub()
+    fe = ServeFrontend(stub)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(fe.port, {"prompt": [1]})
+        assert ei.value.code == 404
+        assert b"LLM" in ei.value.read()
+    finally:
+        fe.stop()

@@ -40,6 +40,7 @@ TARGET_MODULES = (
     os.path.join("horovod_tpu", "common", "engine.py"),
     os.path.join("horovod_tpu", "metrics", "registry.py"),
     os.path.join("horovod_tpu", "serving", "batcher.py"),
+    os.path.join("horovod_tpu", "serving", "llm", "generator.py"),
 )
 
 #: methods that run before any thread exists (construction / rebuild) —

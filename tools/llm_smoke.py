@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""CI smoke for the token-level LLM serving plane (ISSUE 12; ci.sh).
+
+Stands up the disaggregated 1-prefill + 1-decode topology and verifies
+the generation contract end to end:
+
+1.  oracle: a handful of /v1/generate calls return EXACTLY the tokens of
+    the sequential contiguous-cache generation (serving/model.py
+    lm_generate) — the zero-cross-request-contamination bar; any paged
+    block-table leak, handoff corruption, or scheduler mixup diverges
+    some argmax.
+2.  token-level batching: under mixed-length concurrent load, measured
+    mean decode-batch occupancy exceeds 1 (sequences join and leave the
+    decode iteration mid-stream — the Orca property, observed, not
+    assumed), every request answers 200 oracle-exact, and client-
+    measured TTFT p99 stays under the smoke SLO.
+3.  chaos: SIGKILL the decode replica mid-load — its in-flight
+    sequences requeue through re-prefill (retries counter says so), the
+    pool respawns, the dead id is blacklisted, and ZERO client requests
+    fail or diverge from their oracles.
+
+Prints one perf-gate JSON line (``llm_smoke_decode_tokens_per_s``) that
+ci.sh floors with ``tools/perf_gate.py --min-abs``. Exits non-zero with
+a reason on any violation. Replicas are numpy-only (no jax backend
+start): wall-clock budget ~25 s.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SMOKE_TTFT_SLO_MS = 1500.0   # generous: 1-core oversubscribed CI boxes
+MAX_NEW = 16
+
+
+def fail(msg: str) -> None:
+    print(f"llm smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def post(port: int, payload: dict, timeout: float = 60.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class LoadStats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.codes: dict[int, int] = {}
+        self.ttft_ms: list[float] = []
+        self.decode_tokens = 0
+        self.contaminated: list = []
+        self.errors: list[str] = []
+        self.ok_times: list[float] = []
+
+    def p(self, vals, pct):
+        with self.lock:
+            if not vals:
+                return 0.0
+            s = sorted(vals)
+            return s[min(int(len(s) * pct / 100), len(s) - 1)]
+
+
+def drive(port: int, stats: LoadStats, oracles: dict, clients: int,
+          seconds: float, vocab: int) -> float:
+    from horovod_tpu.serving.model import lm_generate, tiny_lm_params
+
+    params = tiny_lm_params()
+    stop_t = time.monotonic() + seconds
+
+    def loop(ci: int):
+        j = 0
+        while time.monotonic() < stop_t:
+            j += 1
+            n = 1 + (ci * 3 + j) % 10          # mixed prompt lengths 1..10
+            prompt = tuple((ci * 13 + j + k) % vocab for k in range(n))
+            if prompt not in oracles:
+                oracles[prompt] = lm_generate(params, list(prompt),
+                                              MAX_NEW)
+            try:
+                code, body = post(port, {"prompt": list(prompt),
+                                         "max_tokens": MAX_NEW})
+                with stats.lock:
+                    stats.codes[code] = stats.codes.get(code, 0) + 1
+                    if code == 200:
+                        stats.ok_times.append(time.monotonic())
+                        stats.ttft_ms.append(body["ttft_ms"])
+                        stats.decode_tokens += max(
+                            body["n_tokens"] - 1, 0)
+                        if body["tokens"] != oracles[prompt]:
+                            stats.contaminated.append(
+                                (prompt, body["tokens"]))
+            except urllib.error.HTTPError as e:
+                with stats.lock:
+                    stats.codes[e.code] = stats.codes.get(e.code, 0) + 1
+                    if len(stats.errors) < 5:
+                        stats.errors.append(
+                            f"HTTP {e.code}: {e.read()[:200]!r}")
+            except OSError as e:
+                with stats.lock:
+                    stats.codes[-1] = stats.codes.get(-1, 0) + 1
+                    if len(stats.errors) < 5:
+                        stats.errors.append(repr(e))
+
+    threads = [threading.Thread(target=loop, args=(i,))
+               for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.monotonic() - t0
+
+
+def main() -> int:
+    from horovod_tpu.serving.config import LLMConfig, ServeConfig
+    from horovod_tpu.serving.llm import LLMServer
+    from horovod_tpu.serving.model import lm_generate, tiny_lm_params
+
+    params = tiny_lm_params()
+    cfg = ServeConfig.from_env(port=0, slo_ms=60000.0, max_retries=4)
+    llm_cfg = LLMConfig.from_env(colocated=0, prefill_replicas=1,
+                                 decode_replicas=1)
+    server = LLMServer(config=cfg, llm_config=llm_cfg).start()
+    try:
+        if not server.wait_ready(60):
+            fail("pools never became ready: "
+                 + str({r: p.describe()
+                        for r, p in server.pools.items()}))
+
+        # -- 1. oracle exactness on the quiet plane ----------------------
+        for prompt in ([3, 17, 5], [42], [7, 7, 7, 7, 7, 7, 7, 7]):
+            code, body = post(server.port,
+                              {"prompt": prompt, "max_tokens": MAX_NEW})
+            if code != 200:
+                fail(f"warmup generate answered {code}: {body}")
+            expect = lm_generate(params, prompt, MAX_NEW)
+            if body["tokens"] != expect:
+                fail(f"contamination at rest: prompt {prompt} -> "
+                     f"{body['tokens']} != oracle {expect}")
+        print("llm smoke: oracle exactness OK")
+
+        # -- 2. token-level batching under load --------------------------
+        oracles: dict = {}
+        nominal = LoadStats()
+        wall = drive(server.port, nominal, oracles, clients=6,
+                     seconds=4.0, vocab=llm_cfg.vocab)
+        n200 = nominal.codes.get(200, 0)
+        if not n200:
+            fail(f"nominal load produced no 200s: {nominal.codes} "
+                 f"{nominal.errors}")
+        bad = {c: n for c, n in nominal.codes.items() if c != 200}
+        if bad:
+            fail(f"nominal load had non-200 responses {bad}; first "
+                 f"errors: {nominal.errors}")
+        if nominal.contaminated:
+            fail(f"cross-request contamination under load: "
+                 f"{nominal.contaminated[:3]}")
+        ttft_p99 = nominal.p(nominal.ttft_ms, 99)
+        if ttft_p99 >= SMOKE_TTFT_SLO_MS:
+            fail(f"TTFT p99 {ttft_p99:.1f}ms >= smoke SLO "
+                 f"{SMOKE_TTFT_SLO_MS}ms")
+        stats = server.stats()["serving"]
+        occupancy = stats["llm"]["mean_batch_occupancy"]
+        if occupancy <= 1.0:
+            fail(f"decode batch never coalesced: mean occupancy "
+                 f"{occupancy} (token-level join/leave not happening)")
+        from horovod_tpu.metrics import validate_snapshot
+
+        errs = validate_snapshot(server.stats()["metrics"])
+        if errs:
+            fail(f"/stats snapshot schema violations: {errs[:5]}")
+        tok_per_s = nominal.decode_tokens / wall
+        print(f"llm smoke: load OK — {n200} x 200, decode "
+              f"{tok_per_s:.0f} tok/s, mean occupancy {occupancy:.2f}, "
+              f"TTFT p50 {nominal.p(nominal.ttft_ms, 50):.1f}ms "
+              f"p99 {ttft_p99:.1f}ms, 0 contaminated")
+
+        # -- 3. decode-replica SIGKILL mid-load --------------------------
+        chaos = LoadStats()
+        dec = server.pools["decode"]
+        victim = next(r for r in dec.describe()["replicas"].values()
+                      if r["state"] == "serving")
+        kill_state = {}
+
+        def killer():
+            time.sleep(0.8)
+            os.kill(victim["pid"], 9)
+            kill_state["t"] = time.monotonic()
+
+        threading.Thread(target=killer).start()
+        drive(server.port, chaos, oracles, clients=6, seconds=6.0,
+              vocab=llm_cfg.vocab)
+        if "t" not in kill_state:
+            fail("killer thread never fired")
+        bad = {c: n for c, n in chaos.codes.items() if c != 200}
+        if bad:
+            fail(f"decode kill lost client requests: {bad}; first "
+                 f"errors: {chaos.errors}")
+        if chaos.contaminated:
+            fail(f"contamination across the kill: "
+                 f"{chaos.contaminated[:3]}")
+        if not any(t > kill_state["t"] for t in chaos.ok_times):
+            fail("no request completed after the kill")
+        deadline = time.monotonic() + 60
+        while dec.serving_count() < 1 and time.monotonic() < deadline:
+            time.sleep(0.2)
+        if dec.serving_count() < 1:
+            fail("decode pool never respawned after the kill")
+        final = server.stats()
+        cs = final["metrics"]["counters"]
+        if cs.get("horovod_serve_replica_deaths_total", 0) < 1:
+            fail("replica death not counted")
+        if cs.get("horovod_serve_retries_total", 0) < 1:
+            fail("killed replica's sequences were never requeued "
+                 "(horovod_serve_retries_total is 0 — the kill landed "
+                 "on an idle replica?)")
+        if not dec.blacklist.blacklisted():
+            fail("killed decode replica id was not blacklisted")
+        n_chaos = chaos.codes.get(200, 0)
+        print(f"llm smoke: chaos OK — killed decode pid "
+              f"{victim['pid']} mid-load, {n_chaos} x 200 / 0 failures, "
+              f"requeues {cs.get('horovod_serve_retries_total', 0):.0f}, "
+              f"respawned, blacklist {dec.blacklist.blacklisted()}")
+
+        print(json.dumps({
+            "metric": "llm_smoke_decode_tokens_per_s",
+            "value": round(tok_per_s, 2), "unit": "tok/s",
+            "clients": 6, "prefill_replicas": 1, "decode_replicas": 1,
+            "requests_ok": n200,
+            "mean_batch_occupancy": occupancy,
+            "ttft_p50_ms": round(nominal.p(nominal.ttft_ms, 50), 2),
+            "ttft_p99_ms": round(ttft_p99, 2),
+            "chaos_requests_ok": n_chaos,
+            "handoff_bytes": cs.get(
+                "horovod_serve_llm_handoff_bytes_total", 0),
+            "preemptions": cs.get(
+                "horovod_serve_llm_preemptions_total", 0),
+        }), flush=True)
+    finally:
+        server.stop()
+    print("llm smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
