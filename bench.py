@@ -552,8 +552,10 @@ def _emit_metrics_snapshot(run, sync, steps_per_s=None) -> None:
 
 def eager_worker_main() -> None:
     """One rank of the eager micro-bench (spawned by ``--eager``): pure
-    Python-engine collectives — deliberately NO jax import, so the measured
-    path is the engine, not backend startup. Prints one JSON line."""
+    eager-engine collectives — deliberately NO jax import, so the measured
+    path is the engine, not backend startup. ``HOROVOD_ENGINE`` picks the
+    implementation (the --eager native A/B leg spawns ``native!`` worlds;
+    default stays the Python reference plane). Prints one JSON line."""
     import hashlib
 
     import numpy as np
@@ -576,10 +578,15 @@ def eager_worker_main() -> None:
     topo = (Topology(rank, world, rank % lsz, lsz, rank // lsz, world // lsz)
             if lsz > 1 else Topology(rank, world, 0, 1, rank, world))
     from horovod_tpu.common.config import _env_bool
-    eng = PyEngine(topo,
-                   Config(cycle_time_ms=1.0, stall_check_disable=True,
-                          hierarchical_allreduce=_env_bool(
-                              "HOROVOD_HIERARCHICAL_ALLREDUCE")))
+    cfg = Config(cycle_time_ms=1.0, stall_check_disable=True,
+                 hierarchical_allreduce=_env_bool(
+                     "HOROVOD_HIERARCHICAL_ALLREDUCE"))
+    if os.environ.get("HOROVOD_ENGINE", "python").startswith("native"):
+        from horovod_tpu.cc.native_engine import NativeEngine
+
+        eng = NativeEngine(topo, cfg)
+    else:
+        eng = PyEngine(topo, cfg)
     try:
         # HVD_EAGER_DTYPE: float64 (default, the historical --eager payload)
         # or float32 (--compression-ab: gradients are f32, and the wire
@@ -637,10 +644,16 @@ def eager_worker_main() -> None:
             "payload_hash": digest.hexdigest(),
             "payload_max_rel_err": max_rel_err,
             "compression": stats.get("compression", "none"),
+            # Both engines feed the same series pair, labeled by plane
+            # ("eager" = python engine inline, "native" = the ctypes
+            # delta-collector) — sum them so either engine reports here.
             "wire_bytes": snap1.get(
-                'horovod_wire_bytes_total{plane="eager"}', 0),
+                'horovod_wire_bytes_total{plane="eager"}', 0) + snap1.get(
+                'horovod_wire_bytes_total{plane="native"}', 0),
             "wire_bytes_saved": snap1.get(
-                'horovod_wire_bytes_saved_total{plane="eager"}', 0),
+                'horovod_wire_bytes_saved_total{plane="eager"}', 0)
+            + snap1.get(
+                'horovod_wire_bytes_saved_total{plane="native"}', 0),
             "cold_neg_ops_s": round(neg_ops / cold_s, 1),
             "cached_neg_ops_s": round(neg_ops / cached_s, 1),
             "cold_hash": cold_hash.hexdigest(),
@@ -727,13 +740,20 @@ def eager_main() -> None:
         os.environ.setdefault("HVD_EAGER_MB", "1")
         os.environ.setdefault("HVD_EAGER_ITERS", "3")
         os.environ.setdefault("HVD_EAGER_NEG_OPS", "32")
-    stage_s = min(max(budget.remaining() / 2 - 10, 30), 240)
+    stage_s = min(max(budget.remaining() / 3 - 10, 30), 240)
     budget.stage("ring-world")
     ring = _spawn_eager_world(
         world, {"HOROVOD_RING_DATA_PLANE": "1"}, stage_s)
     budget.stage("star-world")
     star = _spawn_eager_world(
         world, {"HOROVOD_RING_DATA_PLANE": "0"}, stage_s)
+    # Native-vs-python A/B (ISSUE 13): the same payloads through the native
+    # core's zero-copy byte path (HOROVOD_NATIVE_DATA_PLANE). Emits its own
+    # gated record below — perf_gate --min-abs eager_native_speedup floors
+    # it in CI. native! raises instead of silently falling back, so a
+    # broken native build yields a partial record, never a fake 1.0x.
+    budget.stage("native-world")
+    native = _spawn_eager_world(world, {"HOROVOD_ENGINE": "native!"}, stage_s)
     out = {"metric": "eager_allreduce_ring_speedup", "value": 0.0,
            "unit": "x", "world": world,
            "payload_mb_per_rank": float(os.environ.get("HVD_EAGER_MB", "32")),
@@ -742,8 +762,34 @@ def eager_main() -> None:
         out.update({"partial": True,
                     "reason": "a bench world failed or timed out",
                     "ring_ok": ring is not None, "star_ok": star is not None})
+        print(json.dumps({
+            "metric": "eager_native_speedup", "value": 0.0, "unit": "x",
+            "partial": True,
+            "reason": "a bench world failed or timed out"}), flush=True)
         budget.emit(out)
         return
+    # Gated record: native-plane rank MB/s vs the python ring plane on the
+    # identical payloads (bitwise-identical results — the canonical-order
+    # contract — checked right here).
+    if native is None:
+        print(json.dumps({
+            "metric": "eager_native_speedup", "value": 0.0, "unit": "x",
+            "partial": True, "smoke": _smoke_on(),
+            "reason": "the native-engine world failed or timed out"}),
+            flush=True)
+    else:
+        native_mbs = min(r["payload_mb_s"] for r in native)
+        ring_only_mbs = min(r["payload_mb_s"] for r in ring)
+        print(json.dumps({
+            "metric": "eager_native_speedup",
+            "value": round(native_mbs / ring_only_mbs, 3),
+            "unit": "x", "smoke": _smoke_on(), "world": world,
+            "native_payload_mb_s": round(native_mbs, 2),
+            "python_ring_payload_mb_s": round(ring_only_mbs, 2),
+            "bitwise_identical_native_vs_python":
+                {r["payload_hash"] for r in native}
+                == {r["payload_hash"] for r in ring},
+        }), flush=True)
     r0, s0 = ring[0], star[0]
     ring_mbs = min(r["payload_mb_s"] for r in ring)
     star_mbs = min(r["payload_mb_s"] for r in star)

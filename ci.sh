@@ -57,8 +57,12 @@ python tools/perf_gate.py --current /tmp/hvd_bench_smoke.log --self-check
 echo "== trace smoke (2-proc with injected straggler: merged clock-aligned Perfetto trace, one trace ID across ranks, critical-path analyzer names rank+phase with >=80% attribution; perf-gate pass/fail fixtures) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/trace_smoke.py
 
-echo "== eager smoke (4-proc Python engine: steady-state cache hit rate >= 95%, ring data plane carrying the bytes, star==ring bitwise; bf16 wire >= 2x fewer bytes within tolerance) =="
-timeout -k 10 240 python tools/eager_smoke.py
+echo "== eager smoke (4-proc: steady-state cache hit rate >= 95%, ring data plane carrying the bytes, star==ring bitwise; bf16 wire >= 2x fewer bytes within tolerance; ISSUE 13 native-plane leg: native==python bitwise incl. sparse topk with method-labeled byte savings, native >= 1.3x python-plane MB/s gated below) =="
+timeout -k 10 360 python tools/eager_smoke.py | tee /tmp/hvd_eager_smoke.log
+python tools/perf_gate.py --current /tmp/hvd_eager_smoke.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric eager_native_speedup \
+  --min-abs eager_native_speedup=1.3 --allow-missing-baseline
 
 echo "== hier smoke (simulated 2-host x 2-rank grid: two-level plane active, worst-rank cross-host bytes <= 0.35x flat, flat==hier==star bitwise incl. bf16, cache hit rate unchanged) =="
 timeout -k 10 240 python tools/hier_smoke.py

@@ -20,7 +20,6 @@ from . import lib_path
 # Shared exception types: user except clauses must match regardless of which
 # engine implementation is active.
 from ..common.engine import HorovodInternalError, TensorShapeMismatchError  # noqa: F401
-from ..utils.logging import log
 
 # Order in sync with hvd_common.h.
 OPS = {"allreduce": 0, "allgather": 1, "broadcast": 2, "reducescatter": 3, "alltoall": 4}
@@ -40,6 +39,7 @@ NATIVE_METRICS = (
     "collective_errors", "negotiation_us", "execution_us",
     "stall_warnings", "cycles", "timeline_dropped",
     "cache_hits", "cache_misses", "wire_bytes", "wire_bytes_saved",
+    "topk_wire_bytes", "topk_wire_bytes_saved",
 )
 
 
@@ -152,24 +152,25 @@ class NativeEngine:
         # cache_capacity_from_env reads getenv at coordinator construction).
         os.environ["HOROVOD_CACHE_CAPACITY"] = str(
             max(0, int(getattr(config, "cache_capacity", 1024))))
-        # And the wire-compression dtype (engine.h wire_dtype_from_env,
-        # read at Engine construction): export the Config value so
-        # Config(compression=...) behaves like every other field.
+        # And the wire-compression knobs (engine.h wire_dtype_from_env /
+        # sparse_spec_from_env, read at Engine construction): export the
+        # Config values so Config(compression=...) behaves like every
+        # other field. Since ISSUE 13 the native core implements the FULL
+        # format surface — bf16/fp16 casts, topk select/pack/index-merge
+        # with error-feedback residuals, and the adaptive per-tensor table
+        # — so there is no dense fallback to warn about anymore.
         _comp = str(getattr(config, "compression", "none") or "none")
         os.environ["HOROVOD_COMPRESSION"] = _comp
-        from ..compression import normalize as _comp_normalize
-
-        if _comp_normalize(_comp) in ("topk", "adaptive"):
-            # The sparse wire and the adaptive policy live in the Python
-            # engine (common/engine.py + common/policy.py); the C++ parser
-            # maps unknown names to dense. Keep that no-op LOUD (the repo
-            # rule since VERDICT r3) instead of silently shipping full
-            # width.
-            log("warning",
-                f"HOROVOD_COMPRESSION={_comp} is implemented by the Python "
-                "engine only; the native engine ships dense payloads (set "
-                "HOROVOD_ENGINE=python for sparse/adaptive compression, or "
-                "use bf16/fp16 here)", rank=topo.rank)
+        _ratio = float(getattr(config, "topk_ratio", 0.0) or 0.0)
+        if _ratio > 0:
+            os.environ["HOROVOD_TOPK_RATIO"] = repr(_ratio)
+        os.environ["HOROVOD_COMPRESSION_MIN_BYTES"] = str(
+            int(getattr(config, "compression_min_bytes", 4096) or 4096))
+        if getattr(config, "compression_error_feedback", False):
+            # Only an explicit True is exported: an UNSET env means
+            # "EF defaults on for topk, off for the casts" on both sides
+            # of the bridge, and writing "0" here would clobber that.
+            os.environ["HOROVOD_COMPRESSION_ERROR_FEEDBACK"] = "1"
         # Distributed tracing (ISSUE 6): same env crossing as the knobs
         # above (the C++ engine reads HOROVOD_TRACE_DIR at construction).
         trace_dir = getattr(config, "trace_dir", "") or ""
@@ -208,6 +209,16 @@ class NativeEngine:
         self._cache_last = {"cache_hits": 0, "cache_misses": 0}
         self._wire_last = {"wire_bytes": 0, "wire_bytes_saved": 0}
         self._tier_last = {"total": 0, "cross": 0}
+        # Method-labeled savings (ISSUE 13): the native counters split the
+        # sparse (topk) subset out of the wire totals, so the collector can
+        # feed the SAME horovod_wire_bytes_saved_total{method=...} series
+        # the Python engine labels per format.
+        self._method_last: dict[str, int] = {}
+        from ..compression import normalize as _comp_normalize
+
+        self._cast_method = {"bf16": "bf16", "fp16": "fp16",
+                             "adaptive": "bf16"}.get(
+            _comp_normalize(getattr(config, "compression", "none")))
         # handle -> (op, nbytes, enqueue time): feeds the SAME per-op
         # count/bytes/latency series the Python engine emits
         # (horovod_collective_*), so dashboards read one surface no matter
@@ -247,7 +258,12 @@ class NativeEngine:
         self._registry.counter(
             "horovod_collectives_enqueued_total",
             help="collectives submitted to the eager engine", op=op).inc()
-        self._pending[int(h)] = (op, int(arr.nbytes), time.monotonic())
+        # `arr` rides along to PIN the buffer: the zero-copy hot path
+        # (ISSUE 13) borrows uncompressed allreduce contributions instead
+        # of copying them into the tensor table, so the bytes must stay
+        # alive — and unmutated, the standing collective contract — until
+        # the handle completes (_observe_done drops the reference).
+        self._pending[int(h)] = (op, int(arr.nbytes), time.monotonic(), arr)
         return int(h)
 
     def poll(self, handle: int) -> bool:
@@ -291,7 +307,7 @@ class NativeEngine:
         rec = self._pending.pop(handle, None)
         if rec is None:
             return
-        op, nbytes, t0 = rec
+        op, nbytes, t0, _pin = rec  # _pin: the borrowed buffer, now free
         if not ok:
             self._registry.counter(
                 "horovod_collective_errors_total",
@@ -351,13 +367,19 @@ class NativeEngine:
     def cache_stats(self) -> dict:
         """Response-cache counters, same shape as PyEngine.cache_stats
         (the native data plane is always the peer ring)."""
+        from ..compression import normalize as _comp_normalize
+
         hits = int(self._lib.hvd_metric(b"cache_hits"))
         misses = int(self._lib.hvd_metric(b"cache_misses"))
+        comp = _comp_normalize(getattr(self.config, "compression", "none"))
+        if comp not in ("topk", "adaptive") and self.wire_dtype() is None:
+            comp = "none"  # unknown names degraded to dense at the parser
         return {
             "enabled": int(getattr(self.config, "cache_capacity", 1024)) > 0,
             "ring_active": self.topo.size > 1,
-            "compression": ("none" if self.wire_dtype() is None
-                            else getattr(self.config, "compression", "none")),
+            "compression": comp,
+            "plane": ("hier" if int(self._lib.hvd_hier_allreduce_on())
+                      else "ring") if self.topo.size > 1 else "star",
             "mirror": {"size": int(self._lib.hvd_cache_size()),
                        "hits": max(hits, 0), "misses": max(misses, 0)},
         }
@@ -436,6 +458,26 @@ class NativeEngine:
                     reg.counter(series, help=hlp,
                                 plane="native").inc(v - last)
                 self._wire_last[native] = max(v, last)
+        # Per-method savings: the topk subset feeds method="topk"; the
+        # remainder (16-bit casts) feeds the configured cast format, so
+        # dashboards attribute the win per method whichever engine ran.
+        topk_saved = vals.get("topk_wire_bytes_saved", -1)
+        total_saved = vals.get("wire_bytes_saved", -1)
+        for method, v in (
+                ("topk", topk_saved),
+                (self._cast_method,
+                 total_saved - max(topk_saved, 0)
+                 if total_saved >= 0 else -1)):
+            if method is None or v < 0:
+                continue
+            last = self._method_last.get(method, 0)
+            if v > last:
+                reg.counter(
+                    "horovod_wire_bytes_saved_total",
+                    help="bytes avoided per compression method "
+                         "(bf16/fp16 casts vs topk sparse frames)",
+                    method=method).inc(v - last)
+            self._method_last[method] = max(v, last)
         # Per-fabric-tier wire bytes (ISSUE 7): the native ring stats split
         # total vs cross-host bytes; the deltas feed the SAME
         # horovod_wire_bytes_total{tier=...} series the Python engine's

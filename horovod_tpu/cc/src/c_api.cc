@@ -257,6 +257,9 @@ long long hvd_metric(const char* name) {
   if (k == "cache_misses") return (long long)m.cache_misses.load();
   if (k == "wire_bytes") return (long long)m.wire_bytes.load();
   if (k == "wire_bytes_saved") return (long long)m.wire_bytes_saved.load();
+  if (k == "topk_wire_bytes") return (long long)m.topk_wire_bytes.load();
+  if (k == "topk_wire_bytes_saved")
+    return (long long)m.topk_wire_bytes_saved.load();
   return -1;
 }
 

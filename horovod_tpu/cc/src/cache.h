@@ -52,12 +52,15 @@ inline size_t cache_capacity_from_env() {
 // format, orig_dtype = caller dtype) and its uncompressed twin are
 // DIFFERENT signatures, so a wire-dtype change misses, falls back to the
 // full-request path, and invalidates the stale bit like a shape change.
+// wire_fmt is included the same way (ISSUE 13): a topk allreduce and its
+// dense twin are different signatures — a policy flip invalidates bits.
 inline std::string cache_key(const Request& q) {
   std::string k = q.name;
   k.push_back('\0');
   k.push_back((char)q.op);
   k.push_back((char)q.dtype);
   k.push_back((char)q.orig_dtype);
+  k.push_back((char)q.wire_fmt);
   k.push_back((char)q.average);
   k.append(std::to_string(q.root_rank));
   for (int64_t d : q.shape) {

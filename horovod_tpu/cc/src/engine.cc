@@ -94,7 +94,7 @@ static float from_wire_one(DataType wire, uint16_t v) {
 
 // Cast `n` elements of `from`-typed `src` into `wire`-typed `out`.
 static void cast_to_wire(DataType from, DataType wire, const void* src,
-                         size_t n, std::vector<uint8_t>& out) {
+                         size_t n, Buffer& out) {
   out.resize(n * dtype_size(wire));
   uint16_t* dst = (uint16_t*)out.data();
   if (from == DataType::F32) {
@@ -108,7 +108,7 @@ static void cast_to_wire(DataType from, DataType wire, const void* src,
 
 // Cast `n` wire-typed elements back to the caller dtype.
 static void cast_from_wire(DataType wire, DataType to, const void* src,
-                           size_t n, std::vector<uint8_t>& out) {
+                           size_t n, Buffer& out) {
   out.resize(n * dtype_size(to));
   const uint16_t* s = (const uint16_t*)src;
   if (to == DataType::F32) {
@@ -205,6 +205,19 @@ Engine::Engine(const Topology& topo, const EngineConfig& cfg)
   cycle_time_ms_ = cfg_.cycle_time_ms;
   fusion_threshold_ = (int64_t)cfg_.fusion_threshold;
   wire_dtype_ = wire_dtype_from_env();
+  // Sparse/adaptive wire knobs (ISSUE 13) — same env surface as the
+  // Python engine (compression.py / common/policy.py).
+  sparse_ = sparse_spec_from_env();
+  const char* tmb = std::getenv("HOROVOD_TOPK_MIN_BYTES");
+  topk_min_bytes_ = (tmb && *tmb) ? std::atoll(tmb) : (64 << 10);
+  const char* cmb = std::getenv("HOROVOD_COMPRESSION_MIN_BYTES");
+  compression_min_bytes_ = (cmb && *cmb) ? std::atoll(cmb) : 4096;
+  // Error feedback: OFF for the dtype casts unless explicitly enabled, ON
+  // for topk unless explicitly disabled (topk without EF drops ~99% of the
+  // gradient mass per step — a bias, not a compression; DGC).
+  const char* ef = std::getenv("HOROVOD_COMPRESSION_ERROR_FEEDBACK");
+  ef_cast_ = ef && std::string(ef) == "1";
+  ef_topk_ = ef_cast_ || !ef || !*ef;
   {
     const char* td = std::getenv("HOROVOD_TRACE_DIR");
     trace_enabled_ = td && *td;
@@ -297,8 +310,12 @@ Engine::Engine(const Topology& topo, const EngineConfig& cfg)
     // ones that fail analyze_hier) the outgoing link crosses hosts iff the
     // next rank reported a different cross_rank — the scaling harness needs
     // the flat baseline's cross bytes to be real there too.
-    if (peers[(size_t)next].cross_rank != topo_.cross_rank)
+    if (peers[(size_t)next].cross_rank != topo_.cross_rank) {
       ring_.set_cross_stats(&cross_stats_);
+      // The adaptive policy's flat-ring framing choice: sparse frames pay
+      // on links that cross hosts (value-neutral — common/policy.py).
+      flat_next_cross_ = true;
+    }
     hier_allreduce_ = cfg_.hierarchical_allreduce && hier_.capable;
     hier_allgather_ = cfg_.hierarchical_allgather && hier_.capable &&
                       hier_.blocked;
@@ -354,20 +371,135 @@ int64_t Engine::enqueue(OpType op, const std::string& name, DataType dtype,
   e.req.average = average ? 1 : 0;
   e.req.shape = shape;
   size_t elems = e.req.elements();
-  // Cast-on-send (HOROVOD_COMPRESSION): allreduce payloads of wider floats
-  // enter the engine already at the 16-bit wire dtype — the tensor table,
-  // fusion buffer and every ring hop then move half (f32) or a quarter
-  // (f64) of the bytes; add_chunk accumulates each add in f32 (ring.h).
-  DataType wire = (DataType)wire_dtype_;
-  if (wire_dtype_ >= 0 && op == OpType::ALLREDUCE &&
-      (dtype == DataType::F32 || dtype == DataType::F64) && dtype != wire) {
-    e.req.dtype = wire;
-    cast_to_wire(dtype, wire, data, elems, e.data);
+  size_t nbytes = elems * dtype_size(dtype);
+  // Per-tensor wire resolution (ISSUE 5 + ISSUE 13): explicit bf16/fp16
+  // rides wire_dtype_; `topk` sparsifies the contribution once, HERE, so
+  // every downstream stage (tensor table, sparse ring hops) moves frames
+  // of the selection; `adaptive` consults the deterministic (size, dtype,
+  // topology) table shared with common/policy.py — identical inputs on
+  // every rank, so cross-rank wire agreement holds with zero negotiation.
+  int wire = wire_dtype_;
+  bool topk = false;
+  if (op == OpType::ALLREDUCE) {
+    bool wide_float = dtype == DataType::F32 || dtype == DataType::F64;
+    if (sparse_.adaptive) {
+      wire = -1;
+      if (topo_.cross_size > 1 && wide_float &&
+          (int64_t)nbytes >= compression_min_bytes_) {
+        int64_t floor = topk_min_bytes_ > compression_min_bytes_
+                            ? topk_min_bytes_
+                            : compression_min_bytes_;
+        if (dtype == DataType::F32 && (int64_t)nbytes >= floor &&
+            topk_eligible(nbytes, sparse_.ratio, compression_min_bytes_)) {
+          topk = true;
+        } else {
+          wire = (int)DataType::BF16;
+        }
+      }
+    } else if (sparse_.topk) {
+      topk = dtype == DataType::F32 &&
+             topk_eligible(nbytes, sparse_.ratio, compression_min_bytes_);
+    }
+  }
+  // Error-feedback residual claim (DGC): popped BEFORE select/quantize so
+  // a redo replay of the already-prepared contribution can never fold it
+  // twice; the un-sent mass is re-stored below.
+  auto claim_residual = [&](DataType want) -> std::vector<uint8_t> {
+    std::lock_guard<std::mutex> g(residual_mu_);
+    auto it = residuals_.find(e.req.name);
+    if (it == residuals_.end()) return {};
+    std::vector<uint8_t> out;
+    if (it->second.first == want && it->second.second.size() == nbytes)
+      out = std::move(it->second.second);
+    residuals_.erase(it);  // claimed either way (shape/dtype change drops)
+    return out;
+  };
+  if (topk) {
+    const float* src = (const float*)data;
+    std::vector<float> xbuf;
+    if (ef_topk_) {
+      auto res = claim_residual(DataType::F32);
+      if (!res.empty()) {
+        xbuf.resize(elems);
+        const float* rp = (const float*)res.data();
+        for (size_t i = 0; i < elems; i++) xbuf[i] = src[i] + rp[i];
+        src = xbuf.data();
+      }
+    }
+    std::vector<int32_t> ti;
+    std::vector<float> tv;
+    topk_select(src, elems, topk_k(elems, sparse_.ratio), ti, tv);
+    e.data.assign(nbytes, 0);
+    float* dst = (float*)e.data.data();
+    for (size_t j = 0; j < ti.size(); j++) dst[(size_t)ti[j]] = tv[j];
+    if (ef_topk_) {
+      std::vector<uint8_t> res(nbytes);
+      float* rp = (float*)res.data();
+      for (size_t i = 0; i < elems; i++) rp[i] = src[i] - dst[i];
+      std::lock_guard<std::mutex> g(residual_mu_);
+      residuals_[e.req.name] = {DataType::F32, std::move(res)};
+    }
+    e.req.wire_fmt = 1;
+  } else if (wire >= 0 && op == OpType::ALLREDUCE &&
+             (dtype == DataType::F32 || dtype == DataType::F64) &&
+             dtype != (DataType)wire) {
+    // Cast-on-send: the payload enters the engine already at the 16-bit
+    // wire dtype — the tensor table, fusion buffer and every ring hop then
+    // move half (f32) or a quarter (f64) of the bytes; add_chunk
+    // accumulates each add in f32 (ring.h).
+    DataType w = (DataType)wire;
+    const void* src = data;
+    std::vector<uint8_t> xbuf;
+    if (ef_cast_) {
+      auto res = claim_residual(dtype);
+      if (!res.empty()) {
+        xbuf.resize(nbytes);
+        if (dtype == DataType::F32) {
+          float* x = (float*)xbuf.data();
+          const float* a = (const float*)data;
+          const float* r = (const float*)res.data();
+          for (size_t i = 0; i < elems; i++) x[i] = a[i] + r[i];
+        } else {
+          double* x = (double*)xbuf.data();
+          const double* a = (const double*)data;
+          const double* r = (const double*)res.data();
+          for (size_t i = 0; i < elems; i++) x[i] = a[i] + r[i];
+        }
+        src = xbuf.data();
+      }
+    }
+    e.req.dtype = w;
+    cast_to_wire(dtype, w, src, elems, e.data);
+    if (ef_cast_) {
+      // residual = input - dequantized(quantized(input)), at orig width.
+      std::vector<uint8_t> res(nbytes);
+      const uint16_t* q = (const uint16_t*)e.data.data();
+      if (dtype == DataType::F32) {
+        float* rp = (float*)res.data();
+        const float* a = (const float*)src;
+        for (size_t i = 0; i < elems; i++)
+          rp[i] = a[i] - from_wire_one(w, q[i]);
+      } else {
+        double* rp = (double*)res.data();
+        const double* a = (const double*)src;
+        for (size_t i = 0; i < elems; i++)
+          rp[i] = a[i] - (double)from_wire_one(w, q[i]);
+      }
+      std::lock_guard<std::mutex> g(residual_mu_);
+      residuals_[e.req.name] = {dtype, std::move(res)};
+    }
     metrics_.wire_bytes += (uint64_t)e.data.size();
     metrics_.wire_bytes_saved +=
         (uint64_t)(elems * dtype_size(dtype) - e.data.size());
+  } else if (op == OpType::ALLREDUCE) {
+    // Zero-copy enqueue (ISSUE 13): the binding pins the caller's buffer
+    // until the handle completes, so the uncompressed allreduce hot path
+    // BORROWS it read-only — the reduce-scatter folds it straight into a
+    // fresh output buffer (ring.h ring_reduce_scatter_into) and Python
+    // never pays the tensor-table copy.
+    e.borrow = (const uint8_t*)data;
+    e.borrow_bytes = nbytes;
   } else {
-    size_t nbytes = elems * dtype_size(dtype);
     e.data.assign((const uint8_t*)data, (const uint8_t*)data + nbytes);
   }
   int64_t handle = e.handle;
@@ -392,7 +524,7 @@ int64_t Engine::enqueue(OpType op, const std::string& name, DataType dtype,
       e.req.trace_seq = ++trace_seq_[e.req.name];
       uint64_t t = now_ns();
       trace_span(trace_tid(e.req), e.req.name, op, "enqueue", t, t,
-                 (uint64_t)e.data.size());
+                 (uint64_t)(e.borrow ? e.borrow_bytes : e.data.size()));
     }
     if (timeline_.healthy())
       timeline_.negotiate_start(e.req.name, op_name(op));
@@ -454,7 +586,7 @@ void Engine::finish(Entry& e, Status st, Response res) {
     // path (single-tensor fast path, fused bucket, local world) converts
     // once and the handle always yields the dtype the caller enqueued.
     if (e.req.compressed() && res.kind == Response::OK) {
-      std::vector<uint8_t> full;
+      Buffer full;
       cast_from_wire(e.req.dtype, e.req.orig_dtype, res.data.data(),
                      res.data.size() / dtype_size(e.req.dtype), full);
       res.data.swap(full);
@@ -573,7 +705,8 @@ void Engine::loop() {
       }
       auto tick_start = std::chrono::steady_clock::now();
       int64_t tick_bytes = 0;
-      for (auto& e : batch) tick_bytes += (int64_t)e.data.size();
+      for (auto& e : batch)
+        tick_bytes += (int64_t)(e.borrow ? e.borrow_bytes : e.data.size());
       for (auto& e : batch) complete_local(e);
       if (pm_ && pm_->active() && !batch.empty()) {
         double secs = std::chrono::duration<double>(
@@ -729,7 +862,12 @@ void Engine::complete_local(Entry& e) {
   res.name = e.req.name;
   res.dtype = e.req.dtype;
   res.shape = e.req.shape;
-  res.data = std::move(e.data);
+  if (e.borrow) {
+    // Single-process identity: the borrowed input IS the result.
+    res.data.assign(e.borrow, e.borrow + e.borrow_bytes);
+  } else {
+    res.data = std::move(e.data);
+  }
   if (timeline_.healthy()) timeline_.end(e.req.name);
   finish(e, Status::OK_(), std::move(res));
   if (trace_enabled_) {
@@ -849,11 +987,48 @@ void Engine::execute_entry(const ResponseEntry& re) {
 // Inter-host bytes per rank drop from 2·B·(N-1)/N (the flat boundary rank)
 // to 2·(B/L)·(C-1)/C — the 1/local_size reduction the per-rank cross-byte
 // counters measure.
+// The borrowed-input variant (ISSUE 13): reduce-scatter folds the
+// read-only `in` plus the incoming partials into `out` (3-operand
+// FoldCursor, ring.h); the cross stage, average scale and allgather run
+// in place on `out`. Bitwise identical to allreduce_buffer over a copy.
+void Engine::allreduce_buffer_into(const uint8_t* in, uint8_t* out,
+                                   size_t count, size_t esize, DataType d,
+                                   bool average) {
+  if (!(hier_allreduce_.load() && hier_.capable)) {
+    stats_.passes++;
+    auto counts = split_counts(count, topo_.size);
+    auto offs = offsets_of(counts);
+    ring_reduce_scatter_into(ring_, topo_.rank, topo_.size, in, out, counts,
+                             offs, esize, d, &stats_);
+    if (average) {
+      scale_chunk(d, out + offs[(size_t)topo_.rank] * esize,
+                  counts[(size_t)topo_.rank], topo_.size);
+    }
+    ring_allgather(ring_, topo_.rank, topo_.size, out, counts, offs, esize,
+                   &stats_);
+    return;
+  }
+  int L = topo_.local_size, C = topo_.cross_size;
+  auto counts = split_counts(count, L);
+  auto offs = offsets_of(counts);
+  stats_.passes++;
+  ring_reduce_scatter_into(local_ring_, topo_.local_rank, L, in, out,
+                           counts, offs, esize, d, &stats_);
+  uint8_t* mine = out + offs[(size_t)topo_.local_rank] * esize;
+  size_t mine_n = counts[(size_t)topo_.local_rank];
+  ring_allreduce(cross_ring_, topo_.cross_rank, C, mine, mine_n, esize, d,
+                 false, &stats_);
+  stats_.passes--;  // the cross pass is a stage of this allreduce
+  if (average) scale_chunk(d, mine, mine_n, topo_.size);
+  ring_allgather(local_ring_, topo_.local_rank, L, out, counts, offs, esize,
+                 &stats_);
+}
+
 void Engine::allreduce_buffer(uint8_t* buf, size_t count, size_t esize,
                               DataType d, bool average) {
   if (!(hier_allreduce_.load() && hier_.capable)) {
     ring_allreduce(ring_, topo_.rank, topo_.size, buf, count, esize, d,
-                   average, &stats_, &ring_scratch_);
+                   average, &stats_);
     return;
   }
   int L = topo_.local_size, C = topo_.cross_size;
@@ -861,13 +1036,13 @@ void Engine::allreduce_buffer(uint8_t* buf, size_t count, size_t esize,
   auto offs = offsets_of(counts);
   stats_.passes++;
   ring_reduce_scatter(local_ring_, topo_.local_rank, L, buf, counts, offs,
-                      esize, d, &stats_, &ring_scratch_);
+                      esize, d, &stats_);
   uint8_t* mine = buf + offs[(size_t)topo_.local_rank] * esize;
   size_t mine_n = counts[(size_t)topo_.local_rank];
   // average=false here: the division is by the full world size, applied once
   // below (the cross ring's own world is only cross_size).
   ring_allreduce(cross_ring_, topo_.cross_rank, C, mine, mine_n, esize, d,
-                 false, &stats_, &ring_scratch_);
+                 false, &stats_);
   stats_.passes--;  // the cross pass is a stage of this allreduce, not its own
   if (average) scale_chunk(d, mine, mine_n, topo_.size);
   ring_allgather(local_ring_, topo_.local_rank, L, buf, counts, offs, esize,
@@ -881,6 +1056,12 @@ void Engine::allreduce_buffer(uint8_t* buf, size_t count, size_t esize,
 // only simulated it.
 void Engine::execute_allreduce(const ResponseEntry& re,
                                std::vector<Entry>& ents) {
+  // Sparse entries never fuse (coordinator excludes them from the fusion
+  // plan), so a topk allreduce always arrives alone.
+  if (ents.size() == 1 && ents[0].req.wire_fmt == 1) {
+    execute_sparse_allreduce(re, ents[0]);
+    return;
+  }
   DataType d = re.dtype;
   size_t wes = dtype_size(d);
   const char* act =
@@ -893,14 +1074,23 @@ void Engine::execute_allreduce(const ResponseEntry& re,
     size_t n = e.req.elements();
     if (timeline_.healthy())
       timeline_.activity_start(e.req.name, act);
-    allreduce_buffer(e.data.data(), n, wes, d, re.average != 0);
-    if (timeline_.healthy()) timeline_.activity_end(e.req.name);
     Response res;
     res.kind = Response::OK;
     res.name = e.req.name;
     res.dtype = d;
     res.shape = e.req.shape;
-    res.data = std::move(e.data);
+    if (e.borrow) {
+      // Zero-copy hot path: fold the borrowed caller buffer + incoming
+      // partials straight into the (uninitialized) result buffer — no
+      // tensor-table copy ever happened for this entry.
+      res.data.resize(n * wes);
+      allreduce_buffer_into(e.borrow, res.data.data(), n, wes, d,
+                            re.average != 0);
+    } else {
+      allreduce_buffer(e.data.data(), n, wes, d, re.average != 0);
+      res.data = std::move(e.data);
+    }
+    if (timeline_.healthy()) timeline_.activity_end(e.req.name);
     finish(e, Status::OK_(), std::move(res));
     return;
   }
@@ -912,7 +1102,8 @@ void Engine::execute_allreduce(const ResponseEntry& re,
     size_t n = e.req.elements();
     if (timeline_.healthy())
       timeline_.activity_start(e.req.name, "MEMCPY_IN_FUSION_BUFFER");
-    std::memcpy(buf + off * wes, e.data.data(), n * wes);
+    std::memcpy(buf + off * wes,
+                e.borrow ? e.borrow : e.data.data(), n * wes);
     if (timeline_.healthy()) timeline_.activity_end(e.req.name);
     off += n;
   }
@@ -939,6 +1130,47 @@ void Engine::execute_allreduce(const ResponseEntry& re,
     off += n;
     finish(e, Status::OK_(), std::move(res));
   }
+}
+
+// Sparse (topk) allreduce (ISSUE 13, closing the PR 9 native gap): the
+// entry's buffer holds the enqueue-sparsified dense f32 contribution; the
+// ring hops carry indices+values frames index-merged in canonical fold
+// order (ring.h ring_sparse_allreduce / grid_sparse_allreduce), bitwise
+// identical to the Python engine's sparse planes and the topk oracle.
+void Engine::execute_sparse_allreduce(const ResponseEntry& re, Entry& e) {
+  size_t n = e.req.elements();
+  bool hier = hier_allreduce_.load() && hier_.capable;
+  if (timeline_.healthy())
+    timeline_.activity_start(e.req.name,
+                             hier ? "HIER_ALLREDUCE" : "RING_ALLREDUCE");
+  SparseWire sw;
+  if (hier) {
+    // Per-fabric framing (value-neutral): explicit topk prefers sparse on
+    // both fabrics; adaptive ships sparse on the cross-host leaders rings
+    // only (loopback moves dense f32 faster than it selects/merges).
+    grid_sparse_allreduce(local_ring_, cross_ring_, topo_.local_rank,
+                          topo_.local_size, topo_.cross_rank,
+                          topo_.cross_size, (float*)e.data.data(), n,
+                          re.average != 0, /*sp_local=*/!sparse_.adaptive,
+                          /*sp_cross=*/true, &stats_, &sw);
+  } else {
+    ring_sparse_allreduce(ring_, topo_.rank, topo_.size,
+                          (float*)e.data.data(), n, re.average != 0,
+                          sparse_.adaptive ? flat_next_cross_ : true,
+                          &stats_, &sw);
+  }
+  if (timeline_.healthy()) timeline_.activity_end(e.req.name);
+  metrics_.wire_bytes += sw.wire;
+  metrics_.wire_bytes_saved += sw.saved;
+  metrics_.topk_wire_bytes += sw.wire;
+  metrics_.topk_wire_bytes_saved += sw.saved;
+  Response res;
+  res.kind = Response::OK;
+  res.name = e.req.name;
+  res.dtype = e.req.dtype;
+  res.shape = e.req.shape;
+  res.data = std::move(e.data);
+  finish(e, Status::OK_(), std::move(res));
 }
 
 void Engine::execute_allgather(const ResponseEntry& re, Entry& ent) {
@@ -1042,7 +1274,7 @@ void Engine::execute_reducescatter(const ResponseEntry& re, Entry& ent) {
   // Reduce in place over the entry's own buffer (native width, ring.h).
   stats_.passes++;
   ring_reduce_scatter(ring_, topo_.rank, topo_.size, ent.data.data(), counts,
-                      offs, wes, d, &stats_, &ring_scratch_);
+                      offs, wes, d, &stats_);
   size_t mine = counts[(size_t)topo_.rank];
   uint8_t* my_chunk = ent.data.data() + offs[(size_t)topo_.rank] * wes;
   if (re.average) scale_chunk(d, my_chunk, mine, topo_.size);
@@ -1501,7 +1733,10 @@ void Coordinator::build_response_list() {
   std::vector<FusionItem> items;
   for (size_t i = 0; i < ready.size(); i++) {
     auto& e = ready[i].second;
-    if (e.kind == ResponseEntry::OK && e.op == OpType::ALLREDUCE) {
+    // Sparse (topk) entries never fuse: their payloads are per-tensor
+    // frames, and each rank executes them from its own Request anyway.
+    if (e.kind == ResponseEntry::OK && e.op == OpType::ALLREDUCE &&
+        e.req_wire_fmt == 0) {
       // fused_nbytes (work-dtype payload size) is stashed by validate()
       items.push_back(
           FusionItem{i, e.dtype, e.average, (size_t)e.fused_nbytes});
@@ -1593,6 +1828,10 @@ bool Coordinator::validate(const std::string& name,
       // Divergent HOROVOD_COMPRESSION across ranks: half the world would
       // ship 2-byte chunks the other half reads at full width.
       return fail("Mismatched wire compression for tensor " + name);
+    if (q.wire_fmt != first.wire_fmt)
+      // Same failure class for the sparse wire: a topk rank's frames are
+      // unreadable as dense chunks (ISSUE 13).
+      return fail("Mismatched wire compression for tensor " + name);
   }
   if (first.op == OpType::ALLGATHER) {
     if (first.shape.empty())
@@ -1624,6 +1863,7 @@ bool Coordinator::validate(const std::string& name,
   entry->dtype = first.dtype;
   entry->root_rank = first.root_rank;
   entry->average = first.average;
+  entry->req_wire_fmt = first.wire_fmt;
   if (first.op == OpType::ALLGATHER) {
     entry->tensor_sizes.resize((size_t)world_);
     for (auto& [r, q] : contribs) {

@@ -84,6 +84,12 @@ struct EngineMetrics {
   // native_engine.py into horovod_wire_bytes_{,saved_}total{plane="native"}.
   std::atomic<uint64_t> wire_bytes{0};
   std::atomic<uint64_t> wire_bytes_saved{0};
+  // Sparse (topk) subset of the wire counters (ISSUE 13): frame bytes the
+  // sparse hops shipped and the bytes they avoided vs dense f32 hops.
+  // native_engine.py feeds these to the SAME method="topk"-labeled
+  // horovod_wire_bytes_saved_total series the Python engine increments.
+  std::atomic<uint64_t> topk_wire_bytes{0};
+  std::atomic<uint64_t> topk_wire_bytes_saved{0};
 };
 
 // HOROVOD_COMPRESSION={none,fp16,bf16} -> the 16-bit wire dtype allreduce
@@ -98,6 +104,43 @@ inline int wire_dtype_from_env() {
   if (s == "fp16") return (int)DataType::F16;
   if (s == "bf16") return (int)DataType::BF16;
   return -1;
+}
+
+// HOROVOD_COMPRESSION sparse/adaptive half (ISSUE 13: the native topk
+// plane). Mirrors compression.py parse_spec + topk_ratio_from_env: `topk`
+// and `topk@<ratio>` are first-class, `adaptive` hands the per-tensor
+// format choice to the deterministic (size, dtype, topology) table that
+// common/policy.py defines — evaluated identically on every rank, so the
+// coordinator's cross-rank wire validation holds with zero negotiation.
+struct SparseSpec {
+  bool topk = false;      // explicit topk[@ratio]
+  bool adaptive = false;  // per-tensor policy table
+  double ratio = 0.01;    // DEFAULT_TOPK_RATIO, clamped to (0, 0.5]
+};
+
+inline SparseSpec sparse_spec_from_env() {
+  SparseSpec out;
+  const char* r = std::getenv("HOROVOD_TOPK_RATIO");
+  if (r && *r) {
+    double v = std::atof(r);
+    if (v > 0) out.ratio = v < 0.5 ? v : 0.5;
+  }
+  const char* c = std::getenv("HOROVOD_COMPRESSION");
+  if (!c || !*c) return out;
+  std::string s(c);
+  for (auto& ch : s) ch = (char)std::tolower((unsigned char)ch);
+  if (s == "adaptive") {
+    out.adaptive = true;
+  } else if (s == "topk") {
+    out.topk = true;
+  } else if (s.rfind("topk@", 0) == 0) {
+    double v = std::atof(s.c_str() + 5);
+    if (v > 0) {
+      out.topk = true;
+      out.ratio = v < 0.5 ? v : 0.5;  // @ratio overrides the env knob
+    }
+  }
+  return out;
 }
 
 // One rank's registration record: ring endpoints plus its host coordinates.
@@ -229,9 +272,16 @@ class Engine {
     return (int)cache_key_to_bit_.size();
   }
   void cache_flush() {
-    std::lock_guard<std::mutex> g(cache_mu_);
-    cache_key_to_bit_.clear();
-    cache_bit_to_key_.clear();
+    {
+      std::lock_guard<std::mutex> g(cache_mu_);
+      cache_key_to_bit_.clear();
+      cache_bit_to_key_.clear();
+    }
+    // Error-feedback residuals drop with the cached negotiations (elastic
+    // reset / membership change), matching the Python engine: a stale
+    // residual folded into a fresh world would skew the first step.
+    std::lock_guard<std::mutex> g(residual_mu_);
+    residuals_.clear();
   }
 
   // Live wire-compression dtype: (int)DataType of the 16-bit wire format,
@@ -260,7 +310,13 @@ class Engine {
  private:
   struct Entry {
     Request req;
-    std::vector<uint8_t> data;  // this rank's contribution (host bytes)
+    Buffer data;  // this rank's contribution (host bytes; owned)
+    // Zero-copy enqueue (ISSUE 13): uncompressed allreduce contributions
+    // are BORROWED from the caller (read-only; the ctypes binding pins
+    // the numpy buffer until the handle completes) instead of copied —
+    // `data` stays empty and the fold writes a fresh output buffer.
+    const uint8_t* borrow = nullptr;
+    size_t borrow_bytes = 0;
     int64_t handle = 0;
     std::chrono::steady_clock::time_point enqueued;
   };
@@ -278,10 +334,18 @@ class Engine {
   void execute_list(const ResponseList& list);
   void execute_entry(const ResponseEntry& re);
   void execute_allreduce(const ResponseEntry& re, std::vector<Entry>& ents);
+  // Sparse (topk) allreduce over the entry's own enqueue-sparsified dense
+  // f32 buffer: flat sparse ring, or the two-level sparse ladder.
+  void execute_sparse_allreduce(const ResponseEntry& re, Entry& ent);
   // One allreduce pass over `count` elements in `buf`: flat ring, or the
   // two-level ladder when the hierarchical knob is on and topology allows.
   void allreduce_buffer(uint8_t* buf, size_t count, size_t esize, DataType d,
                         bool average);
+  // Same pass with a READ-ONLY input and separate output (the zero-copy
+  // borrowed-enqueue path): reduce-scatter folds in+incoming into out,
+  // the rest of the ladder runs in place on out.
+  void allreduce_buffer_into(const uint8_t* in, uint8_t* out, size_t count,
+                             size_t esize, DataType d, bool average);
   void execute_allgather(const ResponseEntry& re, Entry& ent);
   void execute_broadcast(const ResponseEntry& re, Entry& ent);
   void execute_reducescatter(const ResponseEntry& re, Entry& ent);
@@ -347,16 +411,29 @@ class Engine {
   mutable std::mutex stall_mu_;
   std::string last_stall_;  // latest stall warning text (diagnostics)
   FusionBuffer fusion_buf_;
-  // Persistent receive-bounce arena for ring reduce-scatter (single
-  // background executor thread => no locking; grown on demand, reused
-  // across collectives so the hot path never re-faults a fresh scratch).
-  std::vector<uint8_t> ring_scratch_;
+  // (The old receive-bounce scratch arena is gone: the reduce-scatter now
+  // folds incoming bytes straight into the accumulator chunk —
+  // ring.h transfer_apply + ReduceCursor, ISSUE 13.)
   std::unique_ptr<ParameterManager> pm_;  // single-process tuning only
   // HOROVOD_COMPRESSION wire dtype ((int)DataType, -1 = none): allreduce
   // payloads are cast to it at enqueue (cast-on-send) and restored to the
   // caller dtype at completion; the ring then moves and reduces 2-byte
   // elements natively (add_chunk accumulates each add in f32, ring.h).
   int wire_dtype_ = -1;
+  // Sparse/adaptive wire config (ISSUE 13): parsed once at construction
+  // from the same env knobs the Python engine reads.
+  SparseSpec sparse_;
+  int64_t topk_min_bytes_ = 1 << 16;        // HOROVOD_TOPK_MIN_BYTES
+  int64_t compression_min_bytes_ = 4096;    // HOROVOD_COMPRESSION_MIN_BYTES
+  bool ef_cast_ = false;   // EF for bf16/fp16 casts (env "1")
+  bool ef_topk_ = true;    // EF for topk (defaults ON; env "0" disables)
+  bool flat_next_cross_ = false;  // flat ring's next link crosses hosts
+  // Per-tensor error-feedback residuals (orig-dtype bytes), claimed at
+  // enqueue and re-stored with the un-sent mass (DGC). Guarded: enqueue
+  // runs on API threads, cache_flush may race from another thread.
+  std::mutex residual_mu_;
+  std::unordered_map<std::string, std::pair<DataType, std::vector<uint8_t>>>
+      residuals_;
   std::atomic<double> cycle_time_ms_{5.0};
   std::atomic<int64_t> fusion_threshold_{64 << 20};
   std::atomic<uint32_t> applied_knob_version_{0};

@@ -10,10 +10,43 @@
 
 #include <cstdint>
 #include <cstring>
+#include <memory>
 #include <string>
 #include <vector>
 
 namespace hvd {
+
+// Allocator whose default-construct is a no-op: Buffer::resize() leaves
+// the bytes uninitialized instead of zero-filling them. Payload buffers
+// are written in full by the collective that produces them (allgather
+// slots, reduce folds, memcpy-out), so the value-initializing resize of
+// a plain std::vector was a wasted full write of every payload — real
+// memory traffic at 100 MB gradients × 16 ranks on one host (ISSUE 13).
+template <typename T, typename A = std::allocator<T>>
+class default_init_allocator : public A {
+  using a_t = std::allocator_traits<A>;
+
+ public:
+  template <typename U>
+  struct rebind {
+    using other = default_init_allocator<
+        U, typename a_t::template rebind_alloc<U>>;
+  };
+  using A::A;
+  template <typename U>
+  void construct(U* ptr) noexcept(
+      std::is_nothrow_default_constructible<U>::value) {
+    ::new (static_cast<void*>(ptr)) U;
+  }
+  template <typename U, typename... Args>
+  void construct(U* ptr, Args&&... args) {
+    a_t::construct(static_cast<A&>(*this), ptr,
+                   std::forward<Args>(args)...);
+  }
+};
+
+// Payload byte buffer (tensor-sized): uninitialized on resize.
+using Buffer = std::vector<uint8_t, default_init_allocator<uint8_t>>;
 
 enum class StatusType : int {
   OK = 0,

@@ -36,6 +36,7 @@
 #include "hvd_common.h"
 #include "net.h"
 #include "shm_ring.h"
+#include "topk.h"
 
 namespace hvd {
 
@@ -273,6 +274,103 @@ class RingLinks {
     }
   }
 
+  // Duplex step whose RECEIVE side streams through a sink instead of a
+  // buffer (ISSUE 13 zero-copy reduce): `feed(src, len)` is called with
+  // in-order byte runs totalling exactly `m`. Over an shm-upgraded link
+  // the runs point INTO the shared segment — the reduce-scatter's add
+  // runs straight from ring memory to the accumulator chunk, skipping
+  // the scratch bounce (a full read+write of the payload per pass); over
+  // TCP the runs come from a small cache-hot staging block, which also
+  // beats the old chunk-sized scratch on locality.
+  template <typename Feed>
+  void transfer_apply(const uint8_t* out, size_t n, size_t m, Feed&& feed,
+                      RingStats* stats) {
+    size_t sent = 0, got = 0;
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(300);
+    uint8_t staging[64 << 10];  // TCP receive runs (L1/L2-resident)
+    while (sent < n || got < m) {
+      bool prog = false;
+      uint32_t prod_seq = 0, cons_seq = 0;
+      if (sent < n) {
+        if (shm_next_.active()) {
+          prod_seq = shm_next_.seq(ShmLink::Side::producer);
+          size_t w = shm_next_.try_produce(out + sent, n - sent);
+          if (w) { sent += w; prog = true; }
+          if (shm_next_.peer_gone())
+            throw std::runtime_error("shm ring peer closed");
+        } else {
+          ssize_t w = ::send(next_fd_, out + sent, n - sent,
+                             MSG_NOSIGNAL | MSG_DONTWAIT);
+          if (w > 0) { sent += (size_t)w; prog = true; }
+          else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                   errno != EINTR)
+            throw std::runtime_error("ring send failed");
+        }
+      }
+      if (got < m) {
+        if (shm_prev_.active()) {
+          cons_seq = shm_prev_.seq(ShmLink::Side::consumer);
+          size_t r = shm_prev_.try_consume_apply(m - got, feed);
+          if (r) { got += r; prog = true; }
+          if (!r && shm_prev_.peer_gone())
+            throw std::runtime_error("shm ring peer closed");
+        } else {
+          size_t want = std::min(m - got, sizeof(staging));
+          ssize_t r = ::recv(prev_fd_, staging, want, MSG_DONTWAIT);
+          if (r == 0) throw std::runtime_error("ring peer closed");
+          if (r > 0) { feed(staging, (size_t)r); got += (size_t)r; prog = true; }
+          else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+            throw std::runtime_error("ring recv failed");
+        }
+      }
+      if (prog) {
+        deadline = std::chrono::steady_clock::now() +
+                   std::chrono::seconds(300);
+        continue;
+      }
+      if (std::chrono::steady_clock::now() > deadline)
+        throw std::runtime_error("ring transfer timed out (300s idle)");
+      // Parking: identical structure to mixed_duplex (see there for the
+      // rationale of every branch).
+      bool tcp_send = sent < n && !shm_next_.active();
+      bool tcp_recv = got < m && !shm_prev_.active();
+      if (tcp_send || tcp_recv) {
+        pollfd fds[2];
+        int nfds = 0;
+        if (tcp_send) fds[nfds++] = {next_fd_, POLLOUT, 0};
+        if (tcp_recv) fds[nfds++] = {prev_fd_, POLLIN, 0};
+        bool shm_pending = (sent < n && shm_next_.active()) ||
+                           (got < m && shm_prev_.active());
+        if (::poll(fds, (nfds_t)nfds, shm_pending ? 5 : 300) < 0 &&
+            errno != EINTR)
+          throw std::runtime_error("poll failed in ring transfer");
+      } else if (got < m && shm_prev_.active() &&
+                 sent < n && shm_next_.active()) {
+        ShmLink::wait_both(shm_prev_, cons_seq, shm_next_, prod_seq);
+      } else if (got < m && shm_prev_.active()) {
+        shm_prev_.wait(ShmLink::Side::consumer, cons_seq);
+      } else if (sent < n && shm_next_.active()) {
+        shm_next_.wait(ShmLink::Side::producer, prod_seq);
+      }
+      pollfd probe[2];
+      int np = 0;
+      if (shm_next_.active() && next_fd_ >= 0)
+        probe[np++] = {next_fd_, 0, 0};
+      if (shm_prev_.active() && prev_fd_ >= 0)
+        probe[np++] = {prev_fd_, POLLIN, 0};
+      if (np > 0 && ::poll(probe, (nfds_t)np, 0) > 0) {
+        for (int i = 0; i < np; i++) {
+          if (probe[i].revents & (POLLHUP | POLLERR | POLLIN))
+            throw std::runtime_error(
+                "ring peer died (socket closed during shm transfer)");
+        }
+      }
+    }
+    if (stats) stats->bytes_sent += n;
+    if (cross_stats_) cross_stats_->bytes_sent += n;
+  }
+
  private:
   // Bidirectional progress loop over any mix of shm and TCP links. Matches
   // duplex()'s contract (both neighbours push and pull concurrently, so
@@ -414,6 +512,46 @@ inline void add_chunk_bf16(uint8_t* dst, const uint8_t* src, size_t count) {
     d[i] = float_to_bf16(bf16_to_float(d[i]) + bf16_to_float(s[i]));
 }
 
+// Three-operand fold: dst[i] = a[i] + s[i] — the out-of-place twin of
+// add_chunk, used by the borrowed-input reduce-scatter (ISSUE 13: the
+// caller's buffer is read-only; the fold writes the fresh output buffer).
+// Identical operand order and per-element arithmetic as add_chunk, so the
+// results are bitwise the same.
+template <typename T>
+static void add_into_t(uint8_t* dst, const uint8_t* a, const uint8_t* s,
+                       size_t count) {
+  T* d = (T*)dst;
+  const T* x = (const T*)a;
+  const T* y = (const T*)s;
+  for (size_t i = 0; i < count; i++) d[i] = x[i] + y[i];
+}
+
+inline void add_chunk_into(DataType t, uint8_t* dst, const uint8_t* a,
+                           const uint8_t* s, size_t count) {
+  const uint16_t* xa = (const uint16_t*)a;
+  const uint16_t* xs = (const uint16_t*)s;
+  uint16_t* xd = (uint16_t*)dst;
+  switch (t) {
+    case DataType::F32: add_into_t<float>(dst, a, s, count); return;
+    case DataType::F64: add_into_t<double>(dst, a, s, count); return;
+    case DataType::F16:
+      for (size_t i = 0; i < count; i++)
+        xd[i] = float_to_half(half_to_float(xa[i]) + half_to_float(xs[i]));
+      return;
+    case DataType::BF16:
+      for (size_t i = 0; i < count; i++)
+        xd[i] = float_to_bf16(bf16_to_float(xa[i]) + bf16_to_float(xs[i]));
+      return;
+    case DataType::I32: add_into_t<int32_t>(dst, a, s, count); return;
+    case DataType::I64: add_into_t<int64_t>(dst, a, s, count); return;
+    case DataType::U8:
+    case DataType::BOOL: add_into_t<uint8_t>(dst, a, s, count); return;
+    case DataType::I8: add_into_t<int8_t>(dst, a, s, count); return;
+    default:
+      throw std::runtime_error("ring reduction on unsupported dtype");
+  }
+}
+
 inline void add_chunk(DataType t, uint8_t* dst, const uint8_t* src,
                       size_t count) {
   switch (t) {
@@ -462,31 +600,165 @@ inline void scale_chunk(DataType t, uint8_t* p, size_t count, int world) {
 
 // ----------------------------------------------------------------- collectives
 
+// Streaming reduce sink for transfer_apply: applies in-order byte runs of
+// an incoming chunk onto the accumulator with add_chunk, handling runs
+// that split mid-element (the shm ring wraps at arbitrary byte offsets)
+// through a tiny carry buffer. Element-for-element this performs the
+// exact same add sequence (ascending index, one add per element) the old
+// consume-to-scratch-then-add path did — bitwise identical results, one
+// full payload read+write less per ring pass.
+struct ReduceCursor {
+  uint8_t* dst;
+  DataType work;
+  size_t esize;
+  size_t done = 0;          // bytes fully folded into dst
+  uint8_t carry[16] = {0};  // partial element spanning two runs
+  size_t carry_n = 0;
+
+  void operator()(const uint8_t* src, size_t len) {
+    if (carry_n) {
+      size_t need = esize - carry_n;
+      size_t take = len < need ? len : need;
+      std::memcpy(carry + carry_n, src, take);
+      carry_n += take;
+      src += take;
+      len -= take;
+      if (carry_n == esize) {
+        add_chunk(work, dst + done, carry, 1);
+        done += esize;
+        carry_n = 0;
+      }
+    }
+    size_t whole = (len / esize) * esize;
+    if (whole) {
+      if (((uintptr_t)src % esize) == 0) {
+        add_chunk(work, dst + done, src, whole / esize);
+        done += whole;
+      } else {
+        // Element-misaligned run (a carry fill or an shm wrap landed
+        // mid-element): typed loads on it are UB, so bounce through a
+        // small aligned block. Rare — at most once per carry event.
+        alignas(8) uint8_t block[4096];
+        size_t off = 0;
+        while (off < whole) {
+          size_t take = whole - off < sizeof(block) ? whole - off
+                                                    : sizeof(block);
+          std::memcpy(block, src + off, take);
+          add_chunk(work, dst + done, block, take / esize);
+          done += take;
+          off += take;
+        }
+      }
+      src += whole;
+      len -= whole;
+    }
+    if (len) {
+      std::memcpy(carry, src, len);
+      carry_n = len;
+    }
+  }
+};
+
 // Ring reduce-scatter over explicit element chunks (counts/offs in elements).
 // After N-1 steps rank r holds the fully reduced chunk r. Flat equal-ish
 // chunks give allreduce; row-aligned chunks give reducescatter semantics.
+// The receive side folds incoming bytes straight into the accumulator
+// chunk (transfer_apply + ReduceCursor): zero-copy from the shm segment
+// on same-host links, a 64 KiB cache-hot staging block on TCP — the old
+// chunk-sized scratch bounce (an extra full read+write of the payload per
+// pass) is gone (ISSUE 13).
 inline void ring_reduce_scatter(RingLinks& links, int rank, int world,
                                 uint8_t* buf, const std::vector<size_t>& counts,
                                 const std::vector<size_t>& offs, size_t esize,
-                                DataType work, RingStats* stats,
-                                std::vector<uint8_t>* scratch_arena = nullptr) {
-  size_t max_chunk = 0;
-  for (auto c : counts) max_chunk = std::max(max_chunk, c);
-  // The receive bounce buffer: callers on the hot path (the engine) pass a
-  // persistent arena so a 100 MB allreduce doesn't allocate — and re-fault —
-  // a fresh 50 MB scratch every collective.
-  std::vector<uint8_t> local;
-  std::vector<uint8_t>& scratch = scratch_arena ? *scratch_arena : local;
-  if (scratch.size() < max_chunk * esize) scratch.resize(max_chunk * esize);
+                                DataType work, RingStats* stats) {
   auto mod = [&](int v) { return ((v % world) + world) % world; };
   for (int s = 0; s < world - 1; s++) {
     int send_idx = mod(rank - 1 - s);
     int recv_idx = mod(rank - 2 - s);
-    links.transfer(buf + offs[(size_t)send_idx] * esize,
-                   counts[(size_t)send_idx] * esize, scratch.data(),
-                   counts[(size_t)recv_idx] * esize, stats);
-    add_chunk(work, buf + offs[(size_t)recv_idx] * esize, scratch.data(),
-              counts[(size_t)recv_idx]);
+    ReduceCursor fold{buf + offs[(size_t)recv_idx] * esize, work, esize};
+    links.transfer_apply(buf + offs[(size_t)send_idx] * esize,
+                         counts[(size_t)send_idx] * esize,
+                         counts[(size_t)recv_idx] * esize, fold, stats);
+  }
+}
+
+// Three-operand streaming fold (the borrowed-input path): out chunk =
+// own (read-only input) chunk + incoming bytes. Same add order as
+// ReduceCursor, bitwise identical; `own` tracks `done` so runs may split
+// anywhere.
+struct FoldCursor {
+  uint8_t* dst;
+  const uint8_t* own;
+  DataType work;
+  size_t esize;
+  size_t done = 0;
+  uint8_t carry[16] = {0};
+  size_t carry_n = 0;
+
+  void operator()(const uint8_t* src, size_t len) {
+    if (carry_n) {
+      size_t need = esize - carry_n;
+      size_t take = len < need ? len : need;
+      std::memcpy(carry + carry_n, src, take);
+      carry_n += take;
+      src += take;
+      len -= take;
+      if (carry_n == esize) {
+        add_chunk_into(work, dst + done, own + done, carry, 1);
+        done += esize;
+        carry_n = 0;
+      }
+    }
+    size_t whole = (len / esize) * esize;
+    if (whole) {
+      if (((uintptr_t)src % esize) == 0) {
+        add_chunk_into(work, dst + done, own + done, src, whole / esize);
+        done += whole;
+      } else {
+        alignas(8) uint8_t block[4096];
+        size_t off = 0;
+        while (off < whole) {
+          size_t take = whole - off < sizeof(block) ? whole - off
+                                                    : sizeof(block);
+          std::memcpy(block, src + off, take);
+          add_chunk_into(work, dst + done, own + done, block, take / esize);
+          done += take;
+          off += take;
+        }
+      }
+      src += whole;
+      len -= whole;
+    }
+    if (len) {
+      std::memcpy(carry, src, len);
+      carry_n = len;
+    }
+  }
+};
+
+// Reduce-scatter with a READ-ONLY input buffer and a separate output
+// (ISSUE 13 zero-copy enqueue: the engine borrows the caller's tensor
+// instead of copying it into the table). Step 0 sends the caller's own
+// chunk; every later step sends the chunk folded the step before (which
+// lives in `out`); folds write out chunk = in chunk + incoming. After
+// world-1 steps `out` holds the same bytes the in-place variant leaves in
+// `buf` for chunks it folded; chunk (rank-1+world)%world of `out` stays
+// untouched (the allgather fills it).
+inline void ring_reduce_scatter_into(RingLinks& links, int rank, int world,
+                                     const uint8_t* in, uint8_t* out,
+                                     const std::vector<size_t>& counts,
+                                     const std::vector<size_t>& offs,
+                                     size_t esize, DataType work,
+                                     RingStats* stats) {
+  auto mod = [&](int v) { return ((v % world) + world) % world; };
+  for (int s = 0; s < world - 1; s++) {
+    int send_idx = mod(rank - 1 - s);
+    int recv_idx = mod(rank - 2 - s);
+    const uint8_t* src = (s == 0 ? in : out) + offs[(size_t)send_idx] * esize;
+    FoldCursor fold{out + offs[(size_t)recv_idx] * esize,
+                    in + offs[(size_t)recv_idx] * esize, work, esize};
+    links.transfer_apply(src, counts[(size_t)send_idx] * esize,
+                         counts[(size_t)recv_idx] * esize, fold, stats);
   }
 }
 
@@ -511,13 +783,12 @@ inline void ring_allgather(RingLinks& links, int rank, int world, uint8_t* buf,
 // Full ring allreduce: reduce-scatter, scale own chunk (average), allgather.
 inline void ring_allreduce(RingLinks& links, int rank, int world, uint8_t* buf,
                            size_t count, size_t esize, DataType work,
-                           bool average, RingStats* stats,
-                           std::vector<uint8_t>* scratch_arena = nullptr) {
+                           bool average, RingStats* stats) {
   if (stats) stats->passes++;
   auto counts = split_counts(count, world);
   auto offs = offsets_of(counts);
-  ring_reduce_scatter(links, rank, world, buf, counts, offs, esize, work, stats,
-                      scratch_arena);
+  ring_reduce_scatter(links, rank, world, buf, counts, offs, esize, work,
+                      stats);
   if (average) {
     scale_chunk(work, buf + offs[(size_t)rank] * esize, counts[(size_t)rank],
                 world);
@@ -603,6 +874,170 @@ inline void ring_alltoall(RingLinks& links, int rank, int world,
     std::memcpy(out + (size_t)origin * my_bytes, incoming.data(), my_bytes);
     // forward the remainder next step
     parcel.assign(incoming.begin() + (ptrdiff_t)my_bytes, incoming.end());
+  }
+}
+
+// ------------------------------------------------------ sparse (topk) wire
+// The native half of ISSUE 13's zero-copy hot path for HOROVOD_COMPRESSION
+// =topk: ring hops carry self-describing indices+values frames (topk.h)
+// instead of dense chunks, reduced by index merge in the SAME fold order
+// as the dense path — bitwise identical to the Python engine's
+// _sparse_allreduce and the _ring_order_reduce(wire="topk") oracle.
+// Sparse frames are variable-size (k grows with every merge), so each hop
+// prefixes a 4-byte length — the only framed transfer on the ring; the
+// dense path's sizes stay protocol-derived.
+
+// Per-collective wire accounting for the sparse hops (single executor
+// thread; the engine folds these into its atomic EngineMetrics after the
+// pass). `saved` counts against the dense f32 hop the uncompressed plane
+// would ship (native width — the Python engine uses the same basis).
+struct SparseWire {
+  uint64_t wire = 0;
+  uint64_t saved = 0;
+
+  void hop(size_t frame_bytes, size_t chunk_elems) {
+    wire += frame_bytes;
+    size_t dense = chunk_elems * 4;
+    saved += dense > frame_bytes ? dense - frame_bytes : 0;
+  }
+};
+
+// One framed hop: exchange 4-byte lengths, then the payloads. `cap` bounds
+// the incoming allocation (topk_frame_cap of the expected chunk).
+inline std::vector<uint8_t> sparse_hop(RingLinks& links,
+                                       const std::vector<uint8_t>& out_frame,
+                                       size_t cap, RingStats* stats) {
+  uint32_t out_len = (uint32_t)out_frame.size();
+  uint32_t in_len = 0;
+  links.transfer((const uint8_t*)&out_len, 4, (uint8_t*)&in_len, 4, stats);
+  if ((size_t)in_len > cap)
+    throw std::runtime_error("sparse frame length " + std::to_string(in_len) +
+                             " exceeds cap " + std::to_string(cap));
+  std::vector<uint8_t> in_frame((size_t)in_len);
+  links.transfer(out_frame.data(), out_frame.size(), in_frame.data(),
+                 in_frame.size(), stats);
+  return in_frame;
+}
+
+// Flat-ring sparse allreduce over a dense float32 buffer (in place),
+// mirroring engine.py _PeerRing._sparse_allreduce hop for hop.
+// `prefer_sparse` is the value-neutral per-link framing choice (the
+// adaptive policy ships sparse on cross-host links, dense on loopback).
+inline void ring_sparse_allreduce(RingLinks& links, int rank, int world,
+                                  float* buf, size_t count, bool average,
+                                  bool prefer_sparse, RingStats* stats,
+                                  SparseWire* wire) {
+  if (stats) stats->passes++;
+  auto bounds = offsets_of(split_counts(count, world));
+  auto mod = [&](int v) { return ((v % world) + world) % world; };
+  auto csize = [&](int c) {
+    return bounds[(size_t)c + 1] - bounds[(size_t)c];
+  };
+  auto chunk = [&](int c) { return buf + bounds[(size_t)c]; };
+  int c = mod(rank - 1);
+  TopkState state = topk_sparsify(chunk(c), csize(c));
+  for (int s = 1; s < world; s++) {
+    auto frame = topk_encode(state, csize(c), prefer_sparse);
+    if (wire) wire->hop(frame.size(), csize(c));
+    c = mod(rank - s - 1);
+    auto in = sparse_hop(links, frame, topk_frame_cap(csize(c)), stats);
+    TopkState st_in = topk_unpack(in.data(), in.size(), csize(c));
+    TopkState mine = topk_sparsify(chunk(c), csize(c));
+    topk_state_add(st_in, mine.idx, mine.val, csize(c));
+    state = std::move(st_in);
+  }
+  if (average) topk_state_scale(state, world);
+  topk_state_dense(state, csize(rank), chunk(rank));
+  auto cur = topk_encode(state, csize(rank), prefer_sparse);
+  c = rank;
+  for (int s = 1; s < world; s++) {
+    if (wire) wire->hop(cur.size(), csize(c));
+    c = mod(rank - s);
+    // Forward the frame verbatim next hop: every rank stores the identical
+    // f32 values whichever encoding carried them.
+    cur = sparse_hop(links, cur, topk_frame_cap(csize(c)), stats);
+    TopkState st = topk_unpack(cur.data(), cur.size(), csize(c));
+    topk_state_dense(st, csize(c), chunk(c));
+  }
+}
+
+// Two-level (hierarchical) sparse allreduce, mirroring engine.py
+// _HierPlane._sparse_allreduce: intra-host sparse reduce-scatter, L
+// parallel cross-host leaders rings on the local chunk, intra-host
+// allgather of the finished chunks. `sp_local`/`sp_cross` are the
+// per-fabric framing preferences (value-neutral).
+inline void grid_sparse_allreduce(RingLinks& local, RingLinks& cross,
+                                  int local_rank, int L, int cross_rank,
+                                  int C, float* buf, size_t count,
+                                  bool average, bool sp_local, bool sp_cross,
+                                  RingStats* stats, SparseWire* wire) {
+  if (stats) stats->passes++;
+  int world = L * C;
+  auto lb = offsets_of(split_counts(count, L));
+  auto lmod = [&](int v) { return ((v % L) + L) % L; };
+  auto cmod = [&](int v) { return ((v % C) + C) % C; };
+  auto lsize = [&](int i) { return lb[(size_t)i + 1] - lb[(size_t)i]; };
+  auto lchunk = [&](int i) { return buf + lb[(size_t)i]; };
+  int l = local_rank, c = cross_rank;
+
+  // -- stage 1: intra-host sparse reduce-scatter (fold start (i+1) % L) --
+  int i = lmod(l - 1);
+  TopkState state = topk_sparsify(lchunk(i), lsize(i));
+  for (int s = 1; s < L; s++) {
+    auto frame = topk_encode(state, lsize(i), sp_local);
+    if (wire) wire->hop(frame.size(), lsize(i));
+    i = lmod(l - s - 1);
+    auto in = sparse_hop(local, frame, topk_frame_cap(lsize(i)), stats);
+    TopkState st_in = topk_unpack(in.data(), in.size(), lsize(i));
+    TopkState mine = topk_sparsify(lchunk(i), lsize(i));
+    topk_state_add(st_in, mine.idx, mine.val, lsize(i));
+    state = std::move(st_in);
+  }
+  // `state` = this host's subtotal of local chunk l.
+
+  // -- stage 2: leaders ring allreduce of chunk l across hosts -----------
+  size_t nl = lsize(l);
+  auto cb = offsets_of(split_counts(nl, C));
+  auto csz = [&](int k) { return cb[(size_t)k + 1] - cb[(size_t)k]; };
+  int k = cmod(c - 1);
+  TopkState cstate = topk_state_slice(state, cb[(size_t)k],
+                                      cb[(size_t)k + 1]);
+  for (int s = 1; s < C; s++) {
+    auto frame = topk_encode(cstate, csz(k), sp_cross);
+    if (wire) wire->hop(frame.size(), csz(k));
+    k = cmod(c - s - 1);
+    auto in = sparse_hop(cross, frame, topk_frame_cap(csz(k)), stats);
+    TopkState st_in = topk_unpack(in.data(), in.size(), csz(k));
+    TopkState mine = topk_state_slice(state, cb[(size_t)k],
+                                      cb[(size_t)k + 1]);
+    if (mine.dense) mine = topk_sparsify(mine.dvals.data(), csz(k));
+    topk_state_add(st_in, mine.idx, mine.val, csz(k));
+    cstate = std::move(st_in);
+  }
+  if (average) topk_state_scale(cstate, world);
+  std::vector<float> fin_l(nl);
+  topk_state_dense(cstate, csz(c), fin_l.data() + cb[(size_t)c]);
+  auto cur = topk_encode(cstate, csz(c), sp_cross);
+  k = c;
+  for (int s = 1; s < C; s++) {
+    if (wire) wire->hop(cur.size(), csz(k));
+    k = cmod(c - s);
+    cur = sparse_hop(cross, cur, topk_frame_cap(csz(k)), stats);
+    TopkState st = topk_unpack(cur.data(), cur.size(), csz(k));
+    topk_state_dense(st, csz(k), fin_l.data() + cb[(size_t)k]);
+  }
+
+  // -- stage 3: intra-host allgather of finished local chunks ------------
+  std::memcpy(lchunk(l), fin_l.data(), nl * 4);
+  TopkState fin_sp = topk_sparsify(fin_l.data(), nl);
+  cur = topk_encode(fin_sp, nl, sp_local);
+  i = l;
+  for (int s = 1; s < L; s++) {
+    if (wire) wire->hop(cur.size(), lsize(i));
+    i = lmod(l - s);
+    cur = sparse_hop(local, cur, topk_frame_cap(lsize(i)), stats);
+    TopkState st = topk_unpack(cur.data(), cur.size(), lsize(i));
+    topk_state_dense(st, lsize(i), lchunk(i));
   }
 }
 

@@ -193,6 +193,30 @@ class ShmLink {
     return take;
   }
 
+  // Like try_consume, but hands the ring memory to `fn(src, len)` instead
+  // of memcpy-ing it out — the zero-copy reduce path (ISSUE 13) applies
+  // the add DIRECTLY from the shared segment into the accumulator chunk,
+  // skipping the scratch bounce entirely (one full read+write of the
+  // payload per ring pass). `fn` may be called twice (wrap point) and
+  // must consume every byte it is given.
+  template <typename Fn>
+  size_t try_consume_apply(size_t n, Fn&& fn) {
+    uint64_t tail = hdr_->tail.load(std::memory_order_relaxed);
+    uint64_t head = hdr_->head.load(std::memory_order_acquire);
+    size_t avail = (size_t)(head - tail);
+    size_t take = n < avail ? n : avail;
+    if (take == 0) return 0;
+    size_t at = (size_t)(tail & (cap_ - 1));
+    size_t first = std::min(take, cap_ - at);
+    fn(data_ + at, first);
+    if (take > first) fn(data_, take - first);
+    hdr_->tail.store(tail + take, std::memory_order_release);
+    hdr_->tail_seq.fetch_add(1, std::memory_order_seq_cst);  // see try_produce
+    if (hdr_->prod_waiters.load(std::memory_order_seq_cst) > 0)
+      futex_call(&hdr_->tail_seq, FUTEX_WAKE, 1, nullptr);
+    return take;
+  }
+
   // Move up to `n` bytes out of the ring; returns bytes read (0 = empty).
   size_t try_consume(uint8_t* p, size_t n) {
     uint64_t tail = hdr_->tail.load(std::memory_order_relaxed);

@@ -88,6 +88,13 @@ struct Request {
   OpType op = OpType::ALLREDUCE;
   DataType dtype = DataType::F32;       // wire/working dtype
   DataType orig_dtype = DataType::F32;  // caller dtype (== dtype when uncompressed)
+  // Sparse wire-format tag (ISSUE 13: the native topk plane): 0 = dense
+  // frames, 1 = topk indices+values frames (topk.h). A dtype cast changes
+  // dtype/orig_dtype; topk changes the FRAME of an f32 payload, so it
+  // needs its own signature facet — the python engine tags the same fact
+  // in its request dict's `wire` field ("topk"). Part of the cache key
+  // (cache.h) and of cross-rank validation, like the dtype pair.
+  uint8_t wire_fmt = 0;
   std::string name;
   int32_t root_rank = 0;
   uint8_t average = 1;
@@ -114,6 +121,7 @@ struct Request {
     w.u8((uint8_t)op);
     w.u8((uint8_t)dtype);
     w.u8((uint8_t)orig_dtype);
+    w.u8(wire_fmt);
     w.str(name);
     w.i32(root_rank);
     w.u8(average);
@@ -127,6 +135,7 @@ struct Request {
     q.op = (OpType)r.u8();
     q.dtype = (DataType)r.u8();
     q.orig_dtype = (DataType)r.u8();
+    q.wire_fmt = r.u8();
     q.name = r.str();
     q.root_rank = r.i32();
     q.average = r.u8();
@@ -214,6 +223,10 @@ struct ResponseEntry {
   // Coordinator-local scratch for the fusion planner (per-rank payload in
   // work-dtype bytes); never serialized.
   int64_t fused_nbytes = 0;
+  // Coordinator-local scratch: the validated wire_fmt of the contributions
+  // (sparse entries never fuse — every rank executes them from its own
+  // Request anyway); never serialized.
+  int64_t req_wire_fmt = 0;
 
   void write(Writer& w) const {
     w.u8((uint8_t)kind);
@@ -315,6 +328,8 @@ struct ResponseList {
 };
 
 // A completed tensor handed back to the caller through the handle table.
+// `data` is a Buffer (hvd_common.h): resize leaves it uninitialized —
+// every producer writes the payload in full.
 struct Response {
   enum Kind : uint8_t { OK = 0, ERROR = 1 };
   Kind kind = OK;
@@ -322,7 +337,7 @@ struct Response {
   std::string error;
   DataType dtype = DataType::F32;
   std::vector<int64_t> shape;
-  std::vector<uint8_t> data;
+  Buffer data;
 };
 
 }  // namespace hvd

@@ -56,7 +56,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from . import resilience
+from . import protocol, resilience
 from .config import Config, STALL_WARNING_TIME_S, _env_float
 from .policy import CompressionPolicy
 from .response_cache import CacheMirror, ResponseCache, request_key
@@ -194,21 +194,21 @@ class HandleManager:
 # --------------------------------------------------- canonical ring reduction
 
 def _chunk_bounds(n: int, world: int) -> list[int]:
-    """np.array_split boundaries for a flat array of n elements."""
-    base, rem = divmod(n, world)
-    bounds = [0]
-    for i in range(world):
-        bounds.append(bounds[-1] + base + (1 if i < rem else 0))
-    return bounds
+    """np.array_split boundaries for a flat array of n elements (the
+    canonical ring chunking — protocol.chunk_bounds)."""
+    return protocol.chunk_bounds(n, world)
 
 
 def _acc_start(chunk: np.ndarray) -> np.ndarray:
-    """Seed a chunk accumulator: float64 for floating dtypes (numerical
-    robustness of the old star reducer, kept), native width otherwise.
-    The seed is never mutated by either plane (adds allocate or land on
-    the received buffer), so same-width inputs pass through copy-free."""
-    if np.issubdtype(chunk.dtype, np.floating) and chunk.dtype != np.float64:
-        return chunk.astype(np.float64)
+    """Seed a chunk accumulator at NATIVE ring width (ISSUE 13): f32 adds
+    for f32 payloads, f64 for f64 — exactly the arithmetic cc/src/ring.h
+    add_chunk performs, which is what pins native == python bitwise for
+    uncompressed folds (and halves the f32 phase-1 hop bytes the old
+    float64 accumulator shipped). 16-bit float payloads never reach this:
+    they route through the implicit wire path (protocol.reduce_plan) and
+    round per hop like the native 16-bit storage does. The seed is never
+    mutated by either plane (adds allocate or land on the received
+    buffer), so inputs pass through copy-free."""
     return chunk
 
 
@@ -238,13 +238,24 @@ def _ring_order_reduce(arrs: list[np.ndarray], average: bool,
     ``wire_dtype`` (HOROVOD_COMPRESSION) simulates the compressed ring's
     wire hops exactly: every partial sum is rounded to the wire dtype
     before the next contribution lands (the reduce-scatter hop payload),
-    and the finished chunk is rounded once more (the allgather hop) so
-    every rank — including the chunk's owner — holds the identical
-    wire-representable value. Compressed accumulation runs at float32 —
-    the native engine's accumulate-in-fp32 (ring.h add_chunk) — which is
-    lossless relative to the per-hop 16-bit rounding and half the cast/add
-    cost of the float64 path; contributions were quantized at enqueue, so
-    viewing them at f32 drops no information either.
+    the finished partial is rounded once more BEFORE the average divide
+    (the storage round — the native ring's final add stores the partial at
+    wire width, ring.h add_chunk), and the finished chunk is rounded again
+    for the allgather so every rank — including the chunk's owner — holds
+    the identical wire-representable value. Compressed accumulation runs
+    at float32 — the native engine's accumulate-in-fp32 (ring.h
+    add_chunk) — which is lossless relative to the per-hop 16-bit rounding
+    and half the cast/add cost of a float64 path; contributions were
+    quantized at enqueue, so viewing them at f32 drops no information
+    either.
+
+    Uncompressed folds (ISSUE 13 unification) run at NATIVE ring width —
+    f32 adds for f32 payloads, f64 for f64 (protocol.reduce_plan) — and a
+    16-bit float payload with no explicit wire dtype implicitly hops at
+    its own width (per-hop rounding: storage between adds is 16-bit on
+    both engines). That is exactly the arithmetic cc/src/ring.h performs,
+    which is what lets the 4-proc matrix tests pin the native engine
+    bitwise to this oracle for none/bf16/fp16/topk alike.
 
     ``wire_dtype="topk"`` (ISSUE 9) is the SPARSE wire's canonical order:
     callers pass the already-sparsified dense contributions (enqueue-time
@@ -257,6 +268,10 @@ def _ring_order_reduce(arrs: list[np.ndarray], average: bool,
     dense fold."""
     if isinstance(wire_dtype, str) and wire_dtype == "topk":
         wire_dtype = np.dtype(np.float32)
+    if wire_dtype is None and arrs[0].dtype.name in ("float16", "bfloat16"):
+        # Implicit wire = self: 16-bit payloads round at every hop on both
+        # engines (native storage between adds is 16-bit, ring.h).
+        wire_dtype = arrs[0].dtype
     if grid is not None:
         return _grid_order_reduce(arrs, average, wire_dtype, grid)
     world = len(arrs)
@@ -281,6 +296,12 @@ def _ring_order_reduce(arrs: list[np.ndarray], average: bool,
                 # the receiver upcasts to accumulator width before adding.
                 acc = acc.astype(wire_dtype).astype(acc_dt)
             acc = acc + flats[(start + k) % world][lo:hi]
+        if wire_dtype is not None:
+            # Storage round: the native ring's final reduce-scatter add
+            # stores the partial at wire width; the average then divides
+            # the ROUNDED value on both engines. Idempotent for SUM folds
+            # (the allgather round below re-rounds the same value).
+            acc = acc.astype(wire_dtype).astype(acc_dt)
         fin = _acc_finish(acc, average, world, dtype)
         if wire_dtype is not None:
             fin = fin.astype(wire_dtype).astype(dtype)
@@ -306,6 +327,8 @@ def _grid_order_reduce(arrs: list[np.ndarray], average: bool,
     """
     if isinstance(wire_dtype, str) and wire_dtype == "topk":
         wire_dtype = np.dtype(np.float32)  # sparse wire: exact f32 fold
+    if wire_dtype is None and arrs[0].dtype.name in ("float16", "bfloat16"):
+        wire_dtype = arrs[0].dtype  # implicit wire = self (16-bit storage)
     L, C = int(grid[0]), int(grid[1])
     world = L * C
     if len(arrs) != world:
@@ -330,6 +353,11 @@ def _grid_order_reduce(arrs: list[np.ndarray], average: bool,
                 if wire_dtype is not None:
                     acc = acc.astype(wire_dtype).astype(acc_dt)
                 acc = acc + flats[c * L + (start + k) % L][lo:hi]
+            if wire_dtype is not None:
+                # Storage round: the intra-host reduce-scatter's final add
+                # stores the host subtotal at wire width on the native
+                # ladder; stage 2 folds the ROUNDED subtotals.
+                acc = acc.astype(wire_dtype).astype(acc_dt)
             partials.append(acc)
         # Stage 2: fold the host subtotals per cross subchunk (leaders ring).
         cb = _chunk_bounds(hi - lo, C)
@@ -341,6 +369,8 @@ def _grid_order_reduce(arrs: list[np.ndarray], average: bool,
                 if wire_dtype is not None:
                     acc = acc.astype(wire_dtype).astype(acc_dt)
                 acc = acc + partials[(cstart + j) % C][s:e]
+            if wire_dtype is not None:
+                acc = acc.astype(wire_dtype).astype(acc_dt)  # storage round
             fin = _acc_finish(acc, average, world, dtype)
             if wire_dtype is not None:
                 fin = fin.astype(wire_dtype).astype(dtype)
@@ -659,9 +689,16 @@ class _PeerRing:
             return arr
         if isinstance(wire_dtype, str) and wire_dtype == "topk":
             return self._sparse_allreduce(arr, average, sparse_tiers)
+        # Implicit wire = self for 16-bit float payloads (protocol.
+        # reduce_plan): hops round per step like the native 16-bit storage
+        # does; no compression telemetry — nothing was compressed.
+        count_wire = wire_dtype is not None
+        if wire_dtype is None and arr.dtype.name in ("float16", "bfloat16"):
+            wire_dtype = arr.dtype
         flat = arr.ravel()
         bounds = _chunk_bounds(flat.size, world)
         acc_dt = _acc_start(flat[:0]).dtype  # uncompressed phase-1 width
+        native_itemsize = int(arr.dtype.itemsize)
         if wire_dtype is not None:
             # Compressed accumulate-in-fp32 (native ring.h parity; same
             # rounding chain as the oracle): the enqueue-time quantization
@@ -695,10 +732,11 @@ class _PeerRing:
             else:
                 w = part.astype(wire_dtype)
                 self._send(w)
-                self._on_wire(
-                    int(w.nbytes),
-                    int(w.size) * int(acc_dt.itemsize) - int(w.nbytes),
-                    _wire_method(wire_dtype))
+                if count_wire:
+                    self._on_wire(
+                        int(w.nbytes),
+                        int(w.size) * native_itemsize - int(w.nbytes),
+                        _wire_method(wire_dtype))
             c = (rank - s - 1) % world
             if wire_dtype is None:
                 part = self._recv(acc_dt, csize(c))
@@ -714,6 +752,11 @@ class _PeerRing:
             if trace:
                 trace.span(ctx["tid"], ctx["name"], "allreduce", "reduce",
                            r0, time.monotonic_ns(), hop=s)
+        if wire_dtype is not None:
+            # Storage round (protocol.reduce_plan): the native ring's final
+            # reduce-scatter add stores the partial at wire width; average
+            # divides the rounded value on both engines.
+            part = part.astype(wire_dtype).astype(wire_acc)
         mine = _acc_finish(part, average, world, arr.dtype)
         out = np.empty_like(flat)
         if wire_dtype is None:
@@ -727,13 +770,13 @@ class _PeerRing:
         else:
             cur_w = mine.astype(wire_dtype)
             out[bounds[rank]:bounds[rank + 1]] = cur_w.astype(arr.dtype)
-            native_itemsize = arr.dtype.itemsize
             for s in range(1, world):
                 self._send(cur_w)
-                self._on_wire(
-                    int(cur_w.nbytes),
-                    int(cur_w.size * native_itemsize - cur_w.nbytes),
-                    _wire_method(wire_dtype))
+                if count_wire:
+                    self._on_wire(
+                        int(cur_w.nbytes),
+                        int(cur_w.size * native_itemsize - cur_w.nbytes),
+                        _wire_method(wire_dtype))
                 c = (rank - s) % world
                 # Forward the wire bytes verbatim: re-rounding an already
                 # wire-representable chunk is the identity, so every rank
@@ -775,9 +818,9 @@ class _PeerRing:
             frame = topk_encode(state, csize(c), prefer)
             self._send(frame)
             # Saved vs what the UNCOMPRESSED plane ships on this hop:
-            # accumulator-width (f64) phase-1 partials.
+            # native-width (f32) phase-1 partials (protocol.reduce_plan).
             self._on_wire(int(frame.nbytes),
-                          max(0, csize(c) * 8 - int(frame.nbytes)), "topk")
+                          max(0, csize(c) * 4 - int(frame.nbytes)), "topk")
             c = (rank - s - 1) % world
             st_in = topk_unpack(self._links.recv_raw(), csize(c))
             r0 = time.monotonic_ns() if trace else 0
@@ -900,6 +943,11 @@ class _HierPlane:
         arr = np.ascontiguousarray(arr)
         if isinstance(wire_dtype, str) and wire_dtype == "topk":
             return self._sparse_allreduce(arr, average, sparse_tiers)
+        # Implicit wire = self for 16-bit float payloads; no compression
+        # telemetry for it (protocol.reduce_plan, same as the flat ring).
+        count_wire = wire_dtype is not None
+        if wire_dtype is None and arr.dtype.name in ("float16", "bfloat16"):
+            wire_dtype = arr.dtype
         L, C, world = self.L, self.C, self.world
         l, c = self.topo.local_rank, self.topo.cross_rank
         flat = arr.ravel()
@@ -930,16 +978,18 @@ class _HierPlane:
             part = _acc_start(lchunk((l - 1) % L))
         else:
             part = lchunk((l - 1) % L)
+        native_itemsize = int(arr.dtype.itemsize)
         for s in range(1, L):
             if wire_dtype is None:
                 self._local.send(part)
             else:
                 w = part.astype(wire_dtype)
                 self._local.send(w)
-                self._on_wire(
-                    int(w.nbytes),
-                    int(w.size) * int(acc_dt.itemsize) - int(w.nbytes),
-                    _wire_method(wire_dtype))
+                if count_wire:
+                    self._on_wire(
+                        int(w.nbytes),
+                        int(w.size) * native_itemsize - int(w.nbytes),
+                        _wire_method(wire_dtype))
             i = (l - s - 1) % L
             if wire_dtype is None:
                 part = self._local.recv(acc_dt, lsize(i))
@@ -948,6 +998,10 @@ class _HierPlane:
             r0 = time.monotonic_ns() if trace else 0
             part += lchunk(i)
             _reduce_span(r0, "local", s)
+        if wire_dtype is not None:
+            # Storage round: the native ladder stores the host subtotal at
+            # wire width after the intra-host reduce-scatter's final add.
+            part = part.astype(wire_dtype).astype(wire_acc)
         # `part` = this host's subtotal of local chunk l, accumulator width.
 
         # -- stage 2: leaders ring allreduce of chunk l across hosts ------
@@ -967,10 +1021,11 @@ class _HierPlane:
             else:
                 w = cpart.astype(wire_dtype)
                 self._cross.send(w)
-                self._on_wire(
-                    int(w.nbytes),
-                    int(w.size) * int(acc_dt.itemsize) - int(w.nbytes),
-                    _wire_method(wire_dtype))
+                if count_wire:
+                    self._on_wire(
+                        int(w.nbytes),
+                        int(w.size) * native_itemsize - int(w.nbytes),
+                        _wire_method(wire_dtype))
             i = (c - s - 1) % C
             if wire_dtype is None:
                 cpart = self._cross.recv(acc_dt, csz(i))
@@ -979,9 +1034,10 @@ class _HierPlane:
             r0 = time.monotonic_ns() if trace else 0
             cpart += cchunk(i)
             _reduce_span(r0, "cross", s)
+        if wire_dtype is not None:
+            cpart = cpart.astype(wire_dtype).astype(wire_acc)  # storage round
         mine = _acc_finish(cpart, average, world, arr.dtype)
         fin_l = np.empty(nl, dtype=arr.dtype)
-        native_itemsize = int(arr.dtype.itemsize)
         if wire_dtype is None:
             fin_l[cb[c]:cb[c + 1]] = mine
             cur = mine
@@ -998,10 +1054,11 @@ class _HierPlane:
             fin_l[cb[c]:cb[c + 1]] = cur_w.astype(arr.dtype)
             for s in range(1, C):
                 self._cross.send(cur_w)
-                self._on_wire(
-                    int(cur_w.nbytes),
-                    int(cur_w.size * native_itemsize - cur_w.nbytes),
-                    _wire_method(wire_dtype))
+                if count_wire:
+                    self._on_wire(
+                        int(cur_w.nbytes),
+                        int(cur_w.size * native_itemsize - cur_w.nbytes),
+                        _wire_method(wire_dtype))
                 i = (c - s) % C
                 cur_w = self._cross.recv(wire_dtype, csz(i))
                 fin_l[cb[i]:cb[i + 1]] = cur_w.astype(arr.dtype)
@@ -1020,10 +1077,11 @@ class _HierPlane:
             cur_w = fin_l.astype(wire_dtype)  # exact: values wire-representable
             for s in range(1, L):
                 self._local.send(cur_w)
-                self._on_wire(
-                    int(cur_w.nbytes),
-                    int(cur_w.size * native_itemsize - cur_w.nbytes),
-                    _wire_method(wire_dtype))
+                if count_wire:
+                    self._on_wire(
+                        int(cur_w.nbytes),
+                        int(cur_w.size * native_itemsize - cur_w.nbytes),
+                        _wire_method(wire_dtype))
                 i = (l - s) % L
                 cur_w = self._local.recv(wire_dtype, lsize(i))
                 out[lb[i]:lb[i + 1]] = cur_w.astype(arr.dtype)
@@ -1068,7 +1126,7 @@ class _HierPlane:
             frame = topk_encode(state, lsize(i), sp_local)
             self._local.send(frame)
             self._on_wire(int(frame.nbytes),
-                          max(0, lsize(i) * 8 - int(frame.nbytes)), "topk")
+                          max(0, lsize(i) * 4 - int(frame.nbytes)), "topk")
             i = (l - s - 1) % L
             st_in = topk_unpack(self._local.recv_raw(), lsize(i))
             r0 = time.monotonic_ns() if trace else 0
@@ -1090,7 +1148,7 @@ class _HierPlane:
             frame = topk_encode(cstate, csz(k), sp_cross)
             self._cross.send(frame)
             self._on_wire(int(frame.nbytes),
-                          max(0, csz(k) * 8 - int(frame.nbytes)), "topk")
+                          max(0, csz(k) * 4 - int(frame.nbytes)), "topk")
             k = (c - s - 1) % C
             st_in = topk_unpack(self._cross.recv_raw(), csz(k))
             r0 = time.monotonic_ns() if trace else 0
@@ -3091,8 +3149,19 @@ def create(topo: Topology, config: Config):
     native first; ``native!`` raises instead of falling back. In
     multi-process worlds the fallback is NOT silent: the two engines speak
     different wire protocols, so a mixed world would hang — every rank must
-    make the same choice, hence build failures raise there."""
-    impl = os.environ.get("HOROVOD_ENGINE", "native").lower()
+    make the same choice, hence build failures raise there.
+
+    ``HOROVOD_NATIVE_DATA_PLANE`` (ISSUE 13) is the docs-level name for the
+    same choice, spelled as what it buys: 1 (the default whenever
+    libhvd_core.so loads) keeps the eager byte path — framing, bf16/fp16
+    rounding, topk select/pack/index-merge, canonical-order reduce — in the
+    native core, with Python handing the engine a buffer pointer and never
+    touching tensor bytes; 0 runs the pure-Python reference plane. An
+    explicit ``HOROVOD_ENGINE`` wins when both are set."""
+    impl = (os.environ.get("HOROVOD_ENGINE") or "").lower()
+    if not impl:
+        ndp = os.environ.get("HOROVOD_NATIVE_DATA_PLANE", "1")
+        impl = "python" if ndp in ("0", "false") else "native"
     if impl not in ("native", "native!", "python"):
         log("warning", f"unknown HOROVOD_ENGINE={impl!r}; using 'native'")
         impl = "native"

@@ -167,6 +167,16 @@ class CompressionPolicy:
         }
 
 
+def compiled_tier_format(nbytes: int, dtype, tier: str) -> str:
+    """The compiled plane's per-bucket tier resolve (ISSUE 13 satellite):
+    the SAME value-changing table the eager engines evaluate per tensor,
+    applied to one fused bucket on one fabric tier. Returns a format NAME
+    ('none'/'bf16'/'topk') — the caller substitutes the nearest servable
+    dense format for 'topk' (XLA collectives cannot ship runtime-sparse
+    frames) and counts that fallback. Evaluated at trace time only."""
+    return CompressionPolicy().decide(int(nbytes), dtype, tier)
+
+
 def resolve_format(compression: Optional[str], policy,
                    nbytes: int, dtype) -> str:
     """One-stop eager-side resolution: an explicit HOROVOD_COMPRESSION name

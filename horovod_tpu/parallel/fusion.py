@@ -273,48 +273,38 @@ def fused_allreduce(
     bucket cap becomes ``dcn_threshold * ici_size``; None reads
     HOROVOD_DCN_FUSION_THRESHOLD, 0 = no separate cap). The per-tier plan
     lands in trace-time gauges (metrics.record_tier_plan)."""
-    # Policy names resolve to concrete dense formats here (ISSUE 9): the
-    # compiled plane can't ship runtime-sparse frames (XLA collectives have
-    # static shapes), so 'topk' runs dense — LOUDLY — and 'adaptive'
-    # substitutes its compiled tier table: full width on ICI, bf16 on the
-    # hierarchical ladder's DCN psum (compression.compiled_formats).
+    # Policy names resolve to concrete dense formats here (ISSUE 9 + 13):
+    # the compiled plane can't ship runtime-sparse frames (XLA collectives
+    # have static shapes), so 'topk' runs dense — LOUDLY. 'adaptive' now
+    # reads the FIRST-CLASS per-tier table from common/policy.py: the ICI
+    # tier resolves here, the DCN tier resolves per fused bucket below
+    # (same (size, dtype, tier) inputs the eager engines evaluate per
+    # tensor); only a tier whose table answer is the genuinely unservable
+    # 'topk' counts a fallback and substitutes bf16 (ROADMAP satellite).
     _comp_name = compression_name(compression)
-    if _comp_name in ("topk", "adaptive"):
+    _adaptive = _comp_name == "adaptive"
+    if _comp_name == "topk":
+        global _TOPK_COMPILED_WARNED
+        if not _TOPK_COMPILED_WARNED:
+            _TOPK_COMPILED_WARNED = True
+            from ..utils.logging import log
+
+            log("warning",
+                "HOROVOD_COMPRESSION=topk applies to the eager engines "
+                "only; the compiled plane ships dense buckets (use "
+                "bf16/adaptive for a compiled-plane wire cut)")
         _ici_fmt, _dcn_fmt = compiled_formats(_comp_name)
-        if _comp_name == "topk":
-            global _TOPK_COMPILED_WARNED
-            if not _TOPK_COMPILED_WARNED:
-                _TOPK_COMPILED_WARNED = True
-                from ..utils.logging import log
-
-                log("warning",
-                    "HOROVOD_COMPRESSION=topk applies to the eager engines "
-                    "only; the compiled plane ships dense buckets (use "
-                    "bf16/adaptive for a compiled-plane wire cut)")
-        if _comp_name == "adaptive":
-            from ..metrics import registry as _metrics_registry
-
-            _metrics_registry().counter(
-                "horovod_compiled_adaptive_fallback_total",
-                help="compiled-plane traces where 'adaptive' fell back to "
-                     "its dense tier table (ici=none, dcn=bf16) because "
-                     "XLA collectives cannot ship runtime-sparse topk "
-                     "frames").inc()
-            global _ADAPTIVE_COMPILED_WARNED
-            if not _ADAPTIVE_COMPILED_WARNED:
-                _ADAPTIVE_COMPILED_WARNED = True
-                from ..utils.logging import log
-
-                log("warning",
-                    "HOROVOD_COMPRESSION=adaptive on the compiled plane "
-                    "falls back to its dense tier table (ici=none, "
-                    "dcn=bf16): topk tiers are eager-only "
-                    "(horovod_compiled_adaptive_fallback_total counts "
-                    "these traces)")
         if dcn_compression is None:
             dcn_compression = (os.environ.get("HOROVOD_DCN_COMPRESSION", "")
                                or _dcn_fmt)
         compression = _ici_fmt
+    elif _adaptive:
+        from ..common.policy import compiled_tier_format
+
+        # ICI: the table is size-independent on the fast fabric (full
+        # width); resolved through the policy module all the same so a
+        # future table change lands here without code edits.
+        compression = compiled_tier_format(1 << 30, jnp.float32, "ici")
     pad_to = 1
     if hierarchical and op not in (collectives.ReduceOp.SUM,
                                    collectives.ReduceOp.AVERAGE):
@@ -383,21 +373,66 @@ def fused_allreduce(
     # 16-bit ICI wire opts out (nothing narrower to gain), and all the
     # per-bucket opt-outs of wire_dtype_for_bucket apply unchanged.
     dcn_wire = [None] * len(buffers)
+    _dcn_plan_name = ""
     if hierarchical:
-        if dcn_compression is None:
-            dcn_compression = (os.environ.get("HOROVOD_DCN_COMPRESSION", "")
-                               or compression)
-        dcn_wire = [wire_dtype_for_bucket(dcn_compression, buf.dtype,
-                                          int(buf.nbytes), op,
-                                          compression_min_bytes)
-                    for buf in buffers]
+        if (_adaptive and dcn_compression is None
+                and not os.environ.get("HOROVOD_DCN_COMPRESSION", "")):
+            # Adaptive DCN tier, per fused bucket (ISSUE 13 satellite): the
+            # policy table answers with the same (size, dtype, tier) inputs
+            # the eager engines use. Only the genuinely unservable 'topk'
+            # answer counts a fallback (XLA collectives cannot ship
+            # runtime-sparse frames) and substitutes the bf16 cast.
+            from ..common.policy import compiled_tier_format
+
+            _fmts = []
+            _fallbacks = 0
+            for buf in buffers:
+                fmt = compiled_tier_format(int(buf.nbytes), buf.dtype, "dcn")
+                if fmt == "topk":
+                    _fallbacks += 1
+                    fmt = "bf16"
+                _fmts.append(fmt)
+            dcn_wire = [wire_dtype_for_bucket(f, buf.dtype, int(buf.nbytes),
+                                              op, compression_min_bytes)
+                        for f, buf in zip(_fmts, buffers)]
+            _dcn_plan_name = "adaptive"
+            if _fallbacks:
+                from ..metrics import registry as _metrics_registry
+
+                _metrics_registry().counter(
+                    "horovod_compiled_adaptive_fallback_total",
+                    help="compiled-plane traces where an 'adaptive' DCN "
+                         "tier resolved to the unservable topk format and "
+                         "substituted the bf16 cast (XLA collectives "
+                         "cannot ship runtime-sparse frames)").inc()
+                global _ADAPTIVE_COMPILED_WARNED
+                if not _ADAPTIVE_COMPILED_WARNED:
+                    _ADAPTIVE_COMPILED_WARNED = True
+                    from ..utils.logging import log
+
+                    log("warning",
+                        "HOROVOD_COMPRESSION=adaptive: the policy table "
+                        "picked topk for a compiled DCN bucket; the "
+                        "compiled plane ships the bf16 cast instead — "
+                        "topk frames are eager-only "
+                        "(horovod_compiled_adaptive_fallback_total counts "
+                        "these traces)")
+        else:
+            if dcn_compression is None:
+                dcn_compression = (
+                    os.environ.get("HOROVOD_DCN_COMPRESSION", "")
+                    or compression)
+            dcn_wire = [wire_dtype_for_bucket(dcn_compression, buf.dtype,
+                                              int(buf.nbytes), op,
+                                              compression_min_bytes)
+                        for buf in buffers]
+            _dcn_plan_name = compression_name(dcn_compression)
     from ..metrics import record_tier_plan
 
     record_tier_plan(
         hierarchical,
         ici_wire=compression_name(compression),
-        dcn_wire=(compression_name(dcn_compression) if hierarchical
-                  else ""),
+        dcn_wire=_dcn_plan_name,
         ici_size=pad_to,
         bucket_bytes=[int(b.nbytes) for b in buffers],
         dcn_bucket_bytes=[

@@ -1006,11 +1006,13 @@ def test_residual_not_double_folded_across_demotion(compression):
         "demotion escalated to an elastic reset")
 
 
-def test_compiled_adaptive_fallback_counted(mesh8):
-    """ISSUE 12 satellite: 'adaptive' on the compiled plane substitutes
-    its dense tier table — each substituting trace increments
-    horovod_compiled_adaptive_fallback_total so the fallback is visible
-    in pod snapshots, not just in a warn-once log line."""
+def test_compiled_adaptive_reads_policy_tier_table(mesh_2x4):
+    """ISSUE 13 satellite (ROADMAP known-satellite): compiled-plane
+    'adaptive' reads the FIRST-CLASS per-tier table from common/policy.py
+    — a DCN bucket large enough for the table to answer 'topk' (the
+    genuinely unservable format) substitutes bf16 AND counts a fallback;
+    a bucket whose table answer is already servable (bf16) compresses the
+    DCN hop with NO fallback counted."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -1021,27 +1023,51 @@ def test_compiled_adaptive_fallback_counted(mesh8):
 
     counter = hvd_metrics.registry().counter(
         "horovod_compiled_adaptive_fallback_total",
-        help="compiled-plane traces where 'adaptive' fell back to "
-             "its dense tier table (ici=none, dcn=bf16) because "
-             "XLA collectives cannot ship runtime-sparse topk frames")
+        help="compiled-plane traces where an 'adaptive' DCN tier resolved "
+             "to the unservable topk format and substituted the bf16 cast "
+             "(XLA collectives cannot ship runtime-sparse frames)")
     before = counter.value
-    tree = {"a": jnp.arange(1024, dtype=jnp.float32) / 7}
 
-    def run(compression):
-        f = lambda t: fusion.fused_allreduce(  # noqa: E731
-            t, "hvd", threshold=1 << 20, compression=compression)
-        return jax.jit(shard_map(f, mesh=mesh8, in_specs=(P(),),
-                                 out_specs=P(), check_vma=False))(tree)
+    def run(n, hierarchical, compression="adaptive"):
+        x = np.arange(8 * n, dtype=np.float32).reshape(8, n) / 3.0
 
-    run("adaptive")
+        def body(t):
+            (out,) = fusion.fused_allreduce(
+                [jnp.squeeze(t, 0)], ("dcn", "ici"), threshold=1 << 26,
+                hierarchical=hierarchical, compression=compression)
+            return out[None]
+
+        f = shard_map(body, mesh=mesh_2x4, in_specs=P(("dcn", "ici")),
+                      out_specs=P(("dcn", "ici")))
+        np.asarray(jax.jit(f)(x))
+        return hvd_metrics.last_tier_plan()
+
+    # Large f32 bucket (>= HOROVOD_TOPK_MIN_BYTES): the table says topk on
+    # DCN -> unservable -> bf16 substituted, fallback counted per trace.
+    plan = run(1 << 16, hierarchical=True)
     assert counter.value == before + 1, \
-        "adaptive substitution did not increment the fallback counter"
-    run("bf16")
+        "unservable topk tier did not count a fallback"
+    assert plan["dcn_wire"] == "adaptive"
+    ici = plan["bytes_per_step"]["ici"]
+    assert plan["bytes_per_step"]["dcn"] == ici // 4 // 2, plan
+
+    # Mid-size bucket (>= min_bytes, < topk_min_bytes): the table answers
+    # bf16 — servable as-is, DCN hop compressed, NO fallback counted.
+    plan = run(2048, hierarchical=True)
     assert counter.value == before + 1, \
-        "a non-adaptive trace must not touch the fallback counter"
-    run("adaptive")
-    assert counter.value == before + 2, \
-        "the counter fires per substituting trace, not warn-once"
+        "a servable bf16 tier must not count a fallback"
+    ici = plan["bytes_per_step"]["ici"]
+    assert plan["bytes_per_step"]["dcn"] == ici // 4 // 2, plan
+
+    # Flat (non-hierarchical) adaptive: no DCN psum exists, nothing is
+    # unservable — ICI resolves full width through the same table.
+    run(1 << 16, hierarchical=False)
+    assert counter.value == before + 1, \
+        "flat adaptive has no unservable tier to count"
+
+    # Non-adaptive traces never touch the counter.
+    run(1 << 16, hierarchical=True, compression="bf16")
+    assert counter.value == before + 1
 
 
 def test_autotune_topk_ratio_joins_compression_dimension():

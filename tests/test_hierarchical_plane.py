@@ -216,9 +216,11 @@ def test_hier_plane_matches_grid_oracle_and_cuts_cross_bytes():
     hier_cross = max(o["tier_cross"] for o in hier)
     assert flat_cross > 0
     assert hier_cross <= 0.35 * flat_cross, (hier_cross, flat_cross)
-    # free-form f32 payloads of this size sum exactly in the f64
-    # accumulator, so the planes agree bitwise here too
-    assert {o["hash"] for o in flat} == {hier[0]["hash"]}
+    # Free-form f32 payloads: every plane is pinned to ITS canonical
+    # oracle (flat fold vs grid fold — native-width f32 accumulation,
+    # ISSUE 13), so the flat ranks must agree among themselves; cross-
+    # plane identity is the exact-arithmetic test below.
+    assert len({o["hash"] for o in flat}) == 1
 
 
 def test_flat_hier_star_bitwise_with_bf16_and_error_feedback():

@@ -1,0 +1,445 @@
+"""The shared protocol core (ISSUE 13): spec conformance, the state
+machine, golden protocol-trace replay against BOTH engines, and the
+4-proc native==python bitwise matrix pinned to the canonical oracles.
+
+Three layers of the same contract:
+
+- ``common/protocol.py`` is the importable copy of the machine-extracted
+  ``docs/protocol_spec.json`` — :func:`verify_spec` must return zero
+  mismatches (the analyzer re-checks this in CI; here it runs in-process
+  so a drift fails the unit tier too, naming the first divergent table).
+- The :class:`protocol.Machine` validates negotiation/cache/demote
+  transition traces; golden traces replay clean, corrupted ones fail
+  naming the FIRST bad transition.
+- Real engines: scripted op sequences drive the Python and the native
+  engine through identical cache lifecycles (miss/bind, steady-state
+  hits, shape-change rebind, flush + re-learn), and the observed
+  transition streams must agree with the golden trace and with each
+  other; the bitwise matrix runs {none, bf16, fp16, topk} through
+  {python-star, python-ring, python-hier, native-flat, native-hier} on
+  4-proc worlds and pins every result to the
+  ``_ring_order_reduce``/``_grid_order_reduce`` oracles.
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from launch_util import launch_world
+
+from horovod_tpu.common import protocol
+from horovod_tpu.common.engine import _ring_order_reduce
+from horovod_tpu.compression import (
+    topk_densify,
+    topk_k,
+    topk_select,
+)
+
+# --------------------------------------------------------- spec conformance
+
+
+def test_protocol_core_matches_generated_spec():
+    """common/protocol.py == docs/protocol_spec.json, entry by entry. A
+    drift names the first mismatching table, not a downstream symptom."""
+    mismatches = protocol.verify_spec()
+    assert mismatches == [], "\n".join(mismatches)
+
+
+def test_chunk_bounds_matches_engine():
+    from horovod_tpu.common.engine import _chunk_bounds
+
+    for n, w in [(0, 4), (7, 4), (8, 4), (30011, 4), (5, 8)]:
+        assert protocol.chunk_bounds(n, w) == _chunk_bounds(n, w)
+        counts = np.diff(protocol.chunk_bounds(n, w))
+        split = [len(c) for c in np.array_split(np.zeros(n), w)]
+        assert list(counts) == split
+
+
+def test_fold_order_covers_every_rank_once():
+    for world in (2, 3, 4, 8):
+        for c in range(world):
+            order = protocol.fold_order(c, world)
+            assert sorted(order) == list(range(world))
+            assert order[0] == protocol.fold_start(c, world)
+            # the fold ENDS on the chunk's owner: rank c holds the result
+            assert order[-1] == c
+
+
+def test_reduce_plan_canonical_semantics():
+    import ml_dtypes
+
+    # uncompressed: native ring width — f32 adds for f32, f64 for f64
+    assert protocol.reduce_plan(np.float32) == {
+        "acc": np.dtype(np.float32), "hop": None, "storage_round": False}
+    assert protocol.reduce_plan(np.float64)["acc"] == np.dtype(np.float64)
+    # 16-bit payloads: implicit wire = self, per-hop rounding
+    p = protocol.reduce_plan(np.float16)
+    assert p["hop"] == np.dtype(np.float16) and p["storage_round"]
+    p = protocol.reduce_plan(ml_dtypes.bfloat16)
+    assert p["hop"] == np.dtype(ml_dtypes.bfloat16)
+    # explicit wire: f32 accumulator, rounded hops + storage round
+    p = protocol.reduce_plan(np.float32, np.dtype(ml_dtypes.bfloat16))
+    assert p["acc"] == np.dtype(np.float32) and p["storage_round"]
+    # sparse: exact f32 fold
+    assert protocol.reduce_plan(np.float32, "topk")["hop"] == "topk"
+
+
+# ------------------------------------------------------------ state machine
+
+KEY_A = ("a", "allreduce", "float32", (8,), 0, True, None)
+KEY_A2 = ("a", "allreduce", "float32", (16,), 0, True, None)
+
+
+def _golden_cache_trace():
+    """The canonical 2-rank cache lifecycle: full negotiation + bind,
+    steady-state cached ticks, shape-change rebind, flush + re-learn."""
+    return [
+        ("tick_full", 0, KEY_A), ("tick_full", 1, KEY_A),
+        ("assign", 0, KEY_A), ("execute", KEY_A),
+        ("tick_cached", 0, KEY_A), ("tick_cached", 1, KEY_A),
+        ("execute", KEY_A),
+        # shape change: the stale bit evicts everywhere, the new signature
+        # binds fresh
+        ("tick_full", 0, KEY_A2), ("tick_full", 1, KEY_A2),
+        ("evict", 0), ("assign", 1, KEY_A2), ("execute", KEY_A2),
+        # rank 0 flushes its mirror: it must re-learn from a full request
+        # + re-announcement before its next cached tick
+        ("flush", 0),
+        ("tick_full", 0, KEY_A2), ("tick_cached", 1, KEY_A2),
+        ("assign", 1, KEY_A2),  # mirror re-heal: same (bit, key) re-announce
+        ("execute", KEY_A2),
+        ("tick_cached", 0, KEY_A2), ("tick_cached", 1, KEY_A2),
+        ("execute", KEY_A2),
+    ]
+
+
+def test_golden_cache_trace_replays_clean():
+    trace = _golden_cache_trace()
+    assert protocol.replay(trace, world=2) == len(trace) == 20
+
+
+def test_demote_redo_trace_replays_clean():
+    trace = [
+        ("tick_full", 0, KEY_A), ("tick_full", 1, KEY_A),
+        ("assign", 0, KEY_A), ("execute", KEY_A),
+        ("demote", 0), ("demote", 1),
+        ("redo", KEY_A),
+        ("repromote", 0), ("repromote", 1),
+    ]
+    assert protocol.replay(trace, world=2) == len(trace)
+
+
+@pytest.mark.parametrize("mutate, bad_index, why", [
+    # cached tick before any bind
+    (lambda t: [("tick_cached", 0, KEY_A)] + t, 0, "no bound bit"),
+    # bit re-bound to a different key without an evict
+    (lambda t: t[:4] + [("assign", 0, KEY_A2)] + t[4:], 4, "already bound"),
+    # execute with a missing rank's contribution
+    (lambda t: t[:2] + [("execute", KEY_A), ("execute", KEY_A)] + t[3:],
+     3, "contributions"),
+    # cached tick after a flush, before the re-announcement
+    (lambda t: t[:13] + [("tick_cached", 0, KEY_A2)] + t[13:],
+     13, "mirror learned"),
+    # redo replay with no demotion epoch open
+    (lambda t: t + [("redo", KEY_A2)], 20, "outside a demotion"),
+])
+def test_corrupted_traces_name_first_bad_transition(mutate, bad_index, why):
+    trace = mutate(_golden_cache_trace())
+    with pytest.raises(protocol.ProtocolViolation) as e:
+        protocol.replay(trace, world=2)
+    assert e.value.index == bad_index, (e.value.index, str(e.value))
+    assert why in str(e.value)
+
+
+# ------------------------------------- golden trace replay, real engines
+
+# Scripted cache lifecycle driven through a REAL 2-proc engine; rank 0
+# reports the observed transition stream as (hit|miss, mirror size)
+# symbols. Identical script for both engines — their streams must match
+# the golden and each other.
+CACHE_TRACE_WORKER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import create
+from horovod_tpu.common.topology import Topology
+
+rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+eng = create(Topology(rank, world, 0, 1, rank, world),
+             Config(cycle_time_ms=1.0, stall_check_disable=True))
+try:
+    stream = []
+
+    def observe(step):
+        before = eng.cache_stats()["mirror"]
+        step()
+        after = eng.cache_stats()["mirror"]
+        stream.append([
+            "hit" if after["hits"] > before["hits"] else "miss",
+            int(before["size"]), int(after["size"])])
+
+    a8 = np.arange(8, dtype=np.float32) * (rank + 1)
+    a16 = np.arange(16, dtype=np.float32) * (rank + 1)
+    observe(lambda: eng.run("allreduce", a8, "a"))      # miss, bind
+    observe(lambda: eng.run("allreduce", a8, "a"))      # steady-state hit
+    observe(lambda: eng.run("allreduce", a8, "a"))      # hit
+    observe(lambda: eng.run("allreduce", a16, "a"))     # shape change: rebind
+    observe(lambda: eng.run("allreduce", a16, "a"))     # hit under new key
+    eng.cache_flush()                                   # rank-local flush
+    observe(lambda: eng.run("allreduce", a16, "a"))     # re-learn (full req)
+    observe(lambda: eng.run("allreduce", a16, "a"))     # healed: hit again
+    print(json.dumps({"rank": rank, "stream": stream}))
+finally:
+    eng.shutdown()
+"""
+
+# What both engines must observe, symbol by symbol (rank 0's view):
+GOLDEN_STREAM = [
+    ["miss", 0, 1],   # full negotiation, bit bound
+    ["hit", 1, 1],    # steady state
+    ["hit", 1, 1],
+    ["miss", 1, 1],   # shape change: evict + fresh bind (net size 0)
+    ["hit", 1, 1],
+    ["miss", 0, 1],   # flushed mirror re-learns from the re-announcement
+    ["hit", 1, 1],
+]
+
+
+@pytest.mark.parametrize("engine", ["python", "native!"])
+def test_golden_trace_replays_through_engine(engine):
+    outs = [r["out"] for r in launch_world(
+        2, CACHE_TRACE_WORKER, extra_env={"HOROVOD_ENGINE": engine})]
+    stream = next(o["stream"] for o in outs if o["rank"] == 0)
+    for i, (got, want) in enumerate(zip(stream, GOLDEN_STREAM)):
+        assert got == want, (
+            f"{engine} engine diverged at transition {i}: observed {got}, "
+            f"golden {want} (full stream: {stream})")
+    assert len(stream) == len(GOLDEN_STREAM)
+
+
+def test_both_engines_produce_identical_transition_streams():
+    streams = {}
+    for engine in ("python", "native!"):
+        outs = [r["out"] for r in launch_world(
+            2, CACHE_TRACE_WORKER, extra_env={"HOROVOD_ENGINE": engine})]
+        streams[engine] = next(
+            o["stream"] for o in outs if o["rank"] == 0)
+    py, nat = streams["python"], streams["native!"]
+    assert len(py) == len(nat)
+    for i, (p, n) in enumerate(zip(py, nat)):
+        assert p == n, (
+            f"engines diverged at transition {i}: python {p} vs native {n}")
+
+
+# ---------------------------------------- 4-proc bitwise matrix vs oracles
+
+WORLD = 4
+ELEMS = 30011  # odd: uneven ring chunks; ~120 KB f32 (topk-eligible)
+STEPS = 3
+
+MATRIX_WORKER = r"""
+import hashlib, json, os, sys
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import create
+from horovod_tpu.common.topology import Topology
+
+rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+lsz = int(os.environ.get("T_LOCAL", "1"))
+topo = (Topology(rank, world, rank % lsz, lsz, rank // lsz, world // lsz)
+        if lsz > 1 else Topology(rank, world, 0, 1, rank, world))
+eng = create(topo, Config(
+    cycle_time_ms=1.0, stall_check_disable=True,
+    compression=os.environ.get("T_COMP", "none"),
+    hierarchical_allreduce=os.environ.get("T_HIER", "0") == "1"))
+try:
+    elems = int(os.environ["T_ELEMS"]); steps = int(os.environ["T_STEPS"])
+    rng = np.random.default_rng(23)
+    digest = hashlib.sha256()
+    for step in range(steps):
+        pay = [(rng.standard_normal(elems) * (r + 1)).astype(np.float32)
+               for r in range(world)]
+        out = eng.run("allreduce", pay[rank], f"g.{step % 2}")
+        digest.update(np.ascontiguousarray(out).tobytes())
+    print(json.dumps({"rank": rank, "hash": digest.hexdigest(),
+                      "plane": eng.cache_stats().get("plane", "?")}))
+finally:
+    eng.shutdown()
+"""
+
+
+def _matrix_world(engine: str, comp: str, hier: bool = False,
+                  ring: bool = True):
+    env = {"HOROVOD_ENGINE": engine, "T_COMP": comp,
+           "T_ELEMS": str(ELEMS), "T_STEPS": str(STEPS),
+           "HOROVOD_COMPRESSION": comp,
+           "HOROVOD_RING_DATA_PLANE": "1" if ring else "0"}
+    if hier:
+        env.update({"T_LOCAL": "2", "T_HIER": "1",
+                    "HOROVOD_HIERARCHICAL_ALLREDUCE": "1"})
+    return [r["out"] for r in launch_world(WORLD, MATRIX_WORKER,
+                                           extra_env=env)]
+
+
+def _oracle_digest(comp: str, grid=None) -> str:
+    """The canonical result stream every plane must reproduce bitwise:
+    the pure-numpy oracles over the same seeded payloads, including the
+    enqueue-time quantize/sparsify + EF residual semantics."""
+    import hashlib
+
+    import ml_dtypes
+
+    rng = np.random.default_rng(23)
+    digest = hashlib.sha256()
+    residuals: dict = {}
+    for step in range(STEPS):
+        name = f"g.{step % 2}"
+        pay = [(rng.standard_normal(ELEMS) * (r + 1)).astype(np.float32)
+               for r in range(WORLD)]
+        if comp == "topk":
+            prepared = []
+            for r in range(WORLD):
+                res = residuals.pop((name, r), None)
+                x = pay[r] if res is None else pay[r] + res
+                i, v = topk_select(x.ravel(), topk_k(x.size, 0.01))
+                d = topk_densify(i, v, x.size)
+                residuals[(name, r)] = x - d
+                prepared.append(d)
+            out = _ring_order_reduce(prepared, True, wire_dtype="topk",
+                                     grid=grid)
+        elif comp in ("bf16", "fp16"):
+            wd = np.dtype(ml_dtypes.bfloat16 if comp == "bf16"
+                          else np.float16)
+            quant = [p.astype(wd).astype(np.float32) for p in pay]
+            out = _ring_order_reduce(quant, True, wire_dtype=wd, grid=grid)
+        else:
+            out = _ring_order_reduce(pay, True, grid=grid)
+        digest.update(np.ascontiguousarray(out).tobytes())
+    return digest.hexdigest()
+
+
+@pytest.mark.parametrize("comp", ["none", "bf16", "fp16", "topk"])
+def test_bitwise_matrix_flat_native_equals_python(comp):
+    """The acceptance pin: native-flat == python-ring == the flat oracle,
+    bitwise, for every wire format (incl. topk EF residual carry across
+    re-submissions of the same names)."""
+    want = _oracle_digest(comp)
+    native = _matrix_world("native!", comp)
+    py = _matrix_world("python", comp)
+    assert {o["hash"] for o in native} == {want}, \
+        f"native flat plane != oracle for {comp}"
+    assert {o["hash"] for o in py} == {want}, \
+        f"python ring plane != oracle for {comp}"
+    assert all(o["plane"] == "ring" for o in py)
+
+
+@pytest.mark.parametrize("comp", ["none", "topk"])
+def test_bitwise_matrix_star_pinned_to_same_oracle(comp):
+    """The python STAR relay reduces through the same canonical fold —
+    star == ring == native for the formats the star executor decodes."""
+    want = _oracle_digest(comp)
+    star = _matrix_world("python", comp, ring=False)
+    assert all(o["plane"] == "star" for o in star)
+    assert {o["hash"] for o in star} == {want}, \
+        f"python star plane != oracle for {comp}"
+
+
+@pytest.mark.parametrize("comp", ["bf16", "topk"])
+def test_bitwise_matrix_hier_native_equals_python(comp):
+    """The two-level ladder: native-hier == python-hier == the grid
+    oracle on a simulated 2-host x 2-rank grid."""
+    want = _oracle_digest(comp, grid=(2, 2))
+    native = _matrix_world("native!", comp, hier=True)
+    py = _matrix_world("python", comp, hier=True)
+    assert {o["hash"] for o in native} == {want}, \
+        f"native hier ladder != grid oracle for {comp}"
+    assert {o["hash"] for o in py} == {want}, \
+        f"python hier plane != grid oracle for {comp}"
+    assert all(o["plane"] == "hier" for o in py + native)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("comp", ["bf16", "fp16"])
+def test_bitwise_matrix_star_slow(comp):
+    want = _oracle_digest(comp)
+    star = _matrix_world("python", comp, ring=False)
+    assert {o["hash"] for o in star} == {want}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("comp", ["none", "fp16"])
+def test_bitwise_matrix_hier_slow(comp):
+    want = _oracle_digest(comp, grid=(2, 2))
+    native = _matrix_world("native!", comp, hier=True)
+    py = _matrix_world("python", comp, hier=True)
+    assert {o["hash"] for o in native} == {want}
+    assert {o["hash"] for o in py} == {want}
+
+
+# ------------------------- EF residual carry across a plane demotion
+
+DEMOTION_WORKER = r"""
+import hashlib, json, os, sys
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import PyEngine
+from horovod_tpu.common.topology import Topology
+from horovod_tpu import metrics as hvd_metrics
+
+rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+eng = PyEngine(Topology(rank, world, 0, 1, rank, world),
+               Config(cycle_time_ms=1.0, stall_check_disable=True,
+                      compression="topk"))
+try:
+    elems = 30011
+    rng = np.random.default_rng(31)
+    digest = hashlib.sha256()
+    for step in range(6):
+        pay = [(rng.standard_normal(elems) * (r + 1)).astype(np.float32)
+               for r in range(world)]
+        out = eng.run("allreduce", pay[rank], "grad")
+        digest.update(np.ascontiguousarray(out).tobytes())
+    snap = hvd_metrics.registry().snapshot()["counters"]
+    print(json.dumps({
+        "rank": rank, "hash": digest.hexdigest(),
+        "demotions": snap.get("horovod_plane_demotions_total", 0),
+        "resets": snap.get("horovod_elastic_resets_total", 0)}))
+finally:
+    eng.shutdown()
+"""
+
+
+def test_topk_residual_carry_across_mid_collective_demotion():
+    """EF residuals must survive a rung-2 plane demotion MID-COLLECTIVE:
+    the same name reuses its residual every step, a ring frame is chaos-
+    reset during step 3, and the faulted world's 6-step result stream must
+    stay bitwise identical to the fault-free world's — the redo replays
+    the already-sparsified contribution (residual claimed at enqueue,
+    never folded twice) and later steps keep folding the carried
+    residuals."""
+    clean = [r["out"] for r in launch_world(
+        WORLD, DEMOTION_WORKER, extra_env={"HOROVOD_ENGINE": "python"})]
+    fault = [r["out"] for r in launch_world(
+        WORLD, DEMOTION_WORKER,
+        extra_env={"HOROVOD_ENGINE": "python",
+                   "HOROVOD_FAULT_NET": "reset",
+                   "HOROVOD_FAULT_NET_SCOPE": "ring",
+                   "HOROVOD_FAULT_NET_RANK": "1",
+                   "HOROVOD_FAULT_NET_AFTER": "18",
+                   "HOROVOD_FAULT_NET_COUNT": "1"})]
+    assert len({o["hash"] for o in clean}) == 1
+    assert len({o["hash"] for o in fault}) == 1
+    assert {o["hash"] for o in fault} == {clean[0]["hash"]}, (
+        "faulted world diverged bitwise — a residual was dropped or "
+        "folded twice across the demotion replay")
+    assert max(o["demotions"] for o in fault) >= 1, \
+        "the chaos reset never demoted the plane (test exercised nothing)"
+    assert all(o["resets"] == 0 for o in fault), \
+        "the demotion escalated to an elastic reset"

@@ -37,6 +37,7 @@ CACHE_H = os.path.join("horovod_tpu", "cc", "src", "cache.h")
 ENGINE_PY = os.path.join("horovod_tpu", "common", "engine.py")
 RESPONSE_CACHE_PY = os.path.join("horovod_tpu", "common", "response_cache.py")
 NATIVE_ENGINE_PY = os.path.join("horovod_tpu", "cc", "native_engine.py")
+PROTOCOL_CORE_PY = os.path.join("horovod_tpu", "common", "protocol.py")
 
 # ---------------------------------------------------------------- mappings
 
@@ -51,6 +52,8 @@ REQUEST_FIELD_MAP = {
     "op": "op",
     "dtype": "@wire/working dtype; python tags the format instead:wire",
     "orig_dtype": "dtype",
+    "wire_fmt": "@sparse wire tag (topk, ISSUE 13); python reuses the "
+                "format field:wire",
     "name": "name",
     "root_rank": "root",
     "average": "average",
@@ -113,6 +116,8 @@ CACHE_KEY_MAP = {
     "op": "op",
     "dtype": "@wire/working dtype; python keys the format tag:wire",
     "orig_dtype": "dtype",
+    "wire_fmt": "@sparse wire tag (topk, ISSUE 13); python keys the same "
+                "fact through the format tag:wire",
     "average": "average",
     "root_rank": "root",
     "shape": "shape",
@@ -327,6 +332,34 @@ def check(root: str, spec: Optional[dict] = None) -> list[Finding]:
             f"DTYPES has {len(dtypes)} entries but DataType has "
             f"{len(dtenum)} — the dtype id spaces diverged",
             NATIVE_ENGINE_PY))
+
+    # -- protocol core conformance (ISSUE 13): common/protocol.py is the
+    # importable single copy of the contract; its literal tables must match
+    # what this pass machine-extracted from both engines, or the "shared
+    # spec" is lying. The first divergent table is named.
+    core = parse_py(root, PROTOCOL_CORE_PY)
+    core_tables = {
+        "OPS": py["ops"],
+        "DTYPES": py["dtypes"],
+        "REQUEST_WIRE_ORDER": msgs.get("Request", {}).get("wire_order", []),
+        "TICK_WIRE_ORDER": msgs.get("TickRequest", {}).get("wire_order", []),
+        "RESPONSE_LIST_WIRE_ORDER":
+            msgs.get("ResponseList", {}).get("wire_order", []),
+        "NATIVE_CACHE_KEY_FIELDS": native["cache_key_fields"],
+        "PY_REQUEST_KEY_FIELDS": py["request_key_fields"],
+        "PY_REQUEST_FIELDS": py["request_fields"],
+        "PY_REQUEST_OPTIONAL_FIELDS": py["request_optional_fields"],
+        "STATUS_NAMES": {int(k): v for k, v in py["status_names"].items()},
+    }
+    for const, want in core_tables.items():
+        got = pysrc.module_constant(core, const)
+        if got != want:
+            findings.append(make_finding(
+                "protocol", "protocol-core-drift", const,
+                f"common/protocol.py {const} = {got!r} does not match the "
+                f"machine-extracted contract {want!r} — update the shared "
+                "protocol core (it is the importable copy of "
+                "docs/protocol_spec.json)", PROTOCOL_CORE_PY))
 
     status = py["status_names"]
     stenum = native["enums"].get("StatusType", {})

@@ -40,7 +40,7 @@ STEPS = 30
 TENSORS = 8
 
 WORKER = r"""
-import hashlib, json, os, sys
+import hashlib, json, os, sys, time
 sys.path.insert(0, os.environ["HVD_REPO"])
 import numpy as np
 from horovod_tpu.common.config import Config
@@ -51,8 +51,15 @@ from horovod_tpu import metrics as hvd_metrics
 rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
 warmup = int(os.environ["SMOKE_WARMUP"]); steps = int(os.environ["SMOKE_STEPS"])
 tensors = int(os.environ["SMOKE_TENSORS"])
-eng = PyEngine(Topology(rank, world, 0, 1, rank, world),
-               Config(cycle_time_ms=1.0, stall_check_disable=True))
+topo = Topology(rank, world, 0, 1, rank, world)
+cfg = Config(cycle_time_ms=1.0, stall_check_disable=True)
+if os.environ.get("HOROVOD_ENGINE") == "native!":
+    # The native-plane leg (ISSUE 13): same protocol, the byte path runs
+    # in libhvd_core.so. native! raises instead of silently falling back.
+    from horovod_tpu.cc.native_engine import NativeEngine
+    eng = NativeEngine(topo, cfg)
+else:
+    eng = PyEngine(topo, cfg)
 try:
     digest = hashlib.sha256()
     max_rel_err = 0.0
@@ -81,10 +88,24 @@ try:
     def delta(series):
         return snap1.get(series, 0) - snap0.get(series, 0)
 
+    # Payload throughput (the eager_native_speedup record): a few MB-scale
+    # allreduces, timed — same payload on every engine leg so the A/B and
+    # the cross-engine bitwise check ride one measurement.
+    pay_n = int(float(os.environ.get("SMOKE_PAYLOAD_MB", "4")) * (1 << 17))
+    pay = (np.arange(pay_n, dtype=np.float64) * (rank + 1) / 7.0)
+    eng.run("allreduce", pay, "payload.warm")
+    pay_hash = hashlib.sha256()
+    t0 = time.monotonic()
+    for i in range(3):
+        pay_hash.update(eng.run("allreduce", pay, "payload").tobytes())
+    payload_mb_s = 3 * pay.nbytes / (1 << 20) / (time.monotonic() - t0)
+
     stats = eng.cache_stats()
     print(json.dumps({
         "rank": rank,
         "hash": digest.hexdigest(),
+        "payload_hash": pay_hash.hexdigest(),
+        "payload_mb_s": payload_mb_s,
         "ring_active": stats["ring_active"],
         "compression": stats.get("compression", "none"),
         "max_rel_err": max_rel_err,
@@ -96,9 +117,13 @@ try:
         "ring_bytes": snap1.get(
             'horovod_engine_data_bytes_total{plane="ring"}', 0),
         "wire_bytes": snap1.get(
-            'horovod_wire_bytes_total{plane="eager"}', 0),
+            'horovod_wire_bytes_total{plane="eager"}', 0) + snap1.get(
+            'horovod_wire_bytes_total{plane="native"}', 0),
         "wire_saved": snap1.get(
-            'horovod_wire_bytes_saved_total{plane="eager"}', 0),
+            'horovod_wire_bytes_saved_total{plane="eager"}', 0) + snap1.get(
+            'horovod_wire_bytes_saved_total{plane="native"}', 0),
+        "saved_topk": snap1.get(
+            'horovod_wire_bytes_saved_total{method="topk"}', 0),
     }), flush=True)
 finally:
     eng.shutdown()
@@ -118,7 +143,8 @@ def fail(msg: str) -> None:
     sys.exit(1)
 
 
-def run_world(ring: bool, compression: str = "none") -> list[dict]:
+def run_world(ring: bool, compression: str = "none",
+              engine: str = "python", extra=None) -> list[dict]:
     port = free_port()
     secret = secrets.token_hex(16)
     procs = []
@@ -129,13 +155,14 @@ def run_world(ring: bool, compression: str = "none") -> list[dict]:
             "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(WORLD),
             "HOROVOD_COORD_ADDR": f"127.0.0.1:{port}",
             "HOROVOD_SECRET": secret,
-            "HOROVOD_ENGINE": "python",
+            "HOROVOD_ENGINE": "native!" if engine == "native" else "python",
             "HOROVOD_RING_DATA_PLANE": "1" if ring else "0",
             "HOROVOD_COMPRESSION": compression,
             "SMOKE_WARMUP": str(WARMUP_STEPS),
             "SMOKE_STEPS": str(STEPS),
             "SMOKE_TENSORS": str(TENSORS),
         })
+        env.update(extra or {})
         procs.append(subprocess.Popen(
             [sys.executable, "-c", WORKER], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
@@ -214,6 +241,51 @@ def main() -> int:
             fail(f"rank {r['rank']}: UNCOMPRESSED result off by "
                  f"{r['max_rel_err']} (compression=none must stay exact)")
 
+    # 5. native plane (ISSUE 13): the byte path in libhvd_core.so, same
+    #    protocol — results bitwise identical to the python planes, steady
+    #    state cached, and the payload A/B emits the gated
+    #    eager_native_speedup record (perf_gate --min-abs floors it).
+    native = run_world(ring=True, engine="native")
+    for r in native:
+        window = r["window_hits"] + r["window_misses"]
+        if r["window_hits"] / max(window, 1) < 0.95:
+            fail(f"native rank {r['rank']}: post-warmup cache hit rate "
+                 f"{r['window_hits']}/{window} < 95%")
+    if {r["hash"] for r in native} != {ring[0]["hash"]}:
+        fail("native plane step results diverge bitwise from the python "
+             "ring (canonical-order contract broken)")
+    if {r["payload_hash"] for r in native} != {ring[0]["payload_hash"]}:
+        fail("native plane payload results diverge bitwise from python")
+    native_mbs = min(r["payload_mb_s"] for r in native)
+    python_mbs = min(r["payload_mb_s"] for r in ring)
+    print(json.dumps({
+        "metric": "eager_native_speedup",
+        "value": round(native_mbs / python_mbs, 3), "unit": "x",
+        "smoke": True, "world": WORLD,
+        "native_payload_mb_s": round(native_mbs, 2),
+        "python_ring_payload_mb_s": round(python_mbs, 2),
+        "bitwise_identical_native_vs_python": True,
+    }), flush=True)
+
+    # 6. native topk (the PR 9 gap, closed): sparse frames on the native
+    #    wire, counted into the method="topk" saved counter through the
+    #    hvd_compression()/hvd_metric delta-collector, bitwise identical
+    #    to the python engine's sparse plane on the same inputs.
+    sparse_env = {"HOROVOD_COMPRESSION_MIN_BYTES": "256"}
+    topk_native = run_world(ring=True, compression="topk", engine="native",
+                            extra=sparse_env)
+    topk_py = run_world(ring=True, compression="topk", extra=sparse_env)
+    if len({r["hash"] for r in topk_native}) != 1:
+        fail("native topk results differ across ranks")
+    if {r["hash"] for r in topk_native} != {topk_py[0]["hash"]}:
+        fail("native topk diverges bitwise from the python sparse plane")
+    if {r["hash"] for r in topk_native} == {ring[0]["hash"]}:
+        fail("topk world produced the dense hash (sparsification inert)")
+    for r in topk_native:
+        if r["saved_topk"] <= 0:
+            fail(f"native rank {r['rank']}: no method=topk saved bytes "
+                 "counted (the delta-collector gap is back)")
+
     hits = sum(r["window_hits"] for r in ring)
     window = hits + sum(r["window_misses"] for r in ring)
     reduction = (comp[0]["wire_bytes"] + comp[0]["wire_saved"]) \
@@ -223,7 +295,9 @@ def main() -> int:
           f"{ring[0]['ring_bytes']:.0f}, star relay bytes 0, "
           f"star==ring bitwise; bf16 wire {reduction:.1f}x fewer bytes, "
           f"max rel err {max(r['max_rel_err'] for r in comp):.2%}, "
-          "bf16 star==ring bitwise")
+          "bf16 star==ring bitwise; native==python bitwise "
+          f"({native_mbs / python_mbs:.1f}x payload MB/s), native topk "
+          "sparse + counted")
     return 0
 
 

@@ -110,8 +110,39 @@ def main() -> int:
               "with a sanitizer:<tool>:<frame> key — docs/analysis.md)",
               flush=True)
         return 1
+
+    # 2. the ISSUE 13 native-byte-path stress: multi-threaded dense + bf16
+    # + sparse-topk ring reduces with a chaos-injected mid-collective
+    # reset, as a STANDALONE binary (no CPython in the process) — which is
+    # what lets ASan *and* TSan actually execute it instead of TSan being
+    # build-only.
+    for target, binary, env_extra in (
+            ("asan_stress", "ring_stress.asan",
+             {"ASAN_OPTIONS": "abort_on_error=1",
+              "UBSAN_OPTIONS": "print_stacktrace=1:halt_on_error=1"}),
+            ("tsan_stress", "ring_stress.tsan",
+             {"TSAN_OPTIONS": "halt_on_error=1:second_deadlock_stack=1"})):
+        r = run(["make", "-C", CC_DIR, target])
+        if r.returncode != 0:
+            print(f"FAIL: make {target} did not build", flush=True)
+            return 1
+        r = run([os.path.join(CC_DIR, binary)],
+                env=dict(os.environ, **env_extra), capture_output=True,
+                text=True, timeout=180)
+        sys.stdout.write(r.stdout[-1000:])
+        stress_out = r.stdout + r.stderr
+        stress_live = [
+            ln for ln in stress_out.splitlines()
+            if _REPORT_RE.search(ln)
+            and not any(key.split(":", 1)[1] in ln for key in vetted)]
+        if r.returncode != 0 or stress_live:
+            sys.stderr.write(r.stderr[-4000:])
+            print(f"FAIL: {binary} reported findings or failed", flush=True)
+            return 1
+
     print("sanitize smoke OK: asan/ubsan/tsan build; shm/ring tests pass "
-          "under ASan+UBSan with 0 reports", flush=True)
+          "under ASan+UBSan with 0 reports; ring stress (dense+bf16+topk, "
+          "chaos reset) clean under ASan AND TSan", flush=True)
     return 0
 
 
