@@ -1031,6 +1031,235 @@ def compression_ab_main() -> None:
     budget.emit(out)
 
 
+def _build_fsdp_ab(batch_sz: int, shard_sz: int, features,
+                   fusion_threshold=None, num_buckets=None):
+    """MLP train step for the DP-vs-sharded A/B (ISSUE 14): the same model,
+    data, and init on a ('batch','shard') mesh — shard=1 runs the plain
+    replicated DistributedOptimizer path, shard>1 the ZeRO
+    reduce-scatter/allgather path. Returns (run, sync, info) where info
+    carries the per-rank parameter+optimizer-state bytes and the losses
+    list the run closure appends to (the parity probe)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from horovod_tpu.compat import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import MLP
+    from horovod_tpu.parallel import sharded as hvd_sharded
+
+    import numpy as np
+
+    n_dev = batch_sz * shard_sz
+    devs = jax.devices()[:n_dev]
+    mesh = Mesh(np.asarray(devs).reshape(batch_sz, shard_sz),
+                ("batch", "shard"))
+    per_dev_batch = int(os.environ.get("HVD_BENCH_BATCH", 8))
+    batch = per_dev_batch * n_dev
+    dim = 128
+    model = MLP(features=features)
+    x = jnp.ones((batch, dim), jnp.float32)
+    y = jnp.zeros((batch,), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x[:2])
+    A = ("batch", "shard")
+
+    def loss_fn(p, x, y):
+        logits = model.apply(p, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y + jnp.arange(y.shape[0]) % logits.shape[-1]).mean()
+
+    losses: list = []
+    if shard_sz == 1:
+        opt = hvd.jax.DistributedOptimizer(
+            optax.adam(1e-3), axis_name=A,
+            fusion_threshold=fusion_threshold, num_buckets=num_buckets)
+        opt_state = opt.init(params)
+        state_bytes = hvd_sharded.state_bytes(
+            {"params": params, "opt": opt_state})
+
+        def train_step(p, o, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+            upd, o = opt.update(grads, o, p)
+            return optax.apply_updates(p, upd), o, jax.lax.pmean(loss, A)
+
+        step = jax.jit(shard_map(
+            train_step, mesh=mesh,
+            in_specs=(P(), P(), P(A), P(A)), out_specs=(P(), P(), P()),
+            check_vma=False), donate_argnums=(0, 1))
+        state = [params, opt_state]
+    else:
+        plan = hvd_sharded.build_shard_plan(
+            params, shard_sz, threshold=fusion_threshold,
+            num_buckets=num_buckets)
+        sp = hvd_sharded.shard_params(params, plan)
+        opt = hvd.jax.DistributedOptimizer(
+            optax.adam(1e-3), sharded=True, shard_plan=plan,
+            fusion_threshold=fusion_threshold, num_buckets=num_buckets)
+        opt_state = opt.init(sp)
+        specs = hvd_sharded.shard_specs(opt_state)
+        # Per-rank persistent state: each rank owns 1/shard of every
+        # (shard, chunk) buffer (params + both adam moments + counters).
+        state_bytes = hvd_sharded.state_bytes(
+            {"params": sp, "opt": opt_state}) // shard_sz
+
+        def train_step(sp, o, x, y):
+            full = hvd_sharded.gather_params(sp, plan)
+            loss, grads = jax.value_and_grad(loss_fn)(full, x, y)
+            upd, o = opt.update(grads, o, sp)
+            return optax.apply_updates(sp, upd), o, jax.lax.pmean(loss, A)
+
+        step = jax.jit(shard_map(
+            train_step, mesh=mesh,
+            in_specs=(P("shard"), specs, P(A), P(A)),
+            out_specs=(P("shard"), specs, P()),
+            check_vma=False), donate_argnums=(0, 1))
+        state = [sp, opt_state]
+    loss_box = [None]
+
+    def run():
+        p, o, loss_box[0] = step(*state, x, y)
+        state[:] = (p, o)
+        losses.append(loss_box[0])
+
+    info = {"state_bytes_per_rank": int(state_bytes), "batch": batch,
+            "losses": losses,
+            "param_count": sum(int(l.size) for l in
+                               jax.tree_util.tree_leaves(params))}
+    return run, (lambda: float(loss_box[0])), info
+
+
+def fsdp_ab_main() -> None:
+    """bench.py --fsdp-ab: DP vs ZeRO-sharded A/B on the simulated
+    ('batch','shard') mesh (ISSUE 14). Same model/data/init twice — the
+    fully-replicated DP path (shard=1) against the sharded planner
+    (shard=2) — reporting the headline per-rank parameter+optimizer-state
+    memory reduction (the gated metric, floor 1.8x), step-time, loss-
+    trajectory parity, analytic step wire bytes vs the DP allreduce, the
+    largest trainable model size under a fixed per-rank budget, and a mini
+    joint autotune exercising the mesh shape as the FIFTH dimension. One
+    JSON line, always (budget watchdog; the bounded backend probe ran in
+    main())."""
+    import jax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import metrics as hvd_metrics
+    from horovod_tpu.jax.autotune import measure_steps_per_s, tune
+
+    budget = _Budget.install("fsdp_ab_memory_reduction", "x")
+    budget.stage("devices")
+    # The A/B needs a 2-D mesh; on a CPU host spin up virtual devices (the
+    # same simulated-mesh strategy the test suite uses). Must happen BEFORE
+    # the first jax.devices() call — the backend initializes once.
+    import re as _re
+
+    want = int(os.environ.get("HVD_FSDP_AB_DEVICES", "8"))
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = _re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
+    promised = int(m.group(1)) if m else 0
+    if (os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+            and promised < want):
+        try:
+            from horovod_tpu.compat import set_num_cpu_devices
+
+            set_num_cpu_devices(want)
+        except RuntimeError:
+            pass
+    n_dev = len(jax.devices())
+    out = {"metric": "fsdp_ab_memory_reduction", "value": 0.0, "unit": "x",
+           "smoke": _smoke_on(), "devices": n_dev}
+    if n_dev < 4 or n_dev % 2:
+        out.update({"partial": True,
+                    "reason": f"need an even device count >= 4, have {n_dev}"})
+        budget.emit(out)
+        return
+    hvd.init()
+    smoke = _smoke_on()
+    features = (256, 256, 10) if smoke else (1024, 1024, 1024, 10)
+    steps = 6 if smoke else 12
+    warmup, iters, reps = (2, 3, 2) if smoke else (3, 8, 3)
+    shard = 2
+    batch_dp, batch_sh = n_dev, n_dev // shard
+
+    budget.stage("dp-leg")
+    run_dp, sync_dp, info_dp = _build_fsdp_ab(batch_dp, 1, features)
+    rate_dp = measure_steps_per_s(run_dp, warmup, iters, reps, sync=sync_dp)
+    dp_plan = hvd_metrics.last_plan()
+    dp_wire_b = sum(n for _, n in dp_plan or [])
+    info_dp["losses"].clear()
+
+    budget.stage("sharded-leg")
+    run_sh, sync_sh, info_sh = _build_fsdp_ab(batch_sh, shard, features)
+    rate_sh = measure_steps_per_s(run_sh, warmup, iters, reps, sync=sync_sh)
+    shard_plan = hvd_metrics.last_shard_plan()
+    info_sh["losses"].clear()
+
+    budget.stage("parity")
+    # Fresh states walked side by side: the sharded trajectory must match
+    # DP within dtype tolerance (the bitwise shard=1 proof lives in
+    # tests/test_sharded.py; this is the cross-shape check).
+    run_dp2, _, info_dp2 = _build_fsdp_ab(batch_dp, 1, features)
+    run_sh2, _, info_sh2 = _build_fsdp_ab(batch_sh, shard, features)
+    for _ in range(steps):
+        run_dp2()
+        run_sh2()
+    parity = max(abs(float(a) - float(b))
+                 for a, b in zip(info_dp2["losses"], info_sh2["losses"]))
+
+    dp_bytes = info_dp["state_bytes_per_rank"]
+    sh_bytes = info_sh["state_bytes_per_rank"]
+    hvd_metrics.record_sharded_state_bytes(sh_bytes * shard, shard)
+    # Analytic per-rank ring wire volume: DP allreduce = 2B(N-1)/N; sharded
+    # = scatter (s-1)/s + batch-psum 2(b-1)/b over the 1/s chunk + gather
+    # (s-1)/s — the ZeRO equal-wire-cost claim, from the recorded plans.
+    sc = (shard_plan or {}).get("bytes_per_step", {}).get("scatter", 0)
+    ga = (shard_plan or {}).get("bytes_per_step", {}).get("gather", 0)
+    b_ax = (shard_plan or {}).get("batch", batch_sh)
+    dp_wire = 2.0 * dp_wire_b * (n_dev - 1) / n_dev
+    sh_wire = (sc * (shard - 1) / shard
+               + 2.0 * (b_ax - 1) / max(b_ax, 1) * (sc / shard)
+               + ga * (shard - 1) / shard)
+    out.update({
+        "value": round(dp_bytes / max(sh_bytes, 1), 3),
+        "shard": shard,
+        "dp_state_bytes_per_rank": int(dp_bytes),
+        "sharded_state_bytes_per_rank": int(sh_bytes),
+        "param_count": info_dp["param_count"],
+        "dp_img_s": round(rate_dp * info_dp["batch"], 2),
+        "sharded_img_s": round(rate_sh * info_sh["batch"], 2),
+        "sharded_vs_dp_step_time": round(rate_dp / max(rate_sh, 1e-9), 3),
+        "loss_parity_max_abs_err": round(parity, 8),
+        "wire_bytes_vs_dp": round(sh_wire / max(dp_wire, 1), 4),
+        # Largest trainable model under a per-rank budget equal to the DP
+        # footprint: sharding the state 1/shard lets ~shard-fold more
+        # state bytes fit (minus padding) — the reason this refactor
+        # unlocks models too big for one chip.
+        "largest_trainable_state_bytes_dp": int(dp_bytes),
+        "largest_trainable_state_bytes_sharded": int(
+            dp_bytes * dp_bytes / max(sh_bytes, 1)),
+    })
+    # Mesh shape as the FIFTH joint-autotune dimension (jax/autotune.tune):
+    # the tuner measures the same step over candidate ('batch','shard')
+    # shapes beside (threshold, buckets) and reports the platform's winner.
+    if not budget.skip_if_low("mesh-autotune", 40):
+        budget.stage("mesh-autotune")
+
+        def step_factory(fusion_threshold, mesh_shape):
+            b, s = (int(v) for v in mesh_shape.split("x"))
+            run, sync, _ = _build_fsdp_ab(b, s, features,
+                                          fusion_threshold=fusion_threshold)
+            return run, sync
+
+        report = tune(step_factory, thresholds=(1 << 20,),
+                      mesh_shapes=(f"{n_dev}x1", f"{n_dev // 2}x2"),
+                      warmup=1 if smoke else 2, iters=3, reps=2,
+                      gp_rounds=0, verbose=True)
+        print(report.knob_curve(), file=sys.stderr)
+        out["autotuned_mesh"] = report.best.config.get("mesh",
+                                                       f"{n_dev}x1")
+    budget.emit(out)
+
+
 def serve_bench_main() -> None:
     """bench.py --serve: offered-load sweep over the serving vertical
     (ISSUE 10). Exports a tiny-MLP serving checkpoint, starts a 2-replica
@@ -1321,6 +1550,7 @@ def main() -> None:
     mode_metrics = {
         "--autotune": ("autotune_best_config", "steps/s"),
         "--buckets-ab": ("buckets_ab_images_per_sec", "img/s"),
+        "--fsdp-ab": ("fsdp_ab_memory_reduction", "x"),
         "--roofline": ("resnet50_roofline", "GB/s"),
         "--serve-llm": ("serve_llm_bench_decode_tokens_per_s", "tok/s"),
         "--serve": ("serve_bench_throughput_rps", "req/s"),
@@ -1353,6 +1583,8 @@ def main() -> None:
         return serve_bench_main()
     if "--autotune" in sys.argv:
         return autotune_main()
+    if "--fsdp-ab" in sys.argv:
+        return fsdp_ab_main()
     if "--buckets-ab" in sys.argv:
         return buckets_ab_main()
     if "--roofline" in sys.argv:

@@ -85,6 +85,17 @@ python tools/perf_gate.py --current /tmp/hvd_hier_ab.log \
   --require-metric hier_ab_cross_byte_reduction \
   --min-abs hier_ab_cross_byte_reduction=2.5 --allow-missing-baseline
 
+echo "== fsdp smoke (ISSUE 14 sharded data parallelism: 8-device mesh trains a model whose DP state exceeds the simulated per-rank budget; memory gauge >= 1.8x reduction at shard=2, loss parity with the DP control, wire bytes <= 1.1x DP allreduce, pad tail stays zero) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/fsdp_smoke.py
+
+echo "== fsdp A/B bench + gate (ISSUE 14: DP vs ZeRO-sharded on the simulated ('batch','shard') mesh — the per-rank parameter+optimizer-state memory-reduction metric must exist and clear the 1.8x absolute floor) =="
+HVD_BENCH_SMOKE=1 timeout -k 10 240 env JAX_PLATFORMS=cpu \
+  python bench.py --fsdp-ab | tee /tmp/hvd_fsdp_ab.log
+python tools/perf_gate.py --current /tmp/hvd_fsdp_ab.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric fsdp_ab_memory_reduction \
+  --min-abs fsdp_ab_memory_reduction=1.8 --allow-missing-baseline
+
 echo "== metrics smoke (2-proc train, stall check + exposition; snapshot vs docs/metrics_schema.json, timeline JSON shape) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_smoke.py
 

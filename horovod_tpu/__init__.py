@@ -40,9 +40,12 @@ from .common.topology import num_devices as num_chips, num_local_devices  # noqa
 from .compression import Compression  # noqa: F401
 from .parallel.collectives import ReduceOp  # noqa: F401
 from .parallel.mesh import (  # noqa: F401
+    BATCH_AXIS,
     HVD_AXIS,
+    SHARD_AXIS,
     data_parallel_mesh,
     hierarchical_mesh,
+    sharded_mesh,
     training_mesh,
 )
 

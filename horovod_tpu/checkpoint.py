@@ -201,8 +201,10 @@ def restore(path: str, template: Any = None, step: Optional[int] = None,
 
 def _verify_cross_rank_digest(state: Any, tag: str) -> None:
     """SHA-256 over every restored leaf (dtype + shape + bytes), allgathered
-    through the eager engine; raises if any rank restored different state."""
-    if basics.size() == 1:
+    through the eager engine; raises if any rank restored different state.
+    Uninitialized == single-process (the same plain-export convention as
+    save()): there is no peer to diverge from, so nothing to verify."""
+    if not basics.is_initialized() or basics.size() == 1:
         return
     import hashlib
 
@@ -243,6 +245,58 @@ def _verify_cross_rank_digest(state: Any, tag: str) -> None:
             f"different state than rank 0 (non-shared or stale filesystem?); "
             f"restore on rank 0 only and broadcast, or fix the filesystem"
         )
+
+
+def save_sharded(path: str, state: Any, plan, step: Optional[int] = None) -> None:
+    """Checkpoint a SHARDED training state (ISSUE 14, docs/sharded.md).
+
+    ``state`` is any pytree whose sharded sub-states are
+    :class:`horovod_tpu.parallel.sharded.ShardedBuckets` (params, optimizer
+    moments — whatever ``optimizer.init`` produced); ``plan`` is the
+    :class:`ShardPlan` they were partitioned with. The checkpoint stores
+    the CONSOLIDATED full leaves, so it is mesh-shape independent: restore
+    onto any ('batch','shard') shape, including plain DP. Consolidation
+    also drops the zero-pad tail — pad garbage can never be carried in a
+    checkpoint (the fsdp pad-leak fix's checkpoint half). Rank-0-writes +
+    completion barrier, exactly like :func:`save`."""
+    from .parallel import sharded as _sharded
+
+    save(path, _sharded.unshard_tree(state, plan), step)
+
+
+def restore_sharded(path: str, template: Any, plan,
+                    step: Optional[int] = None, verify: bool = True) -> Any:
+    """Restore a checkpoint written by :func:`save_sharded` (or a plain DP
+    :func:`save` of the same pytree) INTO a sharded layout: the full leaves
+    are read with the consolidated template, then re-partitioned to
+    ``plan`` with fresh zero padding. ``template`` is the live sharded
+    state (it locates every :class:`ShardedBuckets` position); ``plan``
+    may differ from the one the checkpoint was written under — that is
+    what makes resume-after-reshape work. Same cross-rank digest
+    verification contract as :func:`restore`."""
+    from .parallel import sharded as _sharded
+
+    full = restore(path, _sharded.unshard_tree(template, plan), step,
+                   verify=verify)
+    out = _sharded.reshard_tree(full, template, plan)
+    # Re-place every restored leaf on the template leaf's sharding: a
+    # restored host array left on the default device would make the next
+    # jitted step compile a second executable (different input placement),
+    # and two executables are allowed to differ by an ULP — which would
+    # break the save->restore->resume bitwise-exactness contract the tests
+    # pin. With matching shardings the resumed step reuses the SAME
+    # compiled program as the uncheckpointed run.
+    import jax
+
+    def _place(t, r):
+        if isinstance(t, jax.Array) and not isinstance(t, jax.core.Tracer):
+            try:
+                return jax.device_put(r, t.sharding)
+            except (ValueError, AttributeError):
+                return r
+        return r
+
+    return jax.tree_util.tree_map(_place, template, out)
 
 
 def merge_stacked_stats(stats: Any, axis: int = 0) -> Any:

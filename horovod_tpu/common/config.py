@@ -207,6 +207,16 @@ class Config:
     dcn_fusion_threshold: int = field(                    # HOROVOD_DCN_FUSION_THRESHOLD
         default_factory=lambda: max(0, _env_int(
             "HOROVOD_DCN_FUSION_THRESHOLD", 0)))
+    # Sharded data parallelism (ISSUE 14, docs/sharded.md). HOROVOD_MESH
+    # names the 2-D ('batch','shard') mesh shape as "<batch>x<shard>"
+    # (empty = pure DP, shard=1); HOROVOD_SHARD_PARAMS flips
+    # DistributedOptimizer onto the ZeRO wire pattern (reduce-scatter
+    # grads into the owning shard, bucketed allgather parameter refresh).
+    # Env-aware defaults for the same reason as the fields above.
+    mesh: str = field(                                    # HOROVOD_MESH
+        default_factory=lambda: os.environ.get("HOROVOD_MESH", "").strip())
+    shard_params: bool = field(                           # HOROVOD_SHARD_PARAMS
+        default_factory=lambda: _env_bool("HOROVOD_SHARD_PARAMS", False))
     # Distributed tracing (ISSUE 6, docs/tracing.md): non-empty directory
     # enables per-rank span capture on every data plane. Env-aware default
     # like compression above: workers constructed with Config(...) directly

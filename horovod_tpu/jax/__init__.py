@@ -42,8 +42,20 @@ import optax
 
 from ..compression import Compression, Compressor
 from ..parallel import collectives, fusion
+from ..parallel import sharded as _sharded
 from ..parallel.collectives import ReduceOp
-from ..parallel.mesh import HVD_AXIS
+from ..parallel.mesh import BATCH_AXIS, HVD_AXIS, SHARD_AXIS
+from ..parallel.sharded import (  # noqa: F401  (re-exported API surface)
+    ShardedBuckets,
+    ShardPlan,
+    build_shard_plan,
+    gather_params,
+    mask_pad_updates,
+    reduce_scatter_gradients,
+    shard_params,
+    shard_specs,
+    unshard_params,
+)
 from ..common.config import Config
 
 
@@ -113,6 +125,16 @@ def _resolved_hierarchical(hierarchical, op, ici_axis: str,
     return True
 
 
+def _resolved_sharded(sharded) -> bool:
+    """None -> the HOROVOD_SHARD_PARAMS env knob (ISSUE 14): one env var
+    flips DistributedOptimizer onto the ZeRO wire pattern the same way
+    HOROVOD_HIERARCHICAL_ALLREDUCE flips the ladder; an explicit argument
+    — including an explicit False — wins."""
+    if sharded is not None:
+        return bool(sharded)
+    return Config.from_env().shard_params
+
+
 def allreduce_gradients(
     grads,
     axis_name: str = HVD_AXIS,
@@ -177,6 +199,10 @@ def DistributedOptimizer(
     dcn_axis: str = "dcn",
     dcn_compression=None,
     dcn_threshold: int | None = None,
+    sharded: bool | None = None,
+    shard_plan: "ShardPlan | None" = None,
+    batch_axis: str = BATCH_AXIS,
+    shard_axis: str = SHARD_AXIS,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so that ``update()`` first averages gradients
     across the mesh axis, exactly where the reference wraps
@@ -214,7 +240,53 @@ def DistributedOptimizer(
     fabric's wire dtype and bucket cap independently of the ICI tier — the
     multi-pod configuration (docs/hierarchical.md). Joins the autotune as
     the FOURTH dimension (``jax.autotune.tune(hierarchicals=...)``).
+
+    ``sharded`` (or HOROVOD_SHARD_PARAMS, ISSUE 14) switches the wrapper
+    onto the ZeRO wire pattern over a ``('batch', 'shard')`` mesh
+    (docs/sharded.md): ``init()`` takes the :class:`ShardedBuckets` layout
+    from :func:`shard_params` (so optimizer state shards 1/shard_size for
+    free), ``update()`` takes the FULL gradient pytree and reduce-scatters
+    each fused bucket into the owning shard (wire casts and bucket sizing
+    unchanged from DP), the inner update runs on the 1/shard_size rows,
+    and the zero-pad tail is masked so it never trains. The parameter
+    refresh is the caller's :func:`gather_params` in the forward pass —
+    one bucketed allgather per step. On a degenerate ``shard=1`` mesh the
+    exchange compiles bitwise-identically to the DP path. The mesh shape
+    joins the autotune as the FIFTH dimension
+    (``jax.autotune.tune(mesh_shapes=...)``; ``HOROVOD_MESH``).
     """
+    sharded = _resolved_sharded(sharded)
+    if sharded and backward_passes_per_step > 1:
+        # optax.MultiSteps accumulates incoming grads in the PARAMS
+        # structure; the sharded path feeds FULL grads against sharded
+        # params, so the accumulator shapes cannot line up. Accumulate
+        # microbatch grads in the training loop instead (full-tree sum
+        # before one opt.update call).
+        raise ValueError(
+            "DistributedOptimizer(sharded=True) does not compose with "
+            "backward_passes_per_step > 1; accumulate microbatch gradients "
+            "in the training loop and call update() once per exchange")
+
+    def sharded_update_fn(grads, state, params=None, **extra):
+        plan = shard_plan
+        if plan is None:
+            shard_size = fusion._axis_size(shard_axis)
+            if shard_size is None:
+                raise ValueError(
+                    f"DistributedOptimizer(sharded=True) needs the size of "
+                    f"axis {shard_axis!r}: call inside shard_map over a "
+                    f"('{batch_axis}', '{shard_axis}') mesh (e.g. "
+                    f"horovod_tpu.sharded_mesh()) or pass shard_plan=")
+            plan = _sharded.build_shard_plan(
+                grads, shard_size, _resolved_threshold(fusion_threshold),
+                _resolved_num_buckets(num_buckets))
+        reduced = _sharded.reduce_scatter_gradients(
+            grads, plan,
+            batch_axis=batch_axis, shard_axis=shard_axis, op=op,
+            compression=_resolved_compression(compression),
+            compression_min_bytes=compression_min_bytes)
+        updates, new_state = optimizer.update(reduced, state, params, **extra)
+        return _sharded.mask_pad_updates(updates, plan, shard_axis), new_state
 
     def update_fn(grads, state, params=None, **extra):
         reduced = allreduce_gradients(
@@ -233,7 +305,8 @@ def DistributedOptimizer(
         )
         return optimizer.update(reduced, state, params, **extra)
 
-    wrapped = optax.GradientTransformationExtraArgs(optimizer.init, update_fn)
+    wrapped = optax.GradientTransformationExtraArgs(
+        optimizer.init, sharded_update_fn if sharded else update_fn)
     if backward_passes_per_step > 1:
         wrapped = optax.MultiSteps(wrapped, every_k_schedule=backward_passes_per_step).gradient_transformation()
     return wrapped
@@ -292,6 +365,25 @@ def broadcast_optimizer_state(opt_state, root_rank: int = 0, axis_name: str = HV
         return collectives.broadcast(arr, root_rank, axis_name)
 
     return jax.tree_util.tree_map(bcast_leaf, opt_state)
+
+
+def broadcast_sharded_state(state, root_rank: int = 0,
+                            batch_axis: str = BATCH_AXIS):
+    """Initial-state consistency for the SHARDED layout (ISSUE 14): each
+    shard row is owned by a different rank, so broadcasting from one global
+    root would clobber every other rank's partition. The correct contract
+    broadcasts along the BATCH (replica) axis only — rank (root, s) seeds
+    shard s on every batch row — which is exactly what this does for an
+    arbitrary pytree of :class:`ShardedBuckets` / replicated leaves.
+
+    Works on params, optimizer state, or a whole training-state dict;
+    :class:`ShardedBuckets` containers pass through transparently (they are
+    pytrees). The plain :func:`broadcast_parameters` /
+    :func:`broadcast_optimizer_state` stay the replicated-layout entry
+    points."""
+    return jax.tree_util.tree_map(
+        lambda t: collectives.broadcast(jnp.asarray(t), root_rank,
+                                        batch_axis), state)
 
 
 def broadcast_object(obj, root_rank: int = 0, axis_name: str = HVD_AXIS):

@@ -54,6 +54,7 @@ class Measurement:
     num_buckets: int = 1
     compression: str = "none"
     hierarchical: bool = False
+    mesh_shape: str = ""
 
     @property
     def config(self) -> dict:
@@ -63,6 +64,8 @@ class Measurement:
             out["compression"] = self.compression
         if self.hierarchical:
             out["hierarchical"] = True
+        if self.mesh_shape:
+            out["mesh"] = self.mesh_shape
         return out
 
 
@@ -76,6 +79,7 @@ class TuneReport:
         with_buckets = any(m.num_buckets != 1 for m in self.table)
         with_comp = any(m.compression != "none" for m in self.table)
         with_hier = any(m.hierarchical for m in self.table)
+        with_mesh = any(m.mesh_shape for m in self.table)
         head = "branch | fusion_threshold | "
         if with_buckets:
             head += "num_buckets | "
@@ -83,11 +87,13 @@ class TuneReport:
             head += "compression | "
         if with_hier:
             head += "ladder | "
+        if with_mesh:
+            head += "mesh | "
         lines = [head + "steps/s"]
         for m in sorted(self.table,
                         key=lambda m: (str(m.branch), m.fusion_threshold,
                                        m.num_buckets, m.compression,
-                                       m.hierarchical)):
+                                       m.hierarchical, m.mesh_shape)):
             b = ",".join(f"{k}={v}" for k, v in sorted(m.branch.items())) or "-"
             mid = f"{m.fusion_threshold >> 20} MiB | "
             if with_buckets:
@@ -96,6 +102,8 @@ class TuneReport:
                 mid += f"{m.compression} | "
             if with_hier:
                 mid += ("hier | " if m.hierarchical else "flat | ")
+            if with_mesh:
+                mid += f"{m.mesh_shape or '-'} | "
             lines.append(f"{b} | {mid}{m.steps_per_s:.2f}")
         return "\n".join(lines)
 
@@ -225,6 +233,7 @@ def tune(step_factory: Callable[..., Callable[[], None]],
          num_buckets: Optional[Sequence[int]] = None,
          compressions: Optional[Sequence[str]] = None,
          hierarchicals: Optional[Sequence[bool]] = None,
+         mesh_shapes: Optional[Sequence[str]] = None,
          warmup: int = 2, iters: int = 5, reps: int = 3,
          gp_rounds: int = 2, log_path: Optional[str] = None,
          verbose: bool = False) -> TuneReport:
@@ -270,6 +279,17 @@ def tune(step_factory: Callable[..., Callable[[], None]],
     per PLATFORM whether the two-level ladder pays, instead of trusting
     the env knob. The factory is then called with an extra
     ``hierarchical=`` kwarg (bool).
+
+    ``mesh_shapes``: a grid of HOROVOD_MESH shapes (``"<batch>x<shard>"``
+    strings, e.g. ``("8x1", "4x2", "2x4")``) joins as the FIFTH joint
+    dimension (ISSUE 14) — categorical like the ladder, explored
+    exhaustively, with the continuous (threshold, buckets) GP/EI
+    refinement run per (compression, hierarchical, mesh) branch. The
+    factory is then called with an extra ``mesh_shape=`` kwarg (the spec
+    string) and is expected to rebuild its step over
+    ``horovod_tpu.sharded_mesh()`` at that shape — the tuner decides per
+    PLATFORM AND MODEL whether the ZeRO reduce-scatter/allgather pattern
+    pays against the replicated allreduce (docs/sharded.md).
     """
     branches = list(branches) if branches is not None else [{}]
     tune_buckets = num_buckets is not None
@@ -278,11 +298,14 @@ def tune(step_factory: Callable[..., Callable[[], None]],
     comp_grid = tuple(compressions) if tune_comp else ("none",)
     tune_hier = hierarchicals is not None
     hier_grid = tuple(hierarchicals) if tune_hier else (False,)
+    tune_mesh = mesh_shapes is not None
+    mesh_grid = tuple(mesh_shapes) if tune_mesh else ("",)
     table: list[Measurement] = []
     log_rows = []
 
     def run(branch: dict, th: int, nb: int = 1,
-            comp: str = "none", hier: bool = False) -> Measurement:
+            comp: str = "none", hier: bool = False,
+            mesh: str = "") -> Measurement:
         kw = dict(branch)
         if tune_buckets:
             kw["num_buckets"] = nb
@@ -290,10 +313,12 @@ def tune(step_factory: Callable[..., Callable[[], None]],
             kw["compression"] = comp
         if tune_hier:
             kw["hierarchical"] = hier
+        if tune_mesh:
+            kw["mesh_shape"] = mesh
         made = step_factory(fusion_threshold=th, **kw)
         step, sync = made if isinstance(made, tuple) else (made, None)
         rate = measure_steps_per_s(step, warmup, iters, reps, sync=sync)
-        m = Measurement(branch, th, rate, nb, comp, hier)
+        m = Measurement(branch, th, rate, nb, comp, hier, mesh)
         table.append(m)
         token = ";".join(f"{k}={v}" for k, v in sorted(branch.items())) or "-"
         row = [token, str(th)]
@@ -303,6 +328,8 @@ def tune(step_factory: Callable[..., Callable[[], None]],
             row.append(comp)
         if tune_hier:
             row.append("hier" if hier else "flat")
+        if tune_mesh:
+            row.append(mesh or "-")
         log_rows.append(",".join(row + [f"{rate:.4f}"]))
         if verbose:
             import sys
@@ -311,33 +338,36 @@ def tune(step_factory: Callable[..., Callable[[], None]],
             comp_txt = f" wire={comp}" if tune_comp else ""
             hier_txt = (" ladder=hier" if hier else " ladder=flat") \
                 if tune_hier else ""
+            mesh_txt = f" mesh={mesh}" if tune_mesh else ""
             print(f"  autotune: {branch} threshold={th >> 20}MiB"
-                  f"{buckets_txt}{comp_txt}{hier_txt} -> {rate:.2f} steps/s",
+                  f"{buckets_txt}{comp_txt}{hier_txt}{mesh_txt} -> "
+                  f"{rate:.2f} steps/s",
                   file=sys.stderr, flush=True)
         return m
 
     for branch in branches:
         for comp in comp_grid:
             for hier in hier_grid:
-                measured: dict[tuple[int, int], float] = {}
-                for th in thresholds:
-                    for nb in bucket_grid:
-                        measured[(th, nb)] = run(branch, th, nb, comp,
-                                                 hier).steps_per_s
-                lo, hi = min(thresholds), max(thresholds)
-                for _ in range(gp_rounds):
-                    if tune_buckets:
-                        nxt = _ei_suggest_joint(
-                            measured, (lo, hi),
-                            (min(bucket_grid), max(bucket_grid)))
-                    else:
-                        flat = {th: v for (th, _), v in measured.items()}
-                        th_next = _ei_suggest(flat, lo, hi)
-                        nxt = (th_next, 1) if th_next is not None else None
-                    if nxt is None or nxt in measured:
-                        break
-                    measured[nxt] = run(branch, *nxt, comp,
-                                        hier).steps_per_s
+                for mesh in mesh_grid:
+                    measured: dict[tuple[int, int], float] = {}
+                    for th in thresholds:
+                        for nb in bucket_grid:
+                            measured[(th, nb)] = run(branch, th, nb, comp,
+                                                     hier, mesh).steps_per_s
+                    lo, hi = min(thresholds), max(thresholds)
+                    for _ in range(gp_rounds):
+                        if tune_buckets:
+                            nxt = _ei_suggest_joint(
+                                measured, (lo, hi),
+                                (min(bucket_grid), max(bucket_grid)))
+                        else:
+                            flat = {th: v for (th, _), v in measured.items()}
+                            th_next = _ei_suggest(flat, lo, hi)
+                            nxt = (th_next, 1) if th_next is not None else None
+                        if nxt is None or nxt in measured:
+                            break
+                        measured[nxt] = run(branch, *nxt, comp,
+                                            hier, mesh).steps_per_s
 
     table.sort(key=lambda m: -m.steps_per_s)
     if log_path:
@@ -349,6 +379,8 @@ def tune(step_factory: Callable[..., Callable[[], None]],
                 cols.append("compression")
             if tune_hier:
                 cols.append("ladder")
+            if tune_mesh:
+                cols.append("mesh")
             f.write(",".join(cols + ["steps_per_s"]) + "\n")
             f.write("\n".join(log_rows) + "\n")
     return TuneReport(best=table[0], table=table)

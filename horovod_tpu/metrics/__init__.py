@@ -28,10 +28,13 @@ from .aggregate import merge_snapshots  # noqa: F401
 from .exposition import MetricsServer, start_metrics_server  # noqa: F401
 from .overlap import (  # noqa: F401
     last_plan,
+    last_shard_plan,
     last_tier_plan,
     last_wire_plan,
     measure_overlap,
     record_plan,
+    record_shard_plan,
+    record_sharded_state_bytes,
     record_tier_plan,
     record_wire_plan,
 )

@@ -188,6 +188,69 @@ def last_tier_plan() -> Optional[dict]:
     return _last_tier_plan
 
 
+# Latest sharded (ZeRO) plan of the compiled path (ISSUE 14):
+# {"batch": int, "shard": int, "buckets": int,
+#  "scatter_bytes": [...], "gather_bytes": [...],
+#  "bytes_per_step": {"scatter": n, "gather": n}}.
+_last_shard_plan: Optional[dict] = None
+
+
+def record_shard_plan(batch_size: int, shard_size: int,
+                      scatter_bytes: list, gather_bytes: list) -> dict:
+    """Record the latest sharded gradient exchange's plan (trace time, once
+    per compile — same reasoning as record_wire_plan).
+
+    ``scatter_bytes``: per-bucket bytes of the reduce-scatter operand (at
+    the wire dtype — what each bucket's collective moves);
+    ``gather_bytes``: per-bucket bytes of the parameter-refresh allgather
+    (at the storage dtype). On a degenerate shard=1 mesh the gauges still
+    record (scatter == the DP allreduce operand, gather == 0 collectives
+    but the refresh bytes are reported for comparability)."""
+    global _last_shard_plan
+    reg = registry()
+    plan = {"batch": int(batch_size), "shard": int(shard_size),
+            "buckets": len(scatter_bytes),
+            "scatter_bytes": [int(n) for n in scatter_bytes],
+            "gather_bytes": [int(n) for n in gather_bytes],
+            "bytes_per_step": {"scatter": int(sum(scatter_bytes)),
+                               "gather": int(sum(gather_bytes))}}
+    for axis, size in (("batch", batch_size), ("shard", shard_size)):
+        reg.gauge(
+            "horovod_compiled_shard_plan",
+            help="axis sizes of the latest compiled sharded "
+                 "(reduce-scatter/allgather) plan's ('batch','shard') mesh",
+            axis=axis).set(int(size))
+    for stage, total in plan["bytes_per_step"].items():
+        reg.gauge(
+            "horovod_compiled_shard_bytes_per_step",
+            help="gradient-exchange bytes per step per device the latest "
+                 "compiled sharded plan moves in each stage (scatter = "
+                 "reduce-scatter operand at wire dtype, gather = parameter "
+                 "refresh at storage dtype)", stage=stage).set(total)
+    reg.set_info("compiled_shard_plan", plan)
+    _last_shard_plan = plan
+    return plan
+
+
+def last_shard_plan() -> Optional[dict]:
+    """The most recent sharded gradient exchange's plan."""
+    return _last_shard_plan
+
+
+def record_sharded_state_bytes(total_bytes: int, shard_size: int) -> float:
+    """Publish the per-rank parameter+optimizer-state footprint of a sharded
+    training state (the headline ISSUE 14 measurement: ~shard-fold smaller
+    than DP's fully-replicated state). ``total_bytes`` is the global state
+    size; each rank persists 1/shard_size of it."""
+    per_rank = total_bytes / max(1, shard_size)
+    registry().gauge(
+        "horovod_sharded_state_bytes_per_rank",
+        help="bytes of parameters + optimizer state each rank persists "
+             "under the current sharded (ZeRO) layout; equals the full "
+             "state size when shard=1 (plain DP)").set(per_rank)
+    return per_rank
+
+
 # --------------------------------------------------------------- trace parse
 
 

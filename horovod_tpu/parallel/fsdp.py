@@ -24,6 +24,18 @@ optimizer state, done.
 Storage layout: every leaf is flattened, zero-padded to a multiple of the
 axis size, and viewed as ``(axis_size, chunk)`` — shard with
 ``in_specs=P(axis)`` so each device holds its ``(1, chunk)`` row.
+
+Zero-pad discipline (ISSUE 14 fix): gradients on the pad tail are exactly
+zero (the ``flat[:size]`` slice in the gather transposes to zero), but an
+optimizer chain is free to move zero-gradient entries (gradient noise,
+schedule interpolation, decay of restored garbage) — apply
+:func:`fsdp_mask_updates` to the optimizer's updates so the tail stays
+bitwise 0.0 and is never silently carried into checkpoints.
+
+This module is the standalone per-leaf prototype; the planner-integrated
+version — buckets as the shard unit, wire compression, plan gauges, the
+DistributedOptimizer path — lives in ``parallel/sharded.py``
+(docs/sharded.md).
 """
 
 from __future__ import annotations
@@ -31,6 +43,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ..compat import axis_size as _axis_size_in_trace
 
 FSDP_AXIS = "fsdp"
 
@@ -66,6 +80,31 @@ def fsdp_gather_params(local_shards, shapes, axis_name: str = FSDP_AXIS):
         return flat[:size].reshape(shape)
 
     return jax.tree_util.tree_map(gather, local_shards, shapes)
+
+
+def fsdp_mask_updates(updates, shapes, axis_name: str = FSDP_AXIS):
+    """Zero each update's pad-tail entries — call inside shard_map on the
+    optimizer's updates before ``optax.apply_updates``.
+
+    The pad tail receives exactly-zero GRADIENTS, but optimizer updates
+    there are not guaranteed zero for every optax chain, and a drifted tail
+    is silently carried in sharded checkpoints. Leaves whose size already
+    tiles the axis (no padding) pass through untouched, so the mask is
+    free where it isn't needed."""
+    asz = _axis_size_in_trace(axis_name)
+
+    def mask(u, shape):
+        size = 1
+        for d in shape:
+            size *= d
+        chunk = u.shape[-1]
+        if chunk * asz == size:       # no pad on this leaf
+            return u
+        row = lax.axis_index(axis_name)
+        pos = row * chunk + jnp.arange(chunk)
+        return jnp.where((pos < size)[None, :], u, jnp.zeros_like(u))
+
+    return jax.tree_util.tree_map(mask, updates, shapes)
 
 
 def fsdp_unshard_params(sharded, shapes):

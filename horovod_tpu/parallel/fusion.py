@@ -123,6 +123,25 @@ def build_plan(tree, threshold: int = DEFAULT_FUSION_THRESHOLD, pad_to: int = 1,
     return FusionPlan(treedef, tuple(tuple(b) for b in buckets), pad_to)
 
 
+def dcn_capped_threshold(threshold: int, dcn_threshold: Optional[int],
+                         scatter_width: int) -> int:
+    """Compose the per-fabric-tier bucket cap with the plain threshold.
+
+    A bucket whose exchange scatters 1/``scatter_width`` of its bytes over
+    the slow fabric (the hierarchical ladder's cross-host psum, or the
+    sharded planner's per-shard chunk) is bounded by
+    ``HOROVOD_DCN_FUSION_THRESHOLD`` on that tier, so the effective bucket
+    cap is ``dcn_threshold * scatter_width`` — min-composed with the plain
+    threshold (both stay hard caps). ``dcn_threshold`` None reads the env;
+    0 means no separate cap."""
+    if dcn_threshold is None:
+        dcn_threshold = _env_int("HOROVOD_DCN_FUSION_THRESHOLD", 0)
+    if dcn_threshold and dcn_threshold > 0:
+        cap = int(dcn_threshold) * int(scatter_width)
+        return min(threshold, cap) if threshold > 0 else cap
+    return threshold
+
+
 def _reverse_order_buckets(descs: Sequence[_Leaf], num_buckets: int,
                            threshold: int) -> list[list[_Leaf]]:
     """K-way byte-balanced split in reverse leaf order (overlap plan).
@@ -326,12 +345,10 @@ def fused_allreduce(
         # Per-fabric-tier bucket sizing: cap what any single bucket ships
         # over the slow fabric. A bucket's DCN shard is nbytes/ici_size, so
         # a DCN cap of D bounds bucket bytes at D*ici_size — composed with
-        # the plain threshold as a min (both remain hard caps).
-        if dcn_threshold is None:
-            dcn_threshold = _env_int("HOROVOD_DCN_FUSION_THRESHOLD", 0)
-        if dcn_threshold and dcn_threshold > 0:
-            cap = int(dcn_threshold) * int(pad_to)
-            threshold = min(threshold, cap) if threshold > 0 else cap
+        # the plain threshold as a min (both remain hard caps). Shared with
+        # the sharded planner (sharded.build_shard_plan), where the scatter
+        # width is the shard axis size.
+        threshold = dcn_capped_threshold(threshold, dcn_threshold, pad_to)
     plan = build_plan(tree, threshold, pad_to=pad_to, num_buckets=num_buckets)
     # Telemetry (ISSUE 2): record the bucket geometry — count, per-bucket
     # bytes in issue order, buffer occupancy, planned overlap bound — in

@@ -33,6 +33,11 @@ from ..compat import axis_size
 HVD_AXIS = "hvd"
 DCN_AXIS = "dcn"
 ICI_AXIS = "ici"
+# The 2-D sharded-data-parallel mesh (ISSUE 14, docs/sharded.md): gradients
+# average over 'batch' (plain DP replicas) and parameters/grads/optimizer
+# state shard 1/shard_size over 'shard' (the ZeRO wire pattern).
+BATCH_AXIS = "batch"
+SHARD_AXIS = "shard"
 
 
 def _devices(devices=None):
@@ -101,6 +106,79 @@ def training_mesh(
     except Exception:
         arr = np.asarray(devs).reshape(tuple(sizes))
     return Mesh(arr, tuple(axis_names))
+
+
+def parse_mesh_spec(spec: str, n_devices: int) -> tuple[int, int]:
+    """Parse a ``HOROVOD_MESH`` value — ``"<batch>x<shard>"`` (e.g. ``"4x2"``)
+    — into concrete ``(batch, shard)`` sizes for ``n_devices`` chips.
+
+    Either side may be ``-1`` ("use all remaining devices"); an empty spec
+    resolves to the degenerate pure-DP mesh ``(n_devices, 1)``. Raises on a
+    malformed spec or a shape that does not tile the device count — the
+    mesh is a value-affecting knob, and a silently-misparsed shape would
+    train a different model layout than the operator asked for."""
+    s = (spec or "").strip().lower().replace("×", "x")
+    if not s:
+        return n_devices, 1
+    parts = s.split("x")
+    if len(parts) != 2:
+        raise ValueError(
+            f"HOROVOD_MESH={spec!r}: expected '<batch>x<shard>' (e.g. '4x2')")
+    try:
+        batch, shard = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"HOROVOD_MESH={spec!r}: sizes must be integers (or -1)") from None
+    if batch == -1 and shard == -1:
+        raise ValueError(f"HOROVOD_MESH={spec!r}: at most one side may be -1")
+    if shard == -1:
+        if batch <= 0 or n_devices % batch:
+            raise ValueError(
+                f"HOROVOD_MESH={spec!r}: {n_devices} devices not divisible "
+                f"by batch={batch}")
+        shard = n_devices // batch
+    elif batch == -1:
+        if shard <= 0 or n_devices % shard:
+            raise ValueError(
+                f"HOROVOD_MESH={spec!r}: {n_devices} devices not divisible "
+                f"by shard={shard}")
+        batch = n_devices // shard
+    if batch <= 0 or shard <= 0 or batch * shard != n_devices:
+        raise ValueError(
+            f"HOROVOD_MESH={spec!r} needs {batch}x{shard}="
+            f"{batch * shard} devices, have {n_devices}")
+    return batch, shard
+
+
+def sharded_mesh(batch: int | None = None, shard: int | None = None,
+                 devices=None) -> Mesh:
+    """2-D ``('batch', 'shard')`` mesh for sharded data parallelism
+    (docs/sharded.md). With both sizes ``None`` the shape comes from
+    ``HOROVOD_MESH`` (``"<batch>x<shard>"``; unset = pure DP, shard=1).
+
+    The shard axis is laid out as the MINOR (fast-varying) dimension so the
+    every-step reduce-scatter/allgather rides adjacent chips, mirroring how
+    ``hierarchical_mesh`` keeps the ICI axis minor; the once-per-step batch
+    psum crosses the slower boundaries."""
+    devs = _devices(devices)
+    n = len(devs)
+    if batch is None and shard is None:
+        import os
+
+        batch, shard = parse_mesh_spec(os.environ.get("HOROVOD_MESH", ""), n)
+    elif batch is None:
+        batch, shard = parse_mesh_spec(f"-1x{shard}", n)
+    elif shard is None:
+        batch, shard = parse_mesh_spec(f"{batch}x-1", n)
+    else:
+        batch, shard = parse_mesh_spec(f"{batch}x{shard}", n)
+    try:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh((batch, shard), devices=devs)
+    except Exception:
+        arr = np.asarray(devs).reshape(batch, shard)
+    return Mesh(arr, (BATCH_AXIS, SHARD_AXIS))
 
 
 def mesh_rank(axis_name: str = HVD_AXIS):

@@ -1,0 +1,378 @@
+"""Sharded data parallelism (ZeRO / FSDP) through the bucketed planner.
+
+ISSUE 14 tentpole: the same synchronous-SGD contract every Horovod data
+plane honors — identical gradients applied to identical parameters on every
+replica — can run with parameters, gradients, and optimizer state sharded
+1/N per device (Rajbhandari et al., ZeRO; Zhao et al., FSDP). The swap is
+purely on the wire: the per-bucket ``allreduce`` of the DP planner becomes
+
+    reduce-scatter(bucket grads -> owning shard)   # equal ring bytes
+    ... optimizer update on the 1/N shard ...
+    allgather(bucket params)                       # the parameter refresh
+
+over a named 2-D ``('batch', 'shard')`` mesh (mesh.sharded_mesh):
+gradients still average across 'batch' (plain DP replicas), while 'shard'
+carries the ZeRO partition. The degenerate ``shard=1`` mesh compiles to
+BITWISE the DP plan — same buckets, same wire casts, same psum — so the
+sharded path is a strict superset, not a fork.
+
+The bucket layout IS the shard layout (the fsdp.py ``(axis_size, chunk)``
+prototype promoted to the planner's substrate): fusion.build_plan packs
+leaves into same-dtype buckets padded to a multiple of the shard axis size,
+and each rank owns one ``(1, chunk)`` row per bucket. Because buckets are
+the unit of exchange, everything the planner already knows — per-tier
+bucket sizing (HOROVOD_DCN_FUSION_THRESHOLD), the per-bucket wire-dtype
+opt-outs (compression.md), trace-time plan gauges — applies unchanged.
+
+Data model
+----------
+
+:class:`ShardedBuckets` is a registered pytree holding one buffer per
+bucket. Host-side the buffers are ``(shard_size, chunk)``; inside
+shard_map (``in_specs=P('shard')``) each rank sees its ``(1, chunk)`` row.
+Optimizer state built by ``optimizer.init(sharded_params)`` mirrors the
+container, so moments shard for free and
+:func:`unshard_tree` / :func:`reshard_tree` can consolidate / re-partition
+a whole training state for checkpoints (checkpoint.save_sharded).
+
+Zero-pad discipline: fuse() pads each bucket's tail with zeros. Gradients
+at the tail are exactly zero (fuse pads the gradient buffer the same way),
+and :func:`mask_pad_updates` forces optimizer updates there to zero, so
+the tail stays bitwise 0.0 forever — never trained, never leaked into
+checkpoints (consolidation drops it; re-sharding re-pads fresh zeros).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import collectives, fusion
+from .collectives import ReduceOp
+from .mesh import BATCH_AXIS, SHARD_AXIS
+from ..common.config import Config
+from ..compression import compression_name
+
+
+@jax.tree_util.register_pytree_node_class
+class ShardedBuckets:
+    """Pytree container of per-bucket shard buffers.
+
+    Being a registered pytree is the load-bearing property: optax
+    transformations tree_map straight through it (so ``optimizer.init``
+    produces sharded moments), shard_map specs treat it as a prefix
+    position, and :func:`unshard_tree` can find every sharded sub-state in
+    an arbitrary training-state pytree by ``isinstance``."""
+
+    def __init__(self, buffers: Sequence):
+        self.buffers = tuple(buffers)
+
+    def tree_flatten(self):
+        return self.buffers, None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(children)
+
+    def __len__(self) -> int:
+        return len(self.buffers)
+
+    def __iter__(self):
+        return iter(self.buffers)
+
+    def __getitem__(self, i):
+        return self.buffers[i]
+
+    def __repr__(self) -> str:
+        shapes = ",".join(str(tuple(getattr(b, "shape", ()))) for b in self.buffers)
+        return f"ShardedBuckets([{shapes}])"
+
+
+@dataclass(frozen=True)
+class ShardPlan:
+    """A FusionPlan bound to a shard axis size: the bucket layout doubles as
+    the parameter-partition layout. Built once (deterministically — every
+    rank derives the identical plan from the tree structure and the knobs)
+    and shared by shard_params / gather_params / reduce_scatter_gradients /
+    the checkpoint consolidators."""
+
+    base: fusion.FusionPlan
+    shard_size: int
+    threshold: int
+    raw_sizes: tuple          # per-bucket elements before padding
+    padded_sizes: tuple       # per-bucket elements after padding
+    chunk_sizes: tuple        # per-rank elements: padded // shard_size
+    bucket_dtypes: tuple
+
+    @property
+    def num_buckets(self) -> int:
+        return self.base.num_buckets
+
+    def state_bytes_per_rank(self) -> int:
+        """Bytes of ONE sharded copy of the tree per rank (params; multiply
+        by the optimizer's state factor for moments)."""
+        return sum(c * jnp.dtype(d).itemsize
+                   for c, d in zip(self.chunk_sizes, self.bucket_dtypes))
+
+
+def build_shard_plan(tree, shard_size: int, threshold: Optional[int] = None,
+                     num_buckets: Optional[int] = None,
+                     dcn_threshold: Optional[int] = None) -> ShardPlan:
+    """Plan the sharded bucketing of ``tree``'s leaves.
+
+    Same knobs as the DP planner — ``threshold`` None reads
+    HOROVOD_FUSION_THRESHOLD, ``num_buckets`` None reads
+    HOROVOD_NUM_BUCKETS — plus the per-tier cap: a bucket's reduce-scatter
+    ships 1/shard of its bytes per rank, so HOROVOD_DCN_FUSION_THRESHOLD
+    bounds bucket bytes at D*shard_size exactly as it does for the
+    hierarchical ladder (fusion.dcn_capped_threshold). On ``shard_size=1``
+    the plan is identical to the DP plan (pad_to=1, no padding)."""
+    if shard_size < 1:
+        raise ValueError(f"shard_size must be >= 1, got {shard_size}")
+    cfg = None
+    if threshold is None:
+        cfg = Config.from_env()
+        threshold = cfg.fusion_threshold
+    if num_buckets is None:
+        cfg = cfg or Config.from_env()
+        num_buckets = cfg.num_buckets
+    if shard_size > 1:
+        threshold = fusion.dcn_capped_threshold(threshold, dcn_threshold,
+                                                shard_size)
+    plan = fusion.build_plan(tree, threshold, pad_to=shard_size,
+                             num_buckets=num_buckets)
+    raw, padded, chunks, dtypes = [], [], [], []
+    for bucket in plan.buckets:
+        n = sum(d.size for d in bucket)
+        p = -(-n // shard_size) * shard_size
+        raw.append(n)
+        padded.append(p)
+        chunks.append(p // shard_size)
+        dtypes.append(bucket[0].dtype)
+    return ShardPlan(plan, int(shard_size), int(threshold), tuple(raw),
+                     tuple(padded), tuple(chunks), tuple(dtypes))
+
+
+def shard_params(params, plan: ShardPlan) -> ShardedBuckets:
+    """Partition a full pytree into the plan's bucket layout: each bucket is
+    fused (flatten + concatenate + zero-pad) and viewed as
+    ``(shard_size, chunk)`` rows — pass into shard_map with
+    ``in_specs=P('shard')`` so each rank receives its row."""
+    buffers = fusion.fuse(params, plan.base)
+    return ShardedBuckets(
+        b.reshape(plan.shard_size, -1) for b in buffers)
+
+
+def unshard_params(sharded: ShardedBuckets, plan: ShardPlan):
+    """Host-side inverse of :func:`shard_params`: rebuild the full pytree
+    from the ``(shard_size, chunk)`` buffers, dropping the pad tail."""
+    flat = [jnp.reshape(b, (-1,)) for b in sharded]
+    return fusion.unfuse(flat, plan.base)
+
+
+def gather_params(sharded: ShardedBuckets, plan: ShardPlan,
+                  shard_axis: str = SHARD_AXIS):
+    """The ZeRO parameter refresh, inside shard_map: one tiled
+    ``all_gather`` per bucket rebuilds the full parameters from each rank's
+    ``(1, chunk)`` rows. Differentiable — the all_gather transpose delivers
+    each full-parameter gradient as the reduce-scatter-sum into the owning
+    shard, which is exactly what :func:`reduce_scatter_gradients` computes
+    explicitly for the bucketed path. On ``shard_size=1`` no collective is
+    emitted (the row IS the bucket), keeping the degenerate mesh's HLO
+    identical to DP."""
+    flat = []
+    for b in sharded:
+        if plan.shard_size == 1:
+            flat.append(jnp.reshape(b, (-1,)))
+        else:
+            flat.append(lax.all_gather(b[0], shard_axis, axis=0, tiled=True))
+    return fusion.unfuse(flat, plan.base)
+
+
+def reduce_scatter_gradients(
+    grads,
+    plan: Optional[ShardPlan] = None,
+    *,
+    batch_axis: str = BATCH_AXIS,
+    shard_axis: str = SHARD_AXIS,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    compression=None,
+    compression_min_bytes: Optional[int] = None,
+    threshold: Optional[int] = None,
+    num_buckets: Optional[int] = None,
+) -> ShardedBuckets:
+    """The sharded gradient exchange: fuse -> (wire cast) -> per-bucket
+    ``psum_scatter`` into the owning shard over ``shard_axis`` -> ``psum``
+    across ``batch_axis`` -> (cast back, average) — ZeRO's equal-wire-cost
+    replacement for the bucketed allreduce.
+
+    ``grads`` is the FULL gradient pytree (what ``jax.grad`` of a loss over
+    :func:`gather_params`-rebuilt parameters produces); the result is a
+    :class:`ShardedBuckets` matching the parameter shard layout, ready for
+    the inner optimizer update. Wire compression reuses the DP planner's
+    per-bucket verdicts unchanged (wire_dtype_for_bucket opt-outs; the cast
+    wraps BOTH collectives, so scatter and batch-psum ship wire-width).
+
+    On a degenerate ``shard=1`` mesh the exchange is literally
+    ``collectives.bucketed_allreduce`` over ``batch_axis`` — the same call,
+    cast sequence, and plan the DP path compiles — so sharded==DP holds
+    bitwise there."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"sharded gradient exchange supports SUM/AVERAGE only (got "
+            f"{op}); reduce-scatter is a sum machine")
+    if plan is None:
+        shard_size = fusion._axis_size(shard_axis)
+        if shard_size is None:
+            raise ValueError(
+                f"reduce_scatter_gradients needs the size of axis "
+                f"{shard_axis!r}: call inside shard_map over a "
+                f"('{batch_axis}', '{shard_axis}') mesh or pass plan=")
+        plan = build_shard_plan(grads, shard_size, threshold, num_buckets)
+    shard_size = plan.shard_size
+    batch_size = fusion._axis_size(batch_axis)
+    if batch_size is None:
+        if shard_size > 1:
+            raise ValueError(
+                f"reduce_scatter_gradients needs the size of axis "
+                f"{batch_axis!r} in scope (the batch psum); got none")
+        batch_size = 1
+
+    from ..metrics import (record_plan, record_shard_plan, record_wire_plan)
+
+    record_plan(plan.base, plan.threshold)
+    buffers = fusion.fuse(grads, plan.base)
+    orig_dtypes = [buf.dtype for buf in buffers]
+    wire = [fusion.wire_dtype_for_bucket(compression, buf.dtype,
+                                         int(buf.nbytes), op,
+                                         compression_min_bytes)
+            for buf in buffers]
+    record_wire_plan(
+        compression_name(compression),
+        [(int(b.nbytes), w is not None,
+          int(b.size) * (jnp.dtype(w).itemsize if w is not None else 0))
+         for b, w in zip(buffers, wire)])
+    # Trace-time shard-plan gauges (ISSUE 14 satellite): axis sizes plus
+    # per-bucket scatter/gather bytes — the scatter operand ships at the
+    # wire dtype, the parameter-refresh gather at the storage dtype.
+    record_shard_plan(
+        batch_size, shard_size,
+        scatter_bytes=[int(b.size) * int(jnp.dtype(w).itemsize
+                                         if w is not None else b.dtype.itemsize)
+                       for b, w in zip(buffers, wire)],
+        gather_bytes=[int(b.nbytes) for b in buffers])
+    from ..tracing import record_compiled_plan
+
+    record_compiled_plan(
+        plan.num_buckets, [int(b.nbytes) for b in buffers],
+        compression_name(compression), [w is not None for w in wire])
+    buffers = [b.astype(w) if w is not None else b
+               for b, w in zip(buffers, wire)]
+    with jax.named_scope(
+            f"hvd_sharded_reduce_scatter_k{len(buffers)}s{shard_size}"):
+        if shard_size == 1:
+            # Bitwise the DP path: identical collective call over the batch
+            # axis (pmean divides at the wire dtype exactly as
+            # fused_allreduce does), then the identical back-cast.
+            reduced = collectives.bucketed_allreduce(buffers, batch_axis, op)
+            reduced = [r.astype(dt) if w is not None else r
+                       for r, w, dt in zip(reduced, wire, orig_dtypes)]
+        else:
+            world = shard_size * batch_size
+            reduced = []
+            for buf, w, dt in zip(buffers, wire, orig_dtypes):
+                chunk = lax.psum_scatter(buf, shard_axis,
+                                         scatter_dimension=0, tiled=True)
+                if batch_size > 1:
+                    chunk = lax.psum(chunk, batch_axis)
+                if w is not None:
+                    chunk = chunk.astype(dt)
+                if op == ReduceOp.AVERAGE:
+                    chunk = chunk / world
+                reduced.append(chunk)
+    return ShardedBuckets(r.reshape(1, -1) for r in reduced)
+
+
+def mask_pad_updates(updates, plan: ShardPlan, shard_axis: str = SHARD_AXIS):
+    """Zero the optimizer update on each bucket's zero-pad tail (inside
+    shard_map). Gradients there are exactly zero by construction, but an
+    optimizer chain is free to move zero-gradient entries (weight decay on
+    restored garbage, gradient noise, schedule interpolation) — this mask
+    is what makes 'the tail stays bitwise 0.0' an invariant instead of a
+    hope (the fsdp.py prototype's pad-leak fix, applied natively here).
+
+    Buckets without padding (always the case on shard=1) are untouched —
+    no mask op enters the HLO, preserving the degenerate mesh's bitwise
+    identity with DP."""
+    if not isinstance(updates, ShardedBuckets):
+        raise TypeError(f"expected ShardedBuckets updates, got {type(updates)}")
+    out = []
+    for b, buf in enumerate(updates):
+        raw, chunk = plan.raw_sizes[b], plan.chunk_sizes[b]
+        if raw == plan.padded_sizes[b]:
+            out.append(buf)
+            continue
+        if buf.shape[0] == plan.shard_size:
+            # Host-side (shard_size, chunk) view: global positions.
+            pos = jnp.arange(plan.padded_sizes[b]).reshape(plan.shard_size,
+                                                           chunk)
+        else:
+            row = lax.axis_index(shard_axis)
+            pos = (row * chunk + jnp.arange(chunk))[None, :]
+        out.append(jnp.where(pos < raw, buf, jnp.zeros_like(buf)))
+    return ShardedBuckets(out)
+
+
+def _is_sharded(x) -> bool:
+    return isinstance(x, ShardedBuckets)
+
+
+def unshard_tree(tree, plan: ShardPlan):
+    """Consolidate every :class:`ShardedBuckets` in an arbitrary pytree
+    (training state, optimizer moments, ...) into full leaves — the
+    mesh-shape-independent form checkpoints store (the pad tail is dropped,
+    so it can never be carried in a checkpoint). Non-sharded leaves pass
+    through untouched."""
+    return jax.tree_util.tree_map(
+        lambda x: unshard_params(x, plan) if _is_sharded(x) else x,
+        tree, is_leaf=_is_sharded)
+
+
+def reshard_tree(full, template, plan: ShardPlan):
+    """Inverse of :func:`unshard_tree`: re-partition the full-leaf pytree
+    ``full`` into ``template``'s shard layout (fresh zero pad). ``template``
+    is the live sharded state — it tells us WHERE the sharded sub-states
+    sit; ``plan`` may target a different shard_size than the state that was
+    saved, which is what makes sharded checkpoints restorable onto a
+    reshaped mesh."""
+    return jax.tree_util.tree_map(
+        lambda t, f: shard_params(f, plan) if _is_sharded(t) else f,
+        template, full, is_leaf=_is_sharded)
+
+
+def shard_specs(tree, shard_axis: str = SHARD_AXIS):
+    """shard_map in/out specs for a (possibly nested) sharded state:
+    ``P(shard_axis)`` at every :class:`ShardedBuckets` position (a prefix
+    spec — it applies to each buffer row-wise), ``P()`` (replicated) for
+    everything else (step counters, scalars)."""
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree_util.tree_map(
+        lambda x: P(shard_axis) if _is_sharded(x) else P(),
+        tree, is_leaf=_is_sharded)
+
+
+def state_bytes(tree) -> int:
+    """Total array bytes in a pytree (host view: sharded buffers count their
+    FULL (shard_size, chunk) global footprint — divide by shard_size for
+    the per-rank share)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(getattr(leaf, "nbytes",
+                             jnp.asarray(leaf).nbytes))
+    return total

@@ -12,6 +12,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.parallel.fsdp import (
     fsdp_gather_params,
+    fsdp_mask_updates,
     fsdp_shard_params,
     fsdp_unshard_params,
 )
@@ -156,6 +157,79 @@ def test_dp_x_fsdp_matches_replicated_training():
                     jax.tree_util.tree_leaves(ref_params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-5)
+
+
+def test_fsdp_pad_tail_stays_zero_with_masked_updates(fsdp_mesh):
+    """The ISSUE 14 pad-leak fix: an optimizer chain that moves
+    zero-gradient entries (gradient noise here) drifts the zero-pad tail,
+    which is then silently carried in checkpoints; fsdp_mask_updates pins
+    the tail to bitwise 0.0 without touching real elements."""
+    params = make_params()
+    sharded, shapes = fsdp_shard_params(params, N)
+    opt = optax.chain(optax.adam(1e-2), optax.add_noise(0.01, 0.0, 0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (BATCH * N, DIM_IN))
+    y = jax.random.normal(jax.random.PRNGKey(2), (BATCH * N, DIM_OUT))
+
+    def make_step(mask):
+        opt_state = opt.init(sharded)
+        # Shard only the (N, chunk) moment leaves; scalars AND the noise
+        # chain's (2,)-shaped rng key stay replicated.
+        state_specs = jax.tree_util.tree_map(
+            lambda l: P("fsdp") if getattr(l, "ndim", 0) > 0
+            and l.shape[0] % N == 0 else P(),
+            opt_state)
+
+        def step(shards, opt_state, x, y):
+            def sharded_loss(shards):
+                full = fsdp_gather_params(shards, shapes, "fsdp")
+                return loss_fn(full, x, y)
+
+            grads = jax.tree_util.tree_map(
+                lambda g: g / N, jax.grad(sharded_loss)(shards))
+            upd, opt_state = opt.update(grads, opt_state, shards)
+            if mask:
+                upd = fsdp_mask_updates(upd, shapes, "fsdp")
+            return optax.apply_updates(shards, upd), opt_state
+
+        return jax.jit(shard_map(
+            step, mesh=fsdp_mesh,
+            in_specs=(P("fsdp"), state_specs, P("fsdp"), P("fsdp")),
+            out_specs=(P("fsdp"), state_specs), check_vma=False)), opt_state
+
+    def tails(tree):
+        out = []
+
+        def collect(s, shape):
+            size = int(np.prod(shape)) if shape else 1
+            out.append(np.asarray(s).reshape(-1)[size:])
+            return s
+
+        jax.tree_util.tree_map(collect, tree, shapes)
+        return np.concatenate([t for t in out if t.size]) \
+            if any(t.size for t in out) else np.zeros(0)
+
+    assert tails(sharded).size > 0, "test vacuous: no leaf had padding"
+
+    # Unmasked control: the tail provably drifts (the leak).
+    step_u, st_u = make_step(mask=False)
+    drifted = jax.tree_util.tree_map(jnp.copy, sharded)
+    for _ in range(3):
+        drifted, st_u = step_u(drifted, st_u, x, y)
+    assert (tails(drifted) != 0.0).any(), \
+        "control broken: unmasked noise did not move the tail"
+
+    # Masked: tail bitwise zero, real elements identical to the unmasked
+    # run (the mask only ever touches pad positions).
+    step_m, st_m = make_step(mask=True)
+    clean = jax.tree_util.tree_map(jnp.copy, sharded)
+    for _ in range(3):
+        clean, st_m = step_m(clean, st_m, x, y)
+    assert (tails(clean) == 0.0).all(), "masked update leaked into the tail"
+    for a, b in zip(jax.tree_util.tree_leaves(
+                        fsdp_unshard_params(drifted, shapes)),
+                    jax.tree_util.tree_leaves(
+                        fsdp_unshard_params(clean, shapes))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_fsdp_memory_is_sharded(fsdp_mesh):
