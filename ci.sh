@@ -119,6 +119,9 @@ python tools/perf_gate.py --current /tmp/hvd_llm_smoke.log \
   --require-metric llm_smoke_decode_tokens_per_s \
   --min-abs llm_smoke_decode_tokens_per_s=150 --allow-missing-baseline
 
+echo "== obs smoke (ISSUE 15 observability: injected decode slowdown fires the ttft_slo anomaly + flight dump; SIGKILL'd decode replica's mmap flight ring survives; one-command bundle names the dead replica, merges a strict mixed-plane trace, and a /v1/generate request is followable admit->queue->prefill->handoff->decode->retire with TTFT decomposed by phase) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
+
 echo "== fast tier (includes the launcher e2e: test_run_happy_path) =="
 python -m pytest tests/ -m fast -q
 

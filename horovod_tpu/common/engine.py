@@ -1791,6 +1791,18 @@ class PyEngine:
             self._trace.span("plane#demote", name or "plane", "allreduce",
                              "plane_demote", t, t, reason=str(reason)[:200])
         try:
+            # Flight-recorder escalation (ISSUE 15): a demotion is one of
+            # the dump triggers — the ring holds the spans and metric
+            # deltas of the seconds before the link went bad.
+            from ..tracing import flight as _flight
+
+            fl = _flight.get_flight()
+            fl.event("plane_demote", rank=self.topo.rank,
+                     collective=name, reason=str(reason)[:200])
+            fl.dump(f"plane-demote-rank{self.topo.rank}")
+        except Exception:  # noqa: BLE001 - telemetry never blocks recovery
+            pass
+        try:
             plane.close()
         except Exception:  # noqa: BLE001 - teardown of a broken plane
             pass

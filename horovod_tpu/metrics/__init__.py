@@ -25,6 +25,7 @@ Full reference: docs/metrics.md.
 from __future__ import annotations
 
 from .aggregate import merge_snapshots  # noqa: F401
+from .anomaly import AnomalyDetector  # noqa: F401
 from .exposition import MetricsServer, start_metrics_server  # noqa: F401
 from .overlap import (  # noqa: F401
     last_plan,

@@ -83,11 +83,15 @@ class StallWatchdog:
                  rank: int = 0,
                  on_abort: Optional[Callable[[StallInfo], None]] = None,
                  reg: Optional[MetricsRegistry] = None,
-                 poll_interval_s: Optional[float] = None) -> None:
+                 poll_interval_s: Optional[float] = None,
+                 on_warn: Optional[Callable[[list], None]] = None) -> None:
         self.check_time_s = float(check_time_s)
         self.shutdown_time_s = float(shutdown_time_s)
         self.rank = rank
         self.on_abort = on_abort
+        # Optional escalation hook fired once per fresh warning batch —
+        # serving replicas trip a flight-recorder dump here (ISSUE 15).
+        self.on_warn = on_warn
         self.reg = reg or registry()
         # Poll a few times per warning window so a stall is reported within
         # ~1.25x of check_time even for sub-second test configurations.
@@ -153,6 +157,24 @@ class StallWatchdog:
             text = format_report(stalled, self.check_time_s)
             log("warning", text, rank=self.rank)
             self._warn_counter.inc()
+            try:
+                # Always retained in the process flight ring (ISSUE 15):
+                # a stall that later becomes a crash has its onset on
+                # record even when nobody wired an escalation hook.
+                from ..tracing import flight as _flight
+
+                _flight.get_flight().event(
+                    "stall", rank=self.rank,
+                    stalled=[{"name": s.name, "op": s.op,
+                              "age_s": round(s.age_s, 3)}
+                             for s in stalled[:16]])
+            except Exception:
+                pass
+            if self.on_warn is not None:
+                try:
+                    self.on_warn(stalled)
+                except Exception:   # escalation must not kill the watchdog
+                    pass
         # Publish/refresh the structured report every scan while stalled, so
         # a reader always sees current ages.
         rep = StallReport(time_unix_s=time.time(), rank=self.rank,
@@ -176,6 +198,15 @@ class StallWatchdog:
                         f"stall watchdog: aborting {s.name} after "
                         f"{s.age_s:.1f}s (> HOROVOD_STALL_SHUTDOWN_TIME="
                         f"{self.shutdown_time_s:g}s)", rank=self.rank)
+                    try:
+                        # Escalation is a flight-dump trigger: capture
+                        # the ring before failing the collective.
+                        from ..tracing import flight as _flight
+
+                        _flight.get_flight().dump(
+                            f"stall-abort-{s.name}")
+                    except Exception:
+                        pass
                     # An abort hook may return False to signal "not handled
                     # yet" (e.g. the entry was momentarily checked out of
                     # the engine queue by an in-flight exchange) — retry on

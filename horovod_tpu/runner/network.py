@@ -303,7 +303,15 @@ class BasicService:
             ch = Channel(conn, self.key, server=True)
             while not self._stop.is_set():
                 req = ch.recv()
-                resp = self.handle(req, addr)
+                if isinstance(req, dict) and req.get("kind") == "clock_probe":
+                    # Built-in NTP responder (tracing/clock.py): EVERY
+                    # authenticated service — driver, serving replicas, LLM
+                    # replicas — answers its monotonic clock so the client
+                    # side can align span timestamps without each subclass
+                    # re-implementing the exchange.
+                    resp = {"ok": True, "t": time.monotonic_ns()}
+                else:
+                    resp = self.handle(req, addr)
                 ch.send(resp)
         except (ConnectionError, OSError, EOFError, PermissionError):
             pass

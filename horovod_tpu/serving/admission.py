@@ -163,6 +163,19 @@ class KVAdmission:
             return float("inf")
         return deficit / rate
 
+    def refresh_projection(self, free_blocks: int,
+                           queued_blocks: int) -> float:
+        """Recompute the projected wait of the CURRENT backlog (a
+        zero-block probe) and publish it — called on every decode stats
+        tick so ``horovod_serve_projected_wait_seconds`` stays live even
+        when no admission decision is running (parked clients). The
+        anomaly detector's ``ttft_slo`` rule reads this gauge: a backlog
+        that projects past the TTFT SLO is a breach whether or not a new
+        request happens to arrive to observe it (metrics/anomaly.py)."""
+        wait = self.projected_wait_s(0, free_blocks, queued_blocks)
+        self._wait_gauge.set(wait)
+        return wait
+
     def admit(self, blocks_needed: int, free_blocks: int,
               queued_blocks: int,
               budget_s: Optional[float] = None) -> Tuple[bool, float]:

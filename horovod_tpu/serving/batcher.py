@@ -44,11 +44,12 @@ class Request:
     it, and a replica completing a batch must not overwrite a 504."""
 
     __slots__ = ("rid", "x", "enqueue_t", "deadline_t", "retries",
-                 "event", "code", "output", "error", "_lock")
+                 "event", "code", "output", "error", "_lock", "tid")
 
     def __init__(self, x: np.ndarray, deadline_t: Optional[float] = None):
         self.rid = next(_rid)
         self.x = x
+        self.tid = f"req:infer:{self.rid}"  # serving trace ID (tracing/serve)
         self.enqueue_t = time.monotonic()
         self.deadline_t = deadline_t
         self.retries = 0

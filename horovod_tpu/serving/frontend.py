@@ -75,6 +75,15 @@ class _Handler(BaseHTTPRequestHandler):
                         {"ok": n >= 1, "replicas": n})
         elif path == "/stats":
             self._reply(200, srv.stats())
+        elif path == "/debug/sequences":
+            # Token-level plane only (LLMServer mirrors the decode pools'
+            # per-sequence scheduler state; docs/inference.md).
+            fn = getattr(srv, "debug_sequences", None)
+            if fn is None:
+                self._reply(404, {"error": "/debug/sequences requires the "
+                                           "LLM serving plane (LLMServer)"})
+            else:
+                self._reply(200, fn())
         else:
             self._reply(404, {"error": f"no route {path}"})
 

@@ -20,6 +20,7 @@ eager engine's adaptive cycle, sized for a serving loop.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -36,7 +37,8 @@ class GenRequest:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "enqueue_t",
                  "deadline_t", "retries", "event", "code", "tokens",
-                 "error", "ttft_s", "done_t", "_lock")
+                 "error", "ttft_s", "done_t", "_lock", "tid",
+                 "prefilled_t")
 
     def __init__(self, prompt, max_new_tokens: int,
                  deadline_t: Optional[float] = None) -> None:
@@ -53,6 +55,8 @@ class GenRequest:
         self.ttft_s: Optional[float] = None   # set once, first-writer wins
         self.done_t = 0.0
         self._lock = threading.Lock()
+        self.tid = f"req:gen:{self.rid}"   # serving trace ID (tracing/serve)
+        self.prefilled_t = 0.0             # handoff-span start (router clock)
 
     def blocks_needed(self, block_size: int) -> int:
         return blocks_for(len(self.prompt) + self.max_new_tokens,
@@ -163,6 +167,7 @@ class DecodeEngine:
     """The replica-side engine: one thread, one scheduler, one lock."""
 
     _IDLE_SLEEP_S = 0.002
+    _METRICS_NOTE_EVERY = 64   # flight-ring metric-delta cadence (iters)
 
     def __init__(self, scheduler: IterationScheduler) -> None:
         self._sched = scheduler
@@ -170,6 +175,15 @@ class DecodeEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._finished: dict[int, dict] = {}   # rid -> completion record
+        # Observability chaos knobs (tools/obs_smoke.py): delay every
+        # decode iteration by DELAY_MS once DELAY_AFTER iterations have
+        # run — a deterministic mid-load slowdown injection, the decode
+        # analog of HOROVOD_FAULT_INJECT_STEP's kill.
+        self._delay_s = float(os.environ.get(
+            "HOROVOD_FAULT_DECODE_DELAY_MS", "") or 0.0) / 1000.0
+        self._delay_after = int(os.environ.get(
+            "HOROVOD_FAULT_DECODE_DELAY_AFTER", "") or 0)
+        self._iters = 0
 
     def start(self) -> "DecodeEngine":
         self._thread = threading.Thread(target=self._run,
@@ -184,12 +198,35 @@ class DecodeEngine:
             self._thread.join(timeout=5)
 
     def _run(self) -> None:
+        from ...tracing import flight as _flight
+
         while not self._stop.is_set():
             with self._lock:
                 decoded = self._sched.step()
                 self._collect_locked()
-            if not decoded:
+            if decoded:
+                self._iters += 1
+                if self._iters % self._METRICS_NOTE_EVERY == 0:
+                    _flight.get_flight().note_metrics()
+                if self._delay_s > 0 and self._iters > self._delay_after:
+                    time.sleep(self._delay_s)
+            else:
                 time.sleep(self._IDLE_SLEEP_S)
+
+    def stall_infos(self) -> list:
+        """Stall-watchdog source (metrics/watchdog.py): when the decode
+        loop has sequences RUNNING but has not completed an iteration
+        since ``last_progress_t``, every stuck sequence is reported by id
+        — the watchdog applies the HOROVOD_STALL_CHECK_TIME threshold."""
+        from ...metrics import StallInfo
+
+        with self._lock:
+            running = list(self._sched.running)
+            age = time.monotonic() - self._sched.last_progress_t
+        if not running:
+            return []
+        return [StallInfo(name=f"seq:{s.seq_id}", op="decode", age_s=age)
+                for s in running]
 
     def _collect_locked(self) -> None:
         while self._sched.finished:
@@ -222,7 +259,9 @@ class DecodeEngine:
             self._finished.clear()
             progress = {s.seq_id: len(s.out) for s in self._sched.running}
             stats = self._sched.stats()
-        return {"finished": finished, "progress": progress, "stats": stats}
+            sequences = self._sched.sequences()
+        return {"finished": finished, "progress": progress, "stats": stats,
+                "sequences": sequences}
 
     def stats(self) -> dict:
         with self._lock:

@@ -59,6 +59,12 @@ class BlockAllocator:
         self._free: deque[int] = deque(range(num_blocks))
         self._tables: dict[object, list[int]] = {}
         self.preemptions_total = 0
+        # Serving tracer (tracing/serve.py; set by the owning scheduler):
+        # block-pressure events are emitted on the EDGE — the first refused
+        # allocation of a pressure episode — so a queue waiting out a long
+        # generation does not spam one event per scheduler iteration.
+        self.tracer = None
+        self._pressure = False
 
     # -- views ---------------------------------------------------------------
 
@@ -99,9 +105,11 @@ class BlockAllocator:
                              f"(alloc after alloc without free/preempt)")
         need = blocks_for(n_tokens, self.block_size)
         if not self.can_alloc(need):
+            self._pressure_event("admission", seq_id, need)
             return None
         table = [self._free.popleft() for _ in range(need)]
         self._tables[seq_id] = table
+        self._pressure = False
         return list(table)
 
     def extend(self, seq_id, n_tokens: int) -> bool:
@@ -115,9 +123,11 @@ class BlockAllocator:
         if need <= 0:
             return True
         if len(self._free) < need:
+            self._pressure_event("growth", seq_id, need)
             return False
         for _ in range(need):
             table.append(self._free.popleft())
+        self._pressure = False
         return True
 
     def free(self, seq_id) -> int:
@@ -138,6 +148,15 @@ class BlockAllocator:
         n = self.free(seq_id)
         self.preemptions_total += 1
         return n
+
+    def _pressure_event(self, kind: str, seq_id, need: int) -> None:
+        if self._pressure or self.tracer is None:
+            self._pressure = True
+            return
+        self._pressure = True
+        self.tracer.point(f"req:gen:{seq_id}", "kv_pressure", kind=kind,
+                          need=need, free=len(self._free),
+                          reserve=self.reserve, used=self.used_count)
 
     def check_invariants(self) -> None:
         """Every block is EITHER free or in exactly one table (the

@@ -33,8 +33,9 @@ from __future__ import annotations
 import dataclasses
 import time
 
+from ...tracing.serve import get_serve_tracer
 from ..manager import ReplicaManager, _Replica
-from .handoff import pack_kv
+from .handoff import handoff_nbytes, pack_kv
 
 _FEED_BATCH = 16          # sequences fed to a decode replica per cycle
 _POLL_IDLE_SLEEP_S = 0.02
@@ -91,6 +92,7 @@ class PoolManager(ReplicaManager):
             rep.drained.set()
 
     def _prefill_worker(self, rep: _Replica) -> None:
+        tracer = get_serve_tracer()
         while not self._closed.is_set() and rep.state == "serving":
             req = self.server.prefill_q.take(0.25)
             if req is None:
@@ -99,9 +101,16 @@ class PoolManager(ReplicaManager):
                 if req.fail(504, "deadline exceeded awaiting prefill"):
                     self.server.count_code(504)
                 continue
+            t0 = time.monotonic()
+            if tracer:
+                # prefill-queue wait, then the prefill RPC — the first
+                # two phases of the TTFT decomposition (docs/tracing.md).
+                tracer.span(req.tid, "queue", int(req.enqueue_t * 1e9),
+                            int(t0 * 1e9), rid=req.rid)
             try:
                 resp = rep.client.request(
-                    {"kind": "prefill", "tokens": req.prompt})
+                    {"kind": "prefill", "tokens": req.prompt,
+                     "trace": req.tid})
             except Exception as e:  # noqa: BLE001 - any wire fault = death
                 self.server.retry_or_fail([req])
                 self._mark_dead(rep, f"prefill dispatch failed: {e}")
@@ -113,11 +122,16 @@ class PoolManager(ReplicaManager):
                     self.server.count_code(503)
                 continue
             rep.requests_done += 1
+            if tracer:
+                tracer.span(req.tid, "prefill", int(t0 * 1e9),
+                            tracer.now_ns(), rid=req.rid, replica=rep.rid,
+                            n_tokens=len(req.prompt))
             self.server.on_prefilled(req, pack_kv(
                 req.prompt, resp["k"], resp["v"], resp["next_token"]))
 
     def _decode_worker(self, rep: _Replica) -> None:
         last_poll_t = time.monotonic()
+        tracer = get_serve_tracer()
         while not self._closed.is_set() and rep.state == "serving":
             in_hand = None
             try:
@@ -134,6 +148,7 @@ class PoolManager(ReplicaManager):
                             self.server.count_code(504)
                         in_hand = None
                         continue
+                    t0 = time.monotonic()
                     if payload is None:   # colocated: prompt straight in
                         resp = rep.client.request(
                             {"kind": "generate", "rid": req.rid,
@@ -158,6 +173,23 @@ class PoolManager(ReplicaManager):
                     in_hand = None
                     fed += 1
                     self.server.count_handoff(req, payload)
+                    if tracer:
+                        # KV handoff: prefill completion -> accepted by
+                        # the decode scheduler (queue time + the
+                        # serialized submit_seq RPC). Colocated requests
+                        # skip prefill, so their queue wait is booked
+                        # here instead of the prefill worker.
+                        if payload is None:
+                            tracer.span(req.tid, "queue",
+                                        int(req.enqueue_t * 1e9),
+                                        int(t0 * 1e9), rid=req.rid)
+                        start = req.prefilled_t or t0
+                        tracer.span(
+                            req.tid, "handoff", int(start * 1e9),
+                            tracer.now_ns(), rid=req.rid, replica=rep.rid,
+                            path="local" if payload is None else "wire",
+                            nbytes=0 if payload is None
+                            else handoff_nbytes(payload))
                 resp = rep.client.request({"kind": "poll"})
             except Exception as e:  # noqa: BLE001 - any wire fault = death
                 if in_hand is not None:
@@ -196,6 +228,7 @@ class PoolManager(ReplicaManager):
             if req is not None:
                 req.mark_first_token()
         self.server.mirror_stats(rep.rid, resp.get("stats", {}), dt_s)
+        self.server.mirror_sequences(rep.rid, resp.get("sequences", []))
         stats = resp.get("stats", {})
         return bool(finished or resp.get("progress")
                     or stats.get("waiting"))

@@ -41,6 +41,8 @@ import traceback
 
 from ...elastic import fault
 from ...runner.network import BasicService
+from ...tracing import flight as _flight
+from ...tracing.serve import get_serve_tracer, init_serve_tracer
 from ...utils.logging import log
 from ..config import LLMConfig
 from .generator import DecodeEngine
@@ -86,6 +88,15 @@ class LLMReplicaService(BasicService):
                 resp = self.engine.poll()
                 resp["ok"] = True
                 return resp
+            if kind == "clock_align":
+                # The router measured this replica's clock offset over
+                # the clock_probe exchange and pushes it back; the span
+                # recorder re-announces it in its meta line so the
+                # collector aligns replica spans to the router clock.
+                tracer = get_serve_tracer()
+                if tracer is not None:
+                    tracer.set_clock_offset(int(request["offset_ns"]))
+                return {"ok": True}
             return {"ok": False, "error": f"unknown kind {kind!r}"}
         except Exception:  # noqa: BLE001 - forwarded to the router verbatim
             return {"ok": False, "error": traceback.format_exc(limit=20)}
@@ -101,8 +112,13 @@ class LLMReplicaService(BasicService):
         from ..model import lm_prefill
 
         tokens = [int(t) for t in request["tokens"]]
+        tracer = get_serve_tracer()
+        t0 = tracer.now_ns() if tracer else 0
         k, v, nxt = lm_prefill(self.params, tokens)
         self._prefills += 1
+        if tracer and request.get("trace"):
+            tracer.span(request["trace"], "prefill", t0, tracer.now_ns(),
+                        side="replica", n_tokens=len(tokens))
         return {"ok": True, "k": k, "v": v, "next_token": nxt,
                 "n_tokens": len(tokens)}
 
@@ -159,6 +175,7 @@ def main() -> int:
     state = load_for_serving(ckpt) if ckpt else None
     params = builder(state)
 
+    tracer = init_serve_tracer(f"llm-{role}-{replica_id}")
     engine = None
     if role in ("decode", "both"):
         cache = PagedKVCache(llm_cfg.num_blocks, llm_cfg.block_size,
@@ -166,7 +183,22 @@ def main() -> int:
                              watermark=llm_cfg.watermark)
         engine = DecodeEngine(IterationScheduler(
             cache, params, max_active=llm_cfg.max_active,
-            admission_window=llm_cfg.admission_window)).start()
+            admission_window=llm_cfg.admission_window,
+            tracer=tracer)).start()
+        # Stall watchdog on the decode loop (ISSUE 15 satellite): a
+        # replica whose iterations stop progressing for
+        # HOROVOD_STALL_CHECK_TIME names the stuck sequence ids and trips
+        # a flight-recorder dump — long before the manager's blunt
+        # HOROVOD_SERVE_REPLICA_TIMEOUT reap would notice.
+        if not os.environ.get("HOROVOD_STALL_CHECK_DISABLE"):
+            from ...common.config import _env_stall_check_time
+            from ...metrics import StallWatchdog
+
+            StallWatchdog(
+                check_time_s=_env_stall_check_time(), rank=replica_id,
+                on_warn=lambda stalled: _flight.get_flight().dump(
+                    f"stall-{len(stalled)}seqs")
+            ).add_source(engine.stall_infos)
     elif role != "prefill":
         raise ValueError(f"unknown HVD_SERVE_LLM_ROLE {role!r}")
 

@@ -47,6 +47,7 @@ from typing import Optional
 
 import numpy as np
 
+from ...tracing.serve import serve_trace_id
 from ..model import lm_context_step, lm_prefill
 from .kv_cache import PagedKVCache, blocks_for
 
@@ -97,7 +98,8 @@ class Sequence:
 
 class IterationScheduler:
     def __init__(self, cache: PagedKVCache, params: dict,
-                 max_active: int = 8, admission_window: int = 64) -> None:
+                 max_active: int = 8, admission_window: int = 64,
+                 tracer=None) -> None:
         if max_active < 1:
             raise ValueError(f"max_active must be >= 1, got {max_active}")
         self.cache = cache
@@ -108,6 +110,13 @@ class IterationScheduler:
         self.running: list[Sequence] = []
         self.finished: list[Sequence] = []
         self._admit_seq = 0
+        # Serving-plane tracer (tracing/serve.py; None in unit tests and
+        # router-side oracles): ONE span per decode iteration carrying the
+        # member sequence ids — the Orca unit of serving work — plus
+        # admit/preempt/retire lifecycle points per sequence.
+        self.tracer = tracer
+        cache.alloc.tracer = tracer
+        self.last_progress_t = time.monotonic()
         # plain-int telemetry, mirrored by the router (see module doc)
         self.tokens_prefill_total = 0
         self.tokens_decode_total = 0
@@ -146,10 +155,23 @@ class IterationScheduler:
         """One iteration: admit -> decode one token per running sequence
         -> retire. Returns the number of tokens decoded (0 = idle)."""
         self._admit_phase()
+        t0 = time.monotonic_ns() if self.tracer else 0
+        members = [s.seq_id for s in self.running] if self.tracer else ()
         decoded = self._decode_phase()
         if decoded:
             self.iterations_total += 1
             self.occupancy_sum += decoded
+            self.last_progress_t = time.monotonic()
+            if self.tracer:
+                # ONE span per iteration, member sequence ids in args —
+                # the iteration is the unit of serving work, so a request
+                # under load is findable in every iteration it rode
+                # without a span per sequence per token.
+                self.tracer.span(
+                    f"it:{self.tracer.proc}:{self.iterations_total}",
+                    "decode", t0, time.monotonic_ns(), seqs=list(members),
+                    n=decoded, waiting=len(self.waiting),
+                    blocks_free=self.cache.alloc.free_count)
         for seq in self.waiting:
             seq.waited += 1
         return decoded
@@ -175,6 +197,12 @@ class IterationScheduler:
                 break
             self.waiting.popleft()
             seq.state = RUNNING
+            if self.tracer:
+                self.tracer.point(
+                    serve_trace_id("gen", seq.seq_id), "admit",
+                    side="replica", waited_iters=seq.waited,
+                    blocks=self.cache.alloc.owned(seq.seq_id),
+                    preemptions=seq.preemptions)
             seq.waited = 0
             seq.admit_order = self._admit_seq
             self._admit_seq += 1
@@ -249,13 +277,17 @@ class IterationScheduler:
         return max(self.running, key=lambda s: s.admit_order)
 
     def _preempt(self, seq: Sequence) -> None:
-        self.cache.alloc.preempt(seq.seq_id)
+        freed = self.cache.alloc.preempt(seq.seq_id)
         self.running.remove(seq)
         seq.state = WAITING
         seq.kv_len = 0
         seq.waited = 0
         seq.preemptions += 1
         self.waiting.appendleft(seq)
+        if self.tracer:
+            self.tracer.point(serve_trace_id("gen", seq.seq_id), "preempt",
+                              blocks_freed=freed, tokens=len(seq.out),
+                              preemptions=seq.preemptions)
 
     def _retire(self, seq: Sequence) -> None:
         self.blocks_freed_total += self.cache.alloc.free(seq.seq_id)
@@ -263,8 +295,33 @@ class IterationScheduler:
         seq.state = FINISHED
         self.finished.append(seq)
         self.finished_total += 1
+        if self.tracer:
+            self.tracer.point(serve_trace_id("gen", seq.seq_id), "retire",
+                              side="replica", tokens=len(seq.out),
+                              preemptions=seq.preemptions)
 
     # -- telemetry ------------------------------------------------------------
+
+    def sequences(self) -> list:
+        """Live per-sequence state for GET /debug/sequences: everything
+        the scheduler already tracks, one dict per running-then-waiting
+        sequence (slot = decode-batch position, -1 while waiting)."""
+        now = time.monotonic()
+        out = []
+        for slot, seq in enumerate(self.running):
+            out.append({"rid": seq.seq_id, "state": seq.state, "slot": slot,
+                        "blocks": self.cache.alloc.owned(seq.seq_id),
+                        "tokens_out": len(seq.out), "kv_len": seq.kv_len,
+                        "waited_iters": seq.waited,
+                        "preemptions": seq.preemptions,
+                        "age_s": round(now - seq.submit_t, 3)})
+        for seq in self.waiting:
+            out.append({"rid": seq.seq_id, "state": seq.state, "slot": -1,
+                        "blocks": 0, "tokens_out": len(seq.out),
+                        "kv_len": 0, "waited_iters": seq.waited,
+                        "preemptions": seq.preemptions,
+                        "age_s": round(now - seq.submit_t, 3)})
+        return out
 
     def stats(self) -> dict:
         alloc = self.cache.alloc

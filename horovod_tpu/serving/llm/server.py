@@ -32,6 +32,8 @@ import time
 from typing import Optional, Tuple
 
 from ...metrics import registry as _registry
+from ...metrics.anomaly import AnomalyDetector
+from ...tracing.serve import init_serve_tracer
 from ...utils.logging import log
 from ..admission import KVAdmission
 from ..config import LLMConfig, ServeConfig
@@ -78,6 +80,9 @@ class LLMServer:
         # -- per-decode-replica stat mirrors (rep key -> last snapshot) ----
         self._stats_lock = threading.Lock()
         self._rep_stats: dict[int, dict] = {}
+        self._rep_sequences: dict[int, list] = {}
+        self.tracer = None          # set by start() (tracing/serve.py)
+        self.anomaly = None         # set by start() (metrics/anomaly.py)
         # -- llm telemetry (docs/metrics_schema.json serving_llm_*) --------
         self._active_g = self.reg.gauge(
             "horovod_serve_llm_active_sequences",
@@ -132,6 +137,9 @@ class LLMServer:
 
     def start(self) -> "LLMServer":
         self._started_t = time.time()
+        self.tracer = init_serve_tracer("serve-router")
+        self.anomaly = AnomalyDetector.start_from_env(
+            reg=self.reg, slo_s=self.llm.ttft_slo_ms / 1000.0)
         for pool in self.pools.values():
             pool.start()
         self._frontend = ServeFrontend(self)
@@ -161,6 +169,8 @@ class LLMServer:
         if self._frontend is not None:
             self._frontend.stop()
             self._frontend = None
+        if self.anomaly is not None:
+            self.anomaly.stop()
         for q in (self.prefill_q, self.handoff_q):
             for item in q.close():
                 req = item[0] if isinstance(item, tuple) else item
@@ -168,6 +178,8 @@ class LLMServer:
                     self.count_code(503)
         for pool in self.pools.values():
             pool.stop()
+        if self.tracer is not None:
+            self.tracer.flush()
 
     # -- request path --------------------------------------------------------
 
@@ -195,11 +207,16 @@ class LLMServer:
             req.fail(429, f"shed: projected KV-block wait "
                           f"{wait * 1e3:.0f}ms exceeds the "
                           f"{self.llm.ttft_slo_ms:.0f}ms TTFT SLO")
-            return req, wait
-        if not self.prefill_q.put(req):
+        elif not self.prefill_q.put(req):
             if req.fail(429, "queue full"):
                 self.count_code(429)
-            return req, wait
+        if self.tracer is not None:
+            self.tracer.span(
+                req.tid, "admit", int(req.enqueue_t * 1e9),
+                self.tracer.now_ns(), rid=req.rid,
+                decision="ok" if req.code == 0 else "shed",
+                projected_wait_ms=round(min(wait, 1e9) * 1e3, 3),
+                blocks_needed=req.blocks_needed(self.llm.block_size))
         return req, wait
 
     def _validate(self, req: GenRequest) -> str:
@@ -298,6 +315,7 @@ class LLMServer:
 
     def on_prefilled(self, req: GenRequest, payload: dict) -> None:
         req.mark_first_token()
+        req.prefilled_t = time.monotonic()
         self._tok_prefill_c.inc(len(req.prompt))
         if not self.handoff_q.put((req, payload)):
             if req.fail(503, "handoff queue full or shutting down"):
@@ -330,6 +348,12 @@ class LLMServer:
             tpot = req.tpot_s()
             if tpot is not None:
                 self._tpot_h.observe(tpot)
+            if self.tracer is not None:
+                self.tracer.point(
+                    req.tid, "retire", rid=req.rid, ok=True,
+                    tokens=len(req.tokens),
+                    ttft_ms=round((req.ttft_s or 0.0) * 1e3, 3),
+                    preemptions=rec.get("preemptions", 0))
 
     def retry_or_fail(self, reqs) -> None:
         """Replica died holding these: requeue at the prefill-queue FRONT
@@ -371,6 +395,8 @@ class LLMServer:
         freed = stats.get("blocks_freed_total", 0) \
             - last.get("blocks_freed_total", 0)
         self.admission.observe_release(max(freed, 0), dt_s)
+        free, queued = self._block_availability(None)
+        self.admission.refresh_projection(free, queued)
         self._active_g.set(agg["active"])
         self._waiting_g.set(agg["waiting"])
         self._blocks_used_g.set(agg["blocks_used"])
@@ -379,12 +405,28 @@ class LLMServer:
             self._occupancy_g.set(
                 agg["occupancy_sum"] / agg["iterations_total"])
 
+    def mirror_sequences(self, rep_key: int, sequences: list) -> None:
+        """Latest per-sequence scheduler state from one decode replica —
+        the GET /debug/sequences view (docs/inference.md)."""
+        with self._stats_lock:
+            self._rep_sequences[rep_key] = sequences
+
     def count_code(self, code: int) -> None:
         self.reg.counter("horovod_serve_requests_total",
                          help="terminal request outcomes by HTTP-style code",
                          code=str(code)).inc()
 
     # -- introspection -------------------------------------------------------
+
+    def debug_sequences(self) -> dict:
+        """Live per-sequence state across the decode pool (poll-mirror
+        freshness, one entry per sequence the schedulers hold)."""
+        with self._stats_lock:
+            reps = {str(k): list(v)
+                    for k, v in sorted(self._rep_sequences.items())}
+        return {"time_unix_s": time.time(), "replicas": reps,
+                "prefill_queue_depth": self.prefill_q.depth(),
+                "handoff_queue_depth": self.handoff_q.depth()}
 
     def stats(self) -> dict:
         snap = self.reg.snapshot()

@@ -41,6 +41,9 @@ from typing import Optional
 from ..elastic.discovery import Blacklist
 from ..metrics import registry as _registry
 from ..runner.network import BasicClient, make_secret
+from ..tracing import flight as _flight
+from ..tracing.clock import estimate_offset_ns
+from ..tracing.serve import get_serve_tracer
 from ..utils.logging import log
 from .batcher import bucket_for, bucket_sizes, pad_batch
 
@@ -330,6 +333,7 @@ class ReplicaManager:
         rep.port, rep.pid = int(info["port"]), int(info["pid"])
         rep.client = client
         rep.state = "serving"
+        self._align_replica_clock(rep)
         self._startup_failures = 0
         rep.worker = threading.Thread(
             target=self._worker, args=(rep,),
@@ -337,6 +341,25 @@ class ReplicaManager:
         rep.worker.start()
         log("info", f"serving replica {rep.rid} live on port {rep.port} "
                     f"after {now - rep.spawned_t:.1f}s")
+
+    def _align_replica_clock(self, rep: _Replica) -> None:
+        """NTP exchange over the replica's authenticated channel (built-in
+        ``clock_probe`` responder, runner/network.py), pushed back as a
+        ``clock_align`` RPC so the replica's spans merge onto the router
+        clock (tracing/serve.py). Trace-time only; never fatal."""
+        tracer = get_serve_tracer()
+        if tracer is None or not tracer.enabled:
+            return
+        try:
+            offset, err = estimate_offset_ns(
+                lambda: rep.client.request({"kind": "clock_probe"})["t"],
+                rounds=4)
+            # offset maps router->replica; the replica needs replica->router
+            rep.client.request({"kind": "clock_align",
+                                "offset_ns": -offset})
+        except Exception as e:  # noqa: BLE001 - alignment is best-effort
+            log("warning", f"serving replica {rep.rid} clock align "
+                           f"failed: {e}")
 
     # -- subclass hooks ------------------------------------------------------
 
@@ -353,6 +376,8 @@ class ReplicaManager:
 
     def _worker(self, rep: _Replica) -> None:
         buckets = bucket_sizes(self.cfg.max_batch)
+        tracer = get_serve_tracer()
+        batches = 0
         while not self._closed.is_set() and rep.state == "serving":
             batch = self.batcher.take_batch(_TAKE_TIMEOUT_S)
             if not batch:
@@ -360,14 +385,30 @@ class ReplicaManager:
             n = len(batch)
             arr = pad_batch([r.x for r in batch], bucket_for(n, buckets))
             t0 = time.monotonic()
+            batches += 1
+            if tracer:
+                # queue wait per request, then ONE dispatch span per
+                # device batch with the member request ids in args — the
+                # batch is the stateless plane's unit of work, like the
+                # decode iteration on the token-level plane.
+                now_ns = tracer.now_ns()
+                for r in batch:
+                    tracer.span(r.tid, "queue", int(r.enqueue_t * 1e9),
+                                now_ns, replica=rep.rid)
             try:
                 resp = rep.client.request(
-                    {"kind": "infer", "inputs": arr, "n_valid": n})
+                    {"kind": "infer", "inputs": arr, "n_valid": n,
+                     "trace": f"it:serve-{rep.rid}:{batches}"})
             except Exception as e:  # noqa: BLE001 - any wire fault = death
                 self._requeue_failed(batch)
                 self._mark_dead(rep, f"infer dispatch failed: {e}")
                 break
             service_s = time.monotonic() - t0
+            if tracer:
+                tracer.span(f"it:serve-{rep.rid}:{batches}", "infer",
+                            int(t0 * 1e9), tracer.now_ns(),
+                            rids=[r.rid for r in batch], n=n,
+                            replica=rep.rid, ok=bool(resp.get("ok")))
             if not resp.get("ok"):
                 # The model itself raised: deterministic per-batch failure,
                 # retrying elsewhere would fail the same way. Replica lives.
@@ -417,6 +458,13 @@ class ReplicaManager:
         self.blacklist.record_failure(f"replica:{rep.rid}")
         log("warning", f"serving replica {rep.rid} dead ({was}): {reason}; "
                        f"in-flight requests retry on survivors")
+        # Flight-recorder escalation (ISSUE 15): the router's ring gets a
+        # structured death event and dumps — the replica's own ring file
+        # survives in HOROVOD_FLIGHT_DIR for the bundle to collect.
+        fl = _flight.get_flight()
+        fl.event("replica_death", replica=rep.rid, pid=rep.pid,
+                 state_was=was, reason=str(reason)[:200])
+        fl.dump(f"replica-death-{rep.rid}")
 
     def _start_drain(self, rep: Optional[_Replica]) -> None:
         if rep is None:

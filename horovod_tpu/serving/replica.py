@@ -42,6 +42,7 @@ import numpy as np
 
 from ..elastic import fault
 from ..runner.network import BasicService
+from ..tracing.serve import get_serve_tracer, init_serve_tracer
 from ..utils.logging import log
 
 
@@ -67,6 +68,11 @@ class ReplicaService(BasicService):
             return {"ok": True, "replica": self.replica_id,
                     "requests": self._requests,
                     "recompiles": self._recompiles}
+        if kind == "clock_align":
+            tracer = get_serve_tracer()
+            if tracer is not None:
+                tracer.set_clock_offset(int(request["offset_ns"]))
+            return {"ok": True}
         if kind != "infer":
             return {"ok": False, "error": f"unknown kind {kind!r}"}
         self._requests += 1
@@ -79,7 +85,12 @@ class ReplicaService(BasicService):
             if x.shape not in self._shapes:
                 self._shapes.add(x.shape)
                 self._recompiles += 1
+            tracer = get_serve_tracer()
+            t0 = tracer.now_ns() if tracer else 0
             y = np.asarray(self._forward(x))
+            if tracer and request.get("trace"):
+                tracer.span(request["trace"], "infer", t0, tracer.now_ns(),
+                            side="replica", n_valid=request.get("n_valid"))
             return {"ok": True, "outputs": y,
                     "recompiles": self._recompiles,
                     "requests": self._requests}
@@ -110,6 +121,7 @@ def main() -> int:
     state = load_for_serving(ckpt) if ckpt else None
     forward = make_decode_fn(builder(state), decode_steps)
 
+    init_serve_tracer(f"serve-replica-{replica_id}")
     svc = ReplicaService(secret, forward, replica_id)
     ppid = os.getppid()
     threading.Thread(target=_watch_parent, args=(ppid,), daemon=True).start()

@@ -29,6 +29,8 @@ from typing import Optional, Tuple
 import numpy as np
 
 from ..metrics import registry as _registry
+from ..metrics.anomaly import AnomalyDetector
+from ..tracing.serve import init_serve_tracer
 from ..utils.logging import log
 from .admission import AdmissionController
 from .batcher import ContinuousBatcher, Request
@@ -55,11 +57,16 @@ class InferenceServer:
         self.port: Optional[int] = None
         self._example_shape: Optional[tuple] = None
         self._started_t: Optional[float] = None
+        self.tracer = None          # set by start() (tracing/serve.py)
+        self.anomaly = None         # set by start() (metrics/anomaly.py)
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "InferenceServer":
         self._started_t = time.time()
+        self.tracer = init_serve_tracer("serve-router")
+        self.anomaly = AnomalyDetector.start_from_env(
+            reg=self.reg, slo_s=self.cfg.slo_ms / 1000.0)
         self.manager.start()
         self._frontend = ServeFrontend(self)
         self.port = self._frontend.port
@@ -90,8 +97,12 @@ class InferenceServer:
         if self._frontend is not None:
             self._frontend.stop()
             self._frontend = None
+        if self.anomaly is not None:
+            self.anomaly.stop()
         self.batcher.close()
         self.manager.stop()
+        if self.tracer is not None:
+            self.tracer.flush()
 
     # -- request path --------------------------------------------------------
 
@@ -118,11 +129,14 @@ class InferenceServer:
         if not admitted:
             req.fail(429, f"shed: projected queue wait {wait * 1e3:.0f}ms "
                           f"exceeds the {self.cfg.slo_ms:.0f}ms SLO")
-            return req, wait
-        if not self.batcher.submit(req):
+        elif not self.batcher.submit(req):
             if req.fail(429, "queue full"):
                 self.count_code(429)
-            return req, wait
+        if self.tracer is not None:
+            self.tracer.span(req.tid, "admit", int(req.enqueue_t * 1e9),
+                             self.tracer.now_ns(), rid=req.rid,
+                             decision="ok" if req.code == 0 else "shed",
+                             projected_wait_ms=round(wait * 1e3, 3))
         return req, wait
 
     def infer(self, x: np.ndarray, deadline_ms: Optional[float] = None,

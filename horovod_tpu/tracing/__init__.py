@@ -43,7 +43,25 @@ from .critical_path import (  # noqa: F401
     export_gauges,
     format_summary,
 )
-from .recorder import TraceRecorder, span_path, trace_id  # noqa: F401
+from .flight import (  # noqa: F401
+    FlightRecorder,
+    config_fingerprint,
+    get_flight,
+    init_flight,
+    read_ring,
+)
+from .recorder import (  # noqa: F401
+    TraceRecorder,
+    proc_span_path,
+    span_path,
+    trace_id,
+)
+from .serve import (  # noqa: F401
+    ServeTracer,
+    get_serve_tracer,
+    init_serve_tracer,
+    serve_trace_id,
+)
 
 _lock = threading.Lock()
 _recorder: Optional[TraceRecorder] = None

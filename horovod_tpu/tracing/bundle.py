@@ -1,0 +1,264 @@
+"""One-command debug bundles: ``python -m horovod_tpu.tracing.bundle``.
+
+Sweeps everything the observability layer left behind into ONE directory
+a human (or a bug report) can carry:
+
+- every flight-recorder dump (``flight-*.json``) AND every ring file
+  (``flight-*.ring``) in ``--flight-dir`` — rings are decoded here, so a
+  SIGKILL'd replica's final seconds land in the bundle even though the
+  process never got to write a dump;
+- the merged clock-aligned Perfetto trace of ``--trace-dir`` (training
+  ranks and serving processes in one strict ``trace.json``) plus the
+  critical-path attribution report over the training spans;
+- any ``--stats`` sources: a running router's ``http://.../stats`` (and
+  ``/debug/sequences``) or already-saved snapshot files;
+- ``MANIFEST.md`` — the human-readable index: which processes dumped and
+  why, which replicas died, which anomalies fired, what is in each file.
+
+Exit 0 with the bundle path on stdout; 1 when there was nothing at all
+to collect. docs/debugging.md walks through reading the result.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import time
+from typing import Optional
+
+from . import flight as _flight
+
+_EVENT_KINDS = ("replica_death", "anomaly", "stall", "plane_demote")
+
+
+def _collect_flight(flight_dir: str, out: str) -> tuple[list, list]:
+    """Copy dumps + decode rings into ``out``/flight; returns
+    (inventory rows, notable events)."""
+    rows: list[dict] = []
+    events: list[dict] = []
+    if not flight_dir or not os.path.isdir(flight_dir):
+        return rows, events
+    dst = os.path.join(out, "flight")
+    os.makedirs(dst, exist_ok=True)
+    for path in sorted(glob.glob(os.path.join(flight_dir,
+                                              "flight-*.json"))):
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        shutil.copy(path, os.path.join(dst, name))
+        rows.append({"file": f"flight/{name}", "kind": "dump",
+                     "proc": doc.get("proc", "?"),
+                     "reason": doc.get("reason", "?"),
+                     "records": len(doc.get("records", []))})
+        for rec in doc.get("records", []):
+            if rec.get("flight_event") in _EVENT_KINDS:
+                events.append(dict(rec, _source=name))
+    for path in sorted(glob.glob(os.path.join(flight_dir,
+                                              "flight-*.ring"))):
+        try:
+            ring = _flight.read_ring(path)
+        except (OSError, ValueError):
+            continue
+        name = os.path.basename(path) + ".json"
+        with open(os.path.join(dst, name), "w") as f:
+            json.dump(ring, f)
+        rows.append({"file": f"flight/{name}", "kind": "ring",
+                     "proc": ring.get("proc", "?"), "reason": "-",
+                     "records": len(ring.get("records", []))})
+        for rec in ring.get("records", []):
+            if rec.get("flight_event") in _EVENT_KINDS:
+                events.append(dict(rec, _source=name))
+    return rows, events
+
+
+def _collect_trace(trace_dir: str, out: str) -> tuple[Optional[dict],
+                                                      Optional[str]]:
+    """Merge span files into ``out``/trace.json; returns (critical-path
+    report over the training spans, trace path)."""
+    if not trace_dir or not glob.glob(os.path.join(trace_dir,
+                                                   "spans-*.jsonl")):
+        return None, None
+    from .collector import build_trace, load_spans
+    from .critical_path import analyze, format_summary
+
+    spans, metas = load_spans(trace_dir)
+    if not spans:
+        return None, None
+    trace = build_trace(spans, metas)
+    trace_path = os.path.join(out, "trace.json")
+    with open(trace_path, "w") as f:
+        json.dump(trace, f)
+    train_spans = [s for s in spans if "proc" not in s]
+    report = analyze(train_spans) if train_spans else None
+    if report:
+        with open(os.path.join(out, "critical_path.json"), "w") as f:
+            json.dump(report, f, indent=1)
+        with open(os.path.join(out, "critical_path.txt"), "w") as f:
+            f.write(format_summary(report) + "\n")
+    return report, trace_path
+
+
+def _collect_stats(sources: list, out: str) -> list:
+    rows = []
+    for i, src in enumerate(sources):
+        name = f"stats-{i}.json"
+        try:
+            if src.startswith("http://") or src.startswith("https://"):
+                import urllib.request
+
+                with urllib.request.urlopen(src, timeout=10) as r:
+                    data = r.read()
+                with open(os.path.join(out, name), "wb") as f:
+                    f.write(data)
+            else:
+                shutil.copy(src, os.path.join(out, name))
+        except Exception as e:  # noqa: BLE001 - a dead router is expected
+            rows.append({"file": "-", "source": src,
+                         "error": str(e)[:120]})
+            continue
+        rows.append({"file": name, "source": src})
+    return rows
+
+
+def _manifest(out: str, flight_rows: list, events: list,
+              report: Optional[dict], trace_path: Optional[str],
+              stats_rows: list) -> str:
+    lines = ["# horovod_tpu debug bundle", "",
+             f"Collected {time.strftime('%Y-%m-%d %H:%M:%S')} by "
+             f"`python -m horovod_tpu.tracing.bundle`. How to read this: "
+             f"docs/debugging.md.", ""]
+    deaths = [e for e in events if e.get("flight_event") == "replica_death"]
+    anomalies = [e for e in events if e.get("flight_event") == "anomaly"]
+    other = [e for e in events
+             if e.get("flight_event") in ("stall", "plane_demote")]
+    lines.append("## Verdict")
+    lines.append("")
+    if deaths:
+        for e in deaths:
+            lines.append(f"- **replica {e.get('replica', '?')} died** "
+                         f"(pid {e.get('pid', '?')}, was "
+                         f"{e.get('state_was', '?')}): "
+                         f"{e.get('reason', '?')} — final seconds in its "
+                         f"ring decode under `flight/`")
+    if anomalies:
+        for e in anomalies:
+            detail = {k: v for k, v in e.items()
+                      if k not in ("flight_event", "t", "_source")}
+            lines.append(f"- **anomaly `{e.get('kind', '?')}` fired**: "
+                         f"{json.dumps(detail)}")
+    for e in other:
+        lines.append(f"- event `{e.get('flight_event')}`: "
+                     f"{json.dumps({k: v for k, v in e.items() if k not in ('flight_event', 't', '_source')})}")
+    if not (deaths or anomalies or other):
+        lines.append("- no death/anomaly/stall events in the captured "
+                     "window")
+    lines.append("")
+    if trace_path:
+        lines.append("## Merged trace")
+        lines.append("")
+        lines.append("- `trace.json` — load in https://ui.perfetto.dev; "
+                     "search a request's trace ID (`req:gen:<rid>`) to "
+                     "light up its admit/queue/prefill/handoff/decode/"
+                     "retire chain across router and replicas")
+        if report and report.get("straggler"):
+            s = report["straggler"]
+            lines.append(f"- critical path (training spans): straggler "
+                         f"rank {s['rank']} in {s['phase']} "
+                         f"({s['seconds'] * 1e3:.1f} ms) — "
+                         f"`critical_path.txt`")
+        lines.append("")
+    lines.append("## Flight recorders")
+    lines.append("")
+    if flight_rows:
+        lines.append("| file | kind | proc | reason | records |")
+        lines.append("|---|---|---|---|---|")
+        for r in flight_rows:
+            lines.append(f"| {r['file']} | {r['kind']} | {r['proc']} | "
+                         f"{r['reason']} | {r['records']} |")
+    else:
+        lines.append("(none found)")
+    lines.append("")
+    if stats_rows:
+        lines.append("## Stats snapshots")
+        lines.append("")
+        for r in stats_rows:
+            if r.get("error"):
+                lines.append(f"- {r['source']}: UNREACHABLE "
+                             f"({r['error']})")
+            else:
+                lines.append(f"- `{r['file']}` from {r['source']}")
+        lines.append("")
+    text = "\n".join(lines) + "\n"
+    with open(os.path.join(out, "MANIFEST.md"), "w") as f:
+        f.write(text)
+    return text
+
+
+def make_bundle(out: str, trace_dir: str = "", flight_dir: str = "",
+                stats: Optional[list] = None) -> dict:
+    """Assemble a bundle directory; returns a summary dict (the CLI's
+    machine-readable line)."""
+    os.makedirs(out, exist_ok=True)
+    flight_rows, events = _collect_flight(flight_dir, out)
+    # A ring and its dumps overlap; report each underlying event once.
+    seen: set = set()
+    unique = []
+    for e in events:
+        key = json.dumps({k: v for k, v in sorted(e.items())
+                          if k != "_source"}, default=str)
+        if key not in seen:
+            seen.add(key)
+            unique.append(e)
+    events = unique
+    report, trace_path = _collect_trace(trace_dir, out)
+    stats_rows = _collect_stats(list(stats or []), out)
+    _manifest(out, flight_rows, events, report, trace_path, stats_rows)
+    return {"bundle": out, "flight_files": len(flight_rows),
+            "events": len(events), "trace": bool(trace_path),
+            "stats": len([r for r in stats_rows if not r.get("error")]),
+            "dead_replicas": sorted({e.get("replica") for e in events
+                                     if e.get("flight_event") ==
+                                     "replica_death"
+                                     and e.get("replica") is not None})}
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Collect flight dumps, rings, merged trace and stats "
+                    "into one debug-bundle directory")
+    ap.add_argument("-o", "--out", default=None,
+                    help="bundle directory (default ./debug-bundle-<ts>)")
+    ap.add_argument("--trace-dir",
+                    default=os.environ.get("HOROVOD_TRACE_DIR", ""),
+                    help="span directory (default $HOROVOD_TRACE_DIR)")
+    ap.add_argument("--flight-dir",
+                    default=os.environ.get("HOROVOD_FLIGHT_DIR", ""),
+                    help="flight-ring/dump directory (default "
+                         "$HOROVOD_FLIGHT_DIR)")
+    ap.add_argument("--stats", action="append", default=[],
+                    help="a /stats URL or saved snapshot file "
+                         "(repeatable)")
+    args = ap.parse_args(argv)
+    out = args.out or f"debug-bundle-{time.strftime('%Y%m%d-%H%M%S')}"
+    summary = make_bundle(out, trace_dir=args.trace_dir,
+                          flight_dir=args.flight_dir, stats=args.stats)
+    if not summary["flight_files"] and not summary["trace"] \
+            and not summary["stats"]:
+        print(f"bundle: nothing to collect (trace_dir="
+              f"{args.trace_dir or '-'}, flight_dir="
+              f"{args.flight_dir or '-'})")
+        return 1
+    print(json.dumps(summary))
+    print(f"bundle ready: {out}/MANIFEST.md")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - CLI entry
+    raise SystemExit(main())

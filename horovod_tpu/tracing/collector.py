@@ -25,24 +25,35 @@ import json
 import os
 from typing import Optional
 
-# Stable lane ids per phase so every rank's track layout matches.
+# Stable lane ids per phase so every rank's track layout matches. Lanes
+# 0-5 are the training planes' collective lifecycle; 6-12 are the serving
+# plane's request lifecycle (tracing/serve.py), so a mixed training +
+# serving capture lays out identically on every process row.
 _PHASE_LANES = {"enqueue": 0, "negotiate": 1, "cache_tick": 1, "wire": 2,
-                "wire_send": 2, "wire_recv": 3, "reduce": 4, "done": 5}
+                "wire_send": 2, "wire_recv": 3, "reduce": 4, "done": 5,
+                "admit": 6, "queue": 7, "prefill": 8, "handoff": 9,
+                "decode": 10, "infer": 10, "retire": 11, "preempt": 12,
+                "kv_pressure": 12, "stall": 12, "anomaly": 12, "flight": 12}
 _LANE_NAMES = {0: "enqueue", 1: "negotiate", 2: "wire send", 3: "wire recv",
-               4: "reduce", 5: "done"}
+               4: "reduce", 5: "done", 6: "admit", 7: "queue", 8: "prefill",
+               9: "handoff", 10: "decode", 11: "retire", 12: "events"}
+_TRAIN_LANES = (0, 1, 2, 3, 4, 5)
 
 
-def load_spans(trace_dir: str) -> tuple[list[dict], dict[int, dict]]:
-    """Read every rank's span file, apply its meta clock offset, and return
-    (spans, meta_by_rank). Span ``t0``/``t1`` are ALIGNED ns after this.
-    Unparseable lines are skipped (a crashed rank may leave a torn tail);
-    a missing meta line degrades to offset 0 rather than dropping the rank.
+def load_spans(trace_dir: str) -> tuple[list[dict], dict]:
+    """Read every span file — training ranks (``spans-rank<k>.jsonl``) AND
+    serving processes (``spans-<proc>.jsonl``, tracing/serve.py) — apply
+    each file's meta clock offset, and return (spans, metas). Rank files
+    key their meta by int rank; serving files by their proc string. Span
+    ``t0``/``t1`` are ALIGNED ns after this. Unparseable lines are skipped
+    (a crashed rank or a SIGKILL'd replica may leave a torn tail); a
+    missing meta line degrades to offset 0 rather than dropping the file.
     """
     spans: list[dict] = []
-    metas: dict[int, dict] = {}
-    for path in sorted(glob.glob(os.path.join(trace_dir, "spans-rank*.jsonl"))):
+    metas: dict = {}
+    for path in sorted(glob.glob(os.path.join(trace_dir, "spans-*.jsonl"))):
         offset = 0
-        rank = None
+        proc = None
         pending: list[dict] = []
         with open(path) as f:
             for line in f:
@@ -57,27 +68,50 @@ def load_spans(trace_dir: str) -> tuple[list[dict], dict[int, dict]]:
                     # last meta wins (the offset estimate lands after the
                     # recorder opens, re-announced as a later meta line)
                     offset = int(rec.get("clock_offset_ns", 0))
-                    rank = rec.get("rank", rank)
-                    metas[int(rec["rank"])] = rec
+                    proc = rec.get("proc") or proc
+                    metas[proc if proc else int(rec["rank"])] = rec
                     continue
                 pending.append(rec)
         for rec in pending:
             rec["t0"] = int(rec.get("t0", 0)) + offset
             rec["t1"] = int(rec.get("t1", rec.get("t0", 0))) + offset
+            if proc and "proc" not in rec:
+                rec["proc"] = proc
             spans.append(rec)
     return spans, metas
 
 
 def build_trace(spans: list[dict], metas: Optional[dict] = None) -> dict:
-    """Chrome trace-event JSON object from ALIGNED spans."""
+    """Chrome trace-event JSON object from ALIGNED spans. Training ranks
+    keep their rank number as the Perfetto pid; serving processes (spans
+    carrying a ``proc`` label) get deterministic pids above the highest
+    rank, one process row per proc — "process per replica, lane per
+    phase", mirroring the per-rank layout of the training planes."""
     events: list[dict] = []
-    ranks = sorted({int(s.get("rank", 0)) for s in spans})
+    ranks = sorted({int(s.get("rank", 0)) for s in spans
+                    if "proc" not in s})
+    procs = sorted({str(s["proc"]) for s in spans if "proc" in s})
+    proc_base = (max(ranks) + 1) if ranks else 0
+    proc_pid = {p: proc_base + i for i, p in enumerate(procs)}
+    lanes_used: dict[int, set] = {}
+    for s in spans:
+        pid = proc_pid[str(s["proc"])] if "proc" in s \
+            else int(s.get("rank", 0))
+        lanes_used.setdefault(pid, set()).add(
+            _PHASE_LANES.get(str(s.get("phase", "?")), 1))
     for r in ranks:
         events.append({"name": "process_name", "ph": "M", "pid": r, "tid": 0,
                        "args": {"name": f"rank {r}"}})
-        for lane, lname in sorted(_LANE_NAMES.items()):
+        for lane in sorted(set(_TRAIN_LANES) | lanes_used.get(r, set())):
             events.append({"name": "thread_name", "ph": "M", "pid": r,
-                           "tid": lane, "args": {"name": lname}})
+                           "tid": lane, "args": {"name": _LANE_NAMES[lane]}})
+    for p in procs:
+        pid = proc_pid[p]
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": p}})
+        for lane in sorted(lanes_used.get(pid, set())):
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": lane, "args": {"name": _LANE_NAMES[lane]}})
     t_base = min((s["t0"] for s in spans), default=0)
     for s in spans:
         phase = str(s.get("phase", "?"))
@@ -86,8 +120,10 @@ def build_trace(spans: list[dict], metas: Optional[dict] = None) -> dict:
         dur_us = max(0.0, (s["t1"] - s["t0"]) / 1000.0)
         args = {k: v for k, v in s.items()
                 if k not in ("t0", "t1", "rank", "phase")}
+        pid = proc_pid[str(s["proc"])] if "proc" in s \
+            else int(s.get("rank", 0))
         ev = {"name": f"{phase} {s.get('name', '')}".strip(), "cat": phase,
-              "pid": int(s.get("rank", 0)), "tid": lane,
+              "pid": pid, "tid": lane,
               "ts": round(ts_us, 3), "args": args}
         if s["t1"] > s["t0"]:
             ev["ph"] = "X"
@@ -99,9 +135,13 @@ def build_trace(spans: list[dict], metas: Optional[dict] = None) -> dict:
     out = {"traceEvents": events, "displayTimeUnit": "ms"}
     if metas:
         out["metadata"] = {
-            "ranks": sorted(metas),
+            "ranks": sorted(k for k in metas if isinstance(k, int)),
+            "procs": sorted(str(k) for k in metas
+                            if not isinstance(k, int)),
             "clock_offsets_ns": {str(r): m.get("clock_offset_ns", 0)
-                                 for r, m in sorted(metas.items())},
+                                 for r, m in sorted(metas.items(),
+                                                    key=lambda kv:
+                                                    str(kv[0]))},
         }
     return out
 

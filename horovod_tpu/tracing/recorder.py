@@ -44,12 +44,21 @@ def trace_id(name: str, seq: int) -> str:
 
 
 class TraceRecorder:
-    """Appends span records for ONE rank to a JSONL file."""
+    """Appends span records for ONE process to a JSONL file. Training
+    ranks are identified by ``rank``; serving processes (router, replicas)
+    pass ``proc`` — a stable label the collector turns into its own
+    Perfetto process row (tracing/serve.py). Every record is also retained
+    in the process flight ring (tracing/flight.py) — the ring is the
+    always-on recent-history capture, the file is the opt-in full trace."""
 
     def __init__(self, path: str, rank: int, clock_offset_ns: int = 0,
-                 max_spans: Optional[int] = None) -> None:
+                 max_spans: Optional[int] = None,
+                 proc: Optional[str] = None,
+                 buffering: int = 1 << 16) -> None:
         self.path = path
         self.rank = int(rank)
+        self.proc = proc
+        self._buffering = buffering
         self.clock_offset_ns = int(clock_offset_ns)
         self._lock = threading.Lock()
         self._f = None
@@ -101,11 +110,17 @@ class TraceRecorder:
         self._write(rec)
 
     def _meta(self) -> dict:
-        return {"meta": 1, "rank": self.rank, "clock": "monotonic_ns",
+        meta = {"meta": 1, "rank": self.rank, "clock": "monotonic_ns",
                 "clock_offset_ns": self.clock_offset_ns,
                 "pid": os.getpid(), "time_unix_s": time.time()}
+        if self.proc:
+            meta["proc"] = self.proc
+        return meta
 
     def _write(self, rec: dict) -> None:
+        from . import flight as _flight
+
+        _flight.get_flight().retain(rec)
         with self._lock:
             if self._failed or self._count >= self._max:
                 self._dropped.inc()
@@ -114,7 +129,8 @@ class TraceRecorder:
                 if self._f is None:
                     os.makedirs(os.path.dirname(self.path) or ".",
                                 exist_ok=True)
-                    self._f = open(self.path, "a", buffering=1 << 16)
+                    self._f = open(self.path, "a",
+                                   buffering=self._buffering)
                 if not self._meta_written:
                     self._meta_written = True
                     self._f.write(json.dumps(self._meta()) + "\n")
@@ -151,3 +167,9 @@ class TraceRecorder:
 
 def span_path(trace_dir: str, rank: int) -> str:
     return os.path.join(trace_dir, f"spans-rank{int(rank)}.jsonl")
+
+
+def proc_span_path(trace_dir: str, proc: str) -> str:
+    """Span file for a serving-plane process. ``proc`` must not start
+    with ``rank`` — the collector tells the two families apart by name."""
+    return os.path.join(trace_dir, f"spans-{proc}.jsonl")

@@ -219,6 +219,19 @@ def test_disaggregated_e2e_oracle_kill_and_stats():
         assert body["tokens"] == lm_generate(PARAMS, [3, 17, 5], 16)
         assert body["ttft_ms"] > 0 and body["n_tokens"] == 16
 
+        # GET /debug/sequences (ISSUE 15 satellite): the live
+        # per-sequence mirror answers on the LLM plane with the decode
+        # pool's replicas keyed in.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/sequences",
+                timeout=10) as r:
+            seqs = json.loads(r.read())
+        assert "replicas" in seqs and "prefill_queue_depth" in seqs
+        for rows in seqs["replicas"].values():
+            for row in rows:
+                assert {"rid", "state", "slot", "blocks", "tokens_out",
+                        "waited_iters", "preemptions"} <= set(row)
+
         # malformed requests answer 400, not 500
         for bad in ({"prompt": []}, {"prompt": [999]},
                     {"prompt": [1], "max_tokens": 10 ** 6}, {}):

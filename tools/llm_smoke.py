@@ -183,6 +183,14 @@ def main() -> int:
         errs = validate_snapshot(server.stats()["metrics"])
         if errs:
             fail(f"/stats snapshot schema violations: {errs[:5]}")
+        # ISSUE 15: the always-on anomaly detector must stay silent under
+        # nominal load — a false positive here would trip spurious flight
+        # dumps in every healthy deployment.
+        fired = {k: v for k, v in
+                 server.stats()["metrics"]["counters"].items()
+                 if k.startswith("horovod_anomaly_total") and v > 0}
+        if fired:
+            fail(f"anomaly detector fired under nominal load: {fired}")
         tok_per_s = nominal.decode_tokens / wall
         print(f"llm smoke: load OK — {n200} x 200, decode "
               f"{tok_per_s:.0f} tok/s, mean occupancy {occupancy:.2f}, "

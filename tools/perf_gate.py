@@ -178,6 +178,41 @@ def run_gate(current: list[dict], baselines: list[dict], min_ratio: float,
     return 0
 
 
+def run_trend(paths: list[str]) -> int:
+    """``--trend``: one line per metric across the bench history — the
+    best/latest/ratio trajectory VERDICT rounds kept re-deriving by hand.
+    Records from non-zero-rc bench runs are excluded by load_records
+    (the BENCH_r05 rc=124 shape never becomes a data point)."""
+    series: dict[tuple, list] = {}
+    n_files = 0
+    for p in paths:
+        if not os.path.exists(p):
+            continue
+        recs = [r for r in load_records(p) if _usable(r)]
+        if recs:
+            n_files += 1
+        for r in recs:
+            series.setdefault((r["metric"], _smoke_flag(r)), []).append(
+                (os.path.basename(p), float(r["value"]),
+                 r.get("unit", "")))
+    if not series:
+        print("perf gate trend: no usable records in the history",
+              file=sys.stderr)
+        return 2
+    for (metric, smoke), points in sorted(series.items()):
+        vals = [v for _, v, _ in points]
+        best, latest = max(vals), vals[-1]
+        tag = " (smoke)" if smoke else ""
+        traj = " -> ".join(f"{v:g}" for _, v, _ in points)
+        print(f"perf gate trend: {metric}{tag}: n={len(vals)} "
+              f"best={best:g} latest={latest:g} "
+              f"latest/best={latest / best:.3f} | {traj} "
+              f"{points[-1][2]}".rstrip())
+    print(f"perf gate trend: {len(series)} metric(s) across "
+          f"{n_files} record file(s)")
+    return 0
+
+
 def self_check(current: list[dict], min_ratio: float) -> int:
     """Prove the gate detects a 20% regression on today's own numbers."""
     usable = [r for r in current if _usable(r)]
@@ -198,7 +233,7 @@ def self_check(current: list[dict], min_ratio: float) -> int:
 
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--current", required=True,
+    ap.add_argument("--current", default=None,
                     help="bench output of the run under test")
     ap.add_argument("--baseline", action="append", default=[],
                     help="baseline file (repeatable)")
@@ -224,8 +259,21 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--self-check", action="store_true",
                     help="verify the gate fails a synthetic 20%% regression "
                          "of the current run, then exit")
+    ap.add_argument("--trend", action="store_true",
+                    help="print one best/latest/ratio trajectory line per "
+                         "metric across --baseline/--history records "
+                         "(skipped-rc records excluded), then exit")
     args = ap.parse_args(argv)
 
+    if args.trend:
+        paths = list(args.baseline)
+        for g in args.history:
+            paths.extend(sorted(glob.glob(g)))
+        if args.current and os.path.exists(args.current):
+            paths.append(args.current)
+        return run_trend(paths)
+    if args.current is None:
+        ap.error("--current is required (except with --trend)")
     if not os.path.exists(args.current):
         print(f"perf gate: ERROR — current file {args.current} not found",
               file=sys.stderr)

@@ -179,6 +179,10 @@ def main() -> int:
         shed = stats["serving"]["admission"]["shed_total"]
         if shed:
             fail(f"load shedding fired at nominal load ({shed} sheds)")
+        fired = {k: v for k, v in stats["metrics"]["counters"].items()
+                 if k.startswith("horovod_anomaly_total") and v > 0}
+        if fired:
+            fail(f"anomaly detector fired under nominal load: {fired}")
         counters = stats["metrics"]["counters"]
         for series in ('horovod_serve_requests_total{code="200"}',
                        "horovod_serve_batches_total"):

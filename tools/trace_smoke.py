@@ -130,8 +130,9 @@ def check_trace(trace_dir: str) -> None:
             fail(f"malformed complete event: {e}")
 
     spans, metas = load_spans(trace_dir)
-    if sorted(metas) != [0, 1]:
-        fail(f"expected meta records for ranks 0 and 1, got {sorted(metas)}")
+    rank_metas = sorted(k for k in metas if isinstance(k, int))
+    if rank_metas != [0, 1]:
+        fail(f"expected meta records for ranks 0 and 1, got {rank_metas}")
     by_tid: dict = {}
     for s in spans:
         by_tid.setdefault(s["tid"], set()).add(s["rank"])
@@ -169,6 +170,62 @@ def check_trace(trace_dir: str) -> None:
           f"{len(events)} trace events")
 
 
+def check_mixed_plane_merge(trace_dir: str) -> None:
+    """ISSUE 15 satellite: the collector merges a MIXED training+serving
+    span set — rank processes and replica processes in one strict trace,
+    torn-line tolerance preserved, and the two planes' trace-ID schemes
+    provably disjoint (training ``name#seq`` vs serving ``req:kind:rid``)."""
+    from horovod_tpu.tracing import load_spans, merge_trace
+    from horovod_tpu.tracing.serve import ServeTracer, serve_trace_id
+
+    os.environ["HOROVOD_TRACE_DIR"] = trace_dir
+    router = ServeTracer("serve-router")
+    tid = serve_trace_id("gen", 7)
+    t0 = router.now_ns()
+    router.span(tid, "admit", t0, t0 + 1000, rid=7, decision="ok")
+    router.span(tid, "queue", t0 + 1000, t0 + 5000, rid=7)
+    router.flush()
+    router.close()
+    rep = ServeTracer("llm-decode-0")
+    rep.span(f"it:llm-decode-0:1", "decode", t0 + 5000, t0 + 9000,
+             seqs=[7], n=1)
+    rep.point(tid, "retire", tokens=3)
+    rep.flush()
+    rep.close()
+    # A SIGKILL'd replica leaves a torn tail — the merge must shrug it off.
+    with open(os.path.join(trace_dir, "spans-llm-decode-0.jsonl"),
+              "a") as f:
+        f.write('{"tid": "req:gen:8", "pha')
+    del os.environ["HOROVOD_TRACE_DIR"]
+
+    spans, metas = load_spans(trace_dir)
+    procs = sorted(k for k in metas if not isinstance(k, int))
+    if procs != ["llm-decode-0", "serve-router"]:
+        fail(f"serving proc metas missing from the mixed merge: {procs}")
+    train_tids = {s["tid"] for s in spans if "proc" not in s}
+    serve_tids = {s["tid"] for s in spans if "proc" in s}
+    if not serve_tids or not train_tids:
+        fail(f"mixed span set incomplete: train={len(train_tids)} "
+             f"serve={len(serve_tids)}")
+    if train_tids & serve_tids:
+        fail(f"trace-ID collision across planes: "
+             f"{train_tids & serve_tids}")
+    if any("#" not in t for t in train_tids) or \
+            any("#" in t for t in serve_tids):
+        fail(f"ID schemes not disjoint by construction: train="
+             f"{sorted(train_tids)[:3]} serve={sorted(serve_tids)[:3]}")
+    trace = merge_trace(trace_dir)
+    with open(os.path.join(trace_dir, "trace.json")) as f:
+        json.load(f)   # strict parse straight off disk
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("name") == "process_name"}
+    if not {"rank 0", "rank 1", "serve-router", "llm-decode-0"} <= names:
+        fail(f"mixed trace lacks rank+replica process rows: {names}")
+    print(f"trace smoke: mixed-plane merge OK — processes {sorted(names)}, "
+          f"{len(serve_tids)} serving IDs disjoint from "
+          f"{len(train_tids)} training IDs, torn tail tolerated")
+
+
 def check_perf_gate(tmp: str) -> None:
     gate = os.path.join(REPO, "tools", "perf_gate.py")
     base = os.path.join(tmp, "gate_baseline.json")
@@ -203,6 +260,7 @@ def main() -> int:
     trace_dir = os.path.join(tmp, "trace")
     run_world(trace_dir)
     check_trace(trace_dir)
+    check_mixed_plane_merge(trace_dir)
     check_perf_gate(tmp)
     print("trace smoke OK")
     return 0
