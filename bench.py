@@ -402,6 +402,130 @@ def buckets_ab_main() -> None:
     })
 
 
+def controller_ab_main() -> None:
+    """bench.py --controller-ab: COLD job driven by the runtime controller
+    vs the offline-autotuned config (ISSUE 16 acceptance gate).
+
+    Arm A (reference): the offline GP/EI sweep (jax/autotune.tune) over
+    (fusion_threshold, num_buckets) — the throughput a job gets after
+    paying the full offline tuning bill. Arm B (candidate): the SAME cold
+    starting config, no offline sweep, with a
+    :class:`~horovod_tpu.control.TrainingController` re-tuning the knobs
+    live between measurement windows through a re-jit callback — every
+    change canaried against the pre-change baseline and rolled back on
+    regression. The emitted ``controller_convergence_ratio`` is the
+    controller arm's converged throughput over the offline arm's best
+    (ci.sh gates it at >= 0.90); rc=0 always, one JSON line always
+    (budget watchdog)."""
+    import jax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.control import TrainingController
+    from horovod_tpu.jax.autotune import measure_steps_per_s, tune
+
+    budget = _Budget.install("controller_convergence_ratio", "x")
+    budget.stage("init")
+    hvd.init()
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    smoke = _smoke_on() or not on_tpu
+    if smoke:
+        thresholds = (1 << 20, 16 << 20)
+        bucket_grid = (1, 2, 4)
+        warmup, iters, reps = 2, 5, 2
+        windows = 24
+    else:
+        thresholds = (64 << 20, 256 << 20)
+        bucket_grid = (1, 2, 4, 8)
+        warmup, iters, reps = 3, 8, 2
+        windows = 32
+    batch_box = [0]
+
+    def step_factory(fusion_threshold, num_buckets, compression=None):
+        if smoke:
+            step, state, (x, y), batch, _ = _build_smoke(
+                fusion_threshold, num_buckets, compression)
+            state = list(state)
+            loss_box = [None]
+
+            def run():
+                p, o, loss_box[0] = step(*state, x, y)
+                state[:] = (p, o)
+        else:
+            step, state, (x, y), batch, _ = _build(
+                fusion_threshold=fusion_threshold, num_buckets=num_buckets,
+                compression=compression)
+            state = list(state)
+            loss_box = [None]
+
+            def run():
+                p, bs, os_, loss_box[0] = step(*state, x, y)
+                state[:] = (p, bs, os_)
+        batch_box[0] = batch
+        return run, lambda: float(loss_box[0])
+
+    # -- arm A: the offline autotuner (the bill the controller avoids) ----
+    budget.stage("offline-arm")
+    report = tune(step_factory, thresholds=thresholds,
+                  num_buckets=bucket_grid, warmup=warmup, iters=iters,
+                  reps=reps, gp_rounds=1, verbose=False)
+    offline = report.best.steps_per_s
+    batch = batch_box[0]
+
+    # -- arm B: cold start + live controller, NO offline sweep ------------
+    budget.stage("controller-arm")
+    cur = {"fusion_threshold": thresholds[0], "num_buckets": 1,
+           "compression": None}
+    box = {}
+
+    def rebuild():
+        box["run"], box["sync"] = step_factory(
+            cur["fusion_threshold"], cur["num_buckets"],
+            cur["compression"])
+
+    def rejit(table):
+        for k, v in table.items():
+            if k == "compression":
+                cur[k] = None if v in (None, "none") else str(v)
+            elif k in cur:
+                cur[k] = int(v)
+        rebuild()
+
+    rebuild()
+    tc = TrainingController(rejit=rejit, canary_steps=2, cooldown_s=0.0)
+    tc.loop.set_current("fusion_threshold", cur["fusion_threshold"])
+    tc.loop.set_current("num_buckets", 1)
+    decisions = 0
+    rate = 0.0
+    for w in range(windows):
+        if budget.remaining() < 60:
+            budget.stages_skipped.append(f"controller-windows-{w}..")
+            break
+        rate = measure_steps_per_s(box["run"], warmup=warmup, iters=iters,
+                                   reps=1, sync=box["sync"])
+        tc.on_step(rate)
+        decisions = len(tc.loop.history)
+    converged = tc.loop.baseline or rate
+    ratio = converged / offline if offline > 0 else 0.0
+    budget.emit({
+        "metric": "controller_convergence_ratio",
+        "value": round(ratio, 4),
+        "unit": "x",
+        "smoke": smoke,
+        "offline_img_s": round(offline * batch, 2),
+        "controller_img_s": round(converged * batch, 2),
+        "offline_config": {"fusion_threshold": report.best.fusion_threshold,
+                           "num_buckets": report.best.num_buckets},
+        "controller_config": {k: v for k, v in tc.loop.values.items()
+                              if k in ("fusion_threshold", "num_buckets",
+                                       "compression")},
+        "decisions": decisions,
+        "commits": sum(1 for p in tc.loop.history
+                       if p["verdict"] == "commit"),
+        "rollbacks": sum(1 for p in tc.loop.history
+                         if p["verdict"] == "rollback"),
+    })
+
+
 def autotune_main() -> None:
     """bench.py --autotune: tune the COMPILED hot path's knobs by re-jitting
     the ResNet-50 train step per candidate (VERDICT r2 missing #2; reference
@@ -1549,6 +1673,7 @@ def main() -> None:
     # per mode HERE so a pre-jax failure still emits the right record.
     mode_metrics = {
         "--autotune": ("autotune_best_config", "steps/s"),
+        "--controller-ab": ("controller_convergence_ratio", "x"),
         "--buckets-ab": ("buckets_ab_images_per_sec", "img/s"),
         "--fsdp-ab": ("fsdp_ab_memory_reduction", "x"),
         "--roofline": ("resnet50_roofline", "GB/s"),
@@ -1583,6 +1708,8 @@ def main() -> None:
         return serve_bench_main()
     if "--autotune" in sys.argv:
         return autotune_main()
+    if "--controller-ab" in sys.argv:
+        return controller_ab_main()
     if "--fsdp-ab" in sys.argv:
         return fsdp_ab_main()
     if "--buckets-ab" in sys.argv:

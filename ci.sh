@@ -122,6 +122,21 @@ python tools/perf_gate.py --current /tmp/hvd_llm_smoke.log \
 echo "== obs smoke (ISSUE 15 observability: injected decode slowdown fires the ttft_slo anomaly + flight dump; SIGKILL'd decode replica's mmap flight ring survives; one-command bundle names the dead replica, merges a strict mixed-plane trace, and a /v1/generate request is followable admit->queue->prefill->handoff->decode->retire with TTFT decomposed by phase) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
+echo "== controller smoke (ISSUE 16 self-driving performance: 4-proc DCN bandwidth-collapse goes sparse via a canaried knob epoch within 20 steps and recovers full width bitwise-identically; decode-slowdown collapse fires drain_collapse, the committed target_queue cut scales the decode pool out and goodput recovers with zero failed requests; a healthy plane sees zero firings and zero proposals) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/controller_smoke.py | tee /tmp/hvd_controller_smoke.log
+python tools/perf_gate.py --current /tmp/hvd_controller_smoke.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric controller_smoke_recovery_ratio \
+  --min-abs controller_smoke_recovery_ratio=1.3 --allow-missing-baseline
+
+echo "== controller A/B bench + gate (ISSUE 16: cold job under HOROVOD_CONTROLLER=1 must converge to >= 0.90x the offline-tuned throughput without running the offline sweep) =="
+HVD_BENCH_SMOKE=1 timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python bench.py --controller-ab | tee /tmp/hvd_controller_ab.log
+python tools/perf_gate.py --current /tmp/hvd_controller_ab.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric controller_convergence_ratio \
+  --min-abs controller_convergence_ratio=0.90 --allow-missing-baseline
+
 echo "== fast tier (includes the launcher e2e: test_run_happy_path) =="
 python -m pytest tests/ -m fast -q
 

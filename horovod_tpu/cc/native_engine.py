@@ -90,6 +90,14 @@ def _load():
     lib.hvd_compression.argtypes = []
     lib.hvd_cache_flush.restype = None
     lib.hvd_cache_flush.argtypes = []
+    try:
+        # Live wire-format retune (ISSUE 16) — absent from an older .so;
+        # NativeEngine.set_knobs degrades to a clear error in that case.
+        lib.hvd_set_wire_format.restype = ctypes.c_int
+        lib.hvd_set_wire_format.argtypes = [ctypes.c_char_p,
+                                            ctypes.c_double]
+    except AttributeError:
+        pass
     lib.hvd_timeline_start.restype = ctypes.c_int
     lib.hvd_timeline_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.hvd_timeline_stop.restype = None
@@ -128,6 +136,7 @@ class NativeEngine:
         self.topo = topo
         self.config = config
         self._lib = _load()
+        self._knob_epoch_seen = 0   # local set_knobs applies (ISSUE 16)
         host, port = "", 0
         if topo.size > 1:
             addr = os.environ.get("HOROVOD_COORD_ADDR")
@@ -388,6 +397,34 @@ class NativeEngine:
         """Drop this rank's cached negotiations (elastic reset path); the
         mirror self-heals from the coordinator's re-announcements."""
         self._lib.hvd_cache_flush()
+
+    # -- live knob retuning (ISSUE 16) ---------------------------------------
+
+    def set_knobs(self, table: dict) -> int:
+        """Apply a knob table to the native core. Rank-LOCAL: the C++
+        coordinator has no knob-epoch protocol yet, so a multi-process
+        caller (the runtime controller) must invoke this on every rank at
+        the same step boundary — the Python engine's set_knobs is the
+        epoch-coordinated path. Returns the local knob-apply count."""
+        fn = getattr(self._lib, "hvd_set_wire_format", None)
+        if fn is None:
+            raise HorovodInternalError(
+                "this libhorovod_tpu.so predates hvd_set_wire_format — "
+                "rebuild with `make -C horovod_tpu/cc`")
+        comp = table.get("compression")
+        ratio = float(table.get("topk_ratio", 0.0) or 0.0)
+        if comp is None and not ratio:
+            return self._knob_epoch_seen
+        if comp is None:
+            comp = str(getattr(self.config, "compression", "none") or
+                       "none")
+        if not int(fn(str(comp).encode(), ratio)):
+            raise HorovodInternalError("native engine not initialized")
+        self._knob_epoch_seen += 1
+        return self._knob_epoch_seen
+
+    def knob_epoch(self) -> int:
+        return self._knob_epoch_seen
 
     def trace_drain(self) -> int:
         """Move pending native span records into this rank's span file;

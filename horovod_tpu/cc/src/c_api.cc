@@ -271,6 +271,18 @@ int hvd_compression() {
   return eng ? eng->wire_dtype() : -1;
 }
 
+// Live wire-format retune (ISSUE 16 runtime controller): swap the
+// enqueue-time compression table to a HOROVOD_COMPRESSION-style spec
+// ("none"/"bf16"/"fp16"/"topk[@r]"/"adaptive"); topk_ratio > 0 overrides
+// the spec's ratio. Cross-rank atomicity is the caller's job (land it
+// inside a coordinator knob epoch). Returns 1 on apply, 0 w/o engine.
+int hvd_set_wire_format(const char* spec, double topk_ratio) {
+  auto eng = engine();
+  if (!eng) return 0;
+  eng->set_wire_format(spec ? spec : "", topk_ratio);
+  return 1;
+}
+
 // ---- response cache (this PR: the steady-state fast path) ----
 
 // Live entries in this rank's cache mirror; -1 = no engine.

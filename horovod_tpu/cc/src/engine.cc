@@ -344,6 +344,40 @@ Engine::Engine(const Topology& topo, const EngineConfig& cfg)
 
 Engine::~Engine() { shutdown(); }
 
+void Engine::set_wire_format(const std::string& spec, double topk_ratio) {
+  // Same grammar as wire_dtype_from_env / sparse_spec_from_env so a spec
+  // string behaves identically whether it arrived via the env at launch
+  // or via the runtime controller mid-job.
+  std::string s(spec);
+  for (auto& c : s) c = (char)std::tolower((unsigned char)c);
+  SparseSpec sp;
+  {
+    std::lock_guard<std::mutex> lk(wire_knob_mu_);
+    sp.ratio = sparse_.ratio;  // preserved unless the call overrides it
+  }
+  if (topk_ratio > 0) sp.ratio = topk_ratio < 0.5 ? topk_ratio : 0.5;
+  int wire = -1;
+  if (s == "fp16") {
+    wire = (int)DataType::F16;
+  } else if (s == "bf16") {
+    wire = (int)DataType::BF16;
+  } else if (s == "adaptive") {
+    sp.adaptive = true;
+  } else if (s == "topk") {
+    sp.topk = true;
+  } else if (s.rfind("topk@", 0) == 0) {
+    double v = std::atof(s.c_str() + 5);
+    sp.topk = true;
+    if (topk_ratio <= 0 && v > 0) sp.ratio = v < 0.5 ? v : 0.5;
+  }
+  // anything else ("none", "") -> dense f32, matching the env parsers
+  {
+    std::lock_guard<std::mutex> lk(wire_knob_mu_);
+    wire_dtype_ = wire;
+    sparse_ = sp;
+  }
+}
+
 int64_t Engine::enqueue(OpType op, const std::string& name, DataType dtype,
                         const std::vector<int64_t>& shape, const void* data,
                         int root_rank, bool average) {
@@ -378,11 +412,19 @@ int64_t Engine::enqueue(OpType op, const std::string& name, DataType dtype,
   // of the selection; `adaptive` consults the deterministic (size, dtype,
   // topology) table shared with common/policy.py — identical inputs on
   // every rank, so cross-rank wire agreement holds with zero negotiation.
-  int wire = wire_dtype_;
+  int wire;
+  SparseSpec sp;
+  {
+    // One coherent snapshot of the live wire table (set_wire_format may
+    // swap it between enqueues; a torn read could mix dtype and ratio).
+    std::lock_guard<std::mutex> lk(wire_knob_mu_);
+    wire = wire_dtype_;
+    sp = sparse_;
+  }
   bool topk = false;
   if (op == OpType::ALLREDUCE) {
     bool wide_float = dtype == DataType::F32 || dtype == DataType::F64;
-    if (sparse_.adaptive) {
+    if (sp.adaptive) {
       wire = -1;
       if (topo_.cross_size > 1 && wide_float &&
           (int64_t)nbytes >= compression_min_bytes_) {
@@ -390,15 +432,15 @@ int64_t Engine::enqueue(OpType op, const std::string& name, DataType dtype,
                             ? topk_min_bytes_
                             : compression_min_bytes_;
         if (dtype == DataType::F32 && (int64_t)nbytes >= floor &&
-            topk_eligible(nbytes, sparse_.ratio, compression_min_bytes_)) {
+            topk_eligible(nbytes, sp.ratio, compression_min_bytes_)) {
           topk = true;
         } else {
           wire = (int)DataType::BF16;
         }
       }
-    } else if (sparse_.topk) {
+    } else if (sp.topk) {
       topk = dtype == DataType::F32 &&
-             topk_eligible(nbytes, sparse_.ratio, compression_min_bytes_);
+             topk_eligible(nbytes, sp.ratio, compression_min_bytes_);
     }
   }
   // Error-feedback residual claim (DGC): popped BEFORE select/quantize so
@@ -428,7 +470,7 @@ int64_t Engine::enqueue(OpType op, const std::string& name, DataType dtype,
     }
     std::vector<int32_t> ti;
     std::vector<float> tv;
-    topk_select(src, elems, topk_k(elems, sparse_.ratio), ti, tv);
+    topk_select(src, elems, topk_k(elems, sp.ratio), ti, tv);
     e.data.assign(nbytes, 0);
     float* dst = (float*)e.data.data();
     for (size_t j = 0; j < ti.size(); j++) dst[(size_t)ti[j]] = tv[j];
@@ -1144,6 +1186,11 @@ void Engine::execute_sparse_allreduce(const ResponseEntry& re, Entry& e) {
     timeline_.activity_start(e.req.name,
                              hier ? "HIER_ALLREDUCE" : "RING_ALLREDUCE");
   SparseWire sw;
+  bool adaptive;
+  {
+    std::lock_guard<std::mutex> lk(wire_knob_mu_);
+    adaptive = sparse_.adaptive;
+  }
   if (hier) {
     // Per-fabric framing (value-neutral): explicit topk prefers sparse on
     // both fabrics; adaptive ships sparse on the cross-host leaders rings
@@ -1151,12 +1198,12 @@ void Engine::execute_sparse_allreduce(const ResponseEntry& re, Entry& e) {
     grid_sparse_allreduce(local_ring_, cross_ring_, topo_.local_rank,
                           topo_.local_size, topo_.cross_rank,
                           topo_.cross_size, (float*)e.data.data(), n,
-                          re.average != 0, /*sp_local=*/!sparse_.adaptive,
+                          re.average != 0, /*sp_local=*/!adaptive,
                           /*sp_cross=*/true, &stats_, &sw);
   } else {
     ring_sparse_allreduce(ring_, topo_.rank, topo_.size,
                           (float*)e.data.data(), n, re.average != 0,
-                          sparse_.adaptive ? flat_next_cross_ : true,
+                          adaptive ? flat_next_cross_ : true,
                           &stats_, &sw);
   }
   if (timeline_.healthy()) timeline_.activity_end(e.req.name);

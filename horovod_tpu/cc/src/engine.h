@@ -286,7 +286,20 @@ class Engine {
 
   // Live wire-compression dtype: (int)DataType of the 16-bit wire format,
   // or -1 when HOROVOD_COMPRESSION is none (c_api hvd_compression).
-  int wire_dtype() const { return wire_dtype_; }
+  int wire_dtype() const {
+    std::lock_guard<std::mutex> g(wire_knob_mu_);
+    return wire_dtype_;
+  }
+
+  // Live wire-format retune (ISSUE 16 runtime controller): re-parses a
+  // HOROVOD_COMPRESSION-style spec ("none"/"bf16"/"fp16"/"topk[@r]"/
+  // "adaptive") and swaps it in under the knob mutex — later enqueues
+  // quantize under the new table; already-enqueued entries keep the bytes
+  // they framed. topk_ratio > 0 overrides the spec's @ratio. Bitwise
+  // safety across ranks is the caller's job: land it inside a coordinator
+  // knob epoch (Python engine set_knobs) so every rank switches on the
+  // same collective boundary.
+  void set_wire_format(const std::string& spec, double topk_ratio);
 
   // Engine telemetry counters (c_api hvd_metric / hvd_last_stall).
   const EngineMetrics& op_metrics() const { return metrics_; }
@@ -420,8 +433,10 @@ class Engine {
   // caller dtype at completion; the ring then moves and reduces 2-byte
   // elements natively (add_chunk accumulates each add in f32, ring.h).
   int wire_dtype_ = -1;
-  // Sparse/adaptive wire config (ISSUE 13): parsed once at construction
-  // from the same env knobs the Python engine reads.
+  // Sparse/adaptive wire config (ISSUE 13): parsed at construction from
+  // the same env knobs the Python engine reads; retunable live through
+  // set_wire_format (ISSUE 16) — every read copies under wire_knob_mu_.
+  mutable std::mutex wire_knob_mu_;
   SparseSpec sparse_;
   int64_t topk_min_bytes_ = 1 << 16;        // HOROVOD_TOPK_MIN_BYTES
   int64_t compression_min_bytes_ = 4096;    // HOROVOD_COMPRESSION_MIN_BYTES

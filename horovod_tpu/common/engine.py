@@ -1398,6 +1398,21 @@ class PyEngine:
             self._error_feedback
             or os.environ.get("HOROVOD_COMPRESSION_ERROR_FEEDBACK")
             in ("", None))
+        # Live knob retuning (ISSUE 16): the runtime controller switches
+        # value-affecting knobs (wire format, top-k ratio) mid-job through
+        # the coordinator's knob epoch — the demote/re-promote safe-switch
+        # of ISSUE 8 generalized from "plane" to "any knob". Enqueue reads
+        # ONE snapshot dict (replaced wholesale under _lock, read as a
+        # single reference), so a caller-thread submission can never see a
+        # torn (epoch, format) pair; the entry's `ke` stamp tells the
+        # coordinator which table formatted it.
+        self._knob_epoch_seen = 0
+        self._knobs: dict = {
+            "epoch": 0,
+            "compression": self._compression_name,
+            "topk_ratio": self._topk_ratio,
+            "policy": self._policy,
+        }
         # Distributed tracing (ISSUE 6, docs/tracing.md): per-rank span
         # recorder + per-name submission counters — the counter makes the
         # trace ID (<name>#<seq>) deterministic AND identical across ranks
@@ -1424,6 +1439,10 @@ class PyEngine:
         self._m_exch = self._metrics.counter(
             "horovod_engine_exchanges_total",
             help="coordinator exchanges performed")
+        self._m_knob_changes = self._metrics.counter(
+            "horovod_knob_changes_total",
+            help="live knob-table epochs applied by this rank "
+                 "(ISSUE 16 runtime controller safe-switch)")
         self._m_star = self._metrics.counter(
             "horovod_engine_data_bytes_total",
             help="tensor bytes moved by the eager data plane", plane="star")
@@ -1559,69 +1578,6 @@ class PyEngine:
             # handles increment identically across ranks when op order matches.
             name = f"{op}.noname.{handle}"
         arr = np.asarray(array)
-        # Wire-format resolution (ISSUE 5 + ISSUE 9): an explicit
-        # HOROVOD_COMPRESSION name passes through; 'adaptive' consults the
-        # per-fabric-tier policy. Deterministic in (size, dtype, topology,
-        # config) only, so every rank resolves the same format and the
-        # coordinator's cross-rank wire validation holds by construction.
-        fmt = "none"
-        if op == "allreduce":
-            fmt = (self._policy.resolve(int(arr.nbytes), arr.dtype)
-                   if self._policy is not None else self._compression_name)
-        wire_tag = None      # request['wire']: a numpy dtype or "topk"
-        wire_np = None
-        wire_arr = None
-        wire_method = None
-        sparse_tiers = None
-        if fmt == "topk" and not topk_eligible(
-                arr.dtype, int(arr.nbytes), self._topk_ratio,
-                self._compression_min_bytes):
-            fmt = "none"  # non-f32 / below the floor: ship dense
-        if fmt == "topk":
-            # Claim the residual HERE, before the select — the redo path
-            # after a plane demotion replays the already-sparsified
-            # contribution (e['array']/e['wire_array']) and must never fold
-            # the residual a second time (ISSUE 9 satellite; the pop makes
-            # the claim literal).
-            ef = self._topk_error_feedback
-            res = self._residuals.pop(name, None) if ef else None
-            if (res is not None and res.shape == arr.shape
-                    and res.dtype == arr.dtype):
-                arr = arr + res
-            flat = np.ascontiguousarray(arr).ravel()
-            k = topk_k(flat.size, self._topk_ratio)
-            t_idx, t_val = topk_select(flat, k)
-            dense = topk_densify(t_idx, t_val, flat.size).reshape(arr.shape)
-            if ef:
-                # The un-sent mass: everything the selection dropped plus
-                # nothing else (selected values ship exactly), carried into
-                # the NEXT submission of this name (DGC).
-                self._residuals[name] = arr - dense
-            arr = dense
-            wire_tag = "topk"
-            wire_method = "topk"
-            # Star uploads ship the packed sparse frame of the whole tensor.
-            wire_arr = topk_pack(t_idx, t_val)
-            sparse_tiers = (self._policy.sparse_tiers()
-                            if self._policy is not None else None)
-        elif fmt in ("fp16", "bf16"):
-            wire_np = numpy_wire_dtype(fmt, arr.dtype)
-        if wire_np is not None:
-            res = (self._residuals.pop(name, None)
-                   if self._error_feedback else None)
-            if (res is not None and res.shape == arr.shape
-                    and res.dtype == arr.dtype):
-                arr = arr + res
-            # Quantize the contribution once, here: both data planes then
-            # move/reduce the exact wire-representable value, which is what
-            # keeps star==ring and cold==cached bitwise under compression.
-            wire_arr = np.ascontiguousarray(arr).astype(wire_np)
-            deq = wire_arr.astype(arr.dtype)
-            if self._error_feedback:
-                self._residuals[name] = arr - deq
-            arr = deq
-            wire_tag = wire_np
-            wire_method = fmt
         tid = None
         if self._trace is not None:
             # Trace ID at first enqueue: the k-th submission of `name`. A
@@ -1635,17 +1591,26 @@ class PyEngine:
         entry = {
             "op": op,
             "array": arr,
+            "orig": arr,
             "name": name,
             "root": root_rank,
             "average": average,
             "handle": handle,
             "t": time.monotonic(),
-            "wire": wire_tag,
-            "wire_array": wire_arr,
-            "wire_method": wire_method,
-            "sparse_tiers": sparse_tiers,
+            "wire": None,
+            "wire_array": None,
+            "wire_method": None,
+            "sparse_tiers": None,
+            "ke": 0,
+            "res_claimed": None,
             "tid": tid,
         }
+        # Wire-format resolution + quantization from ONE knob snapshot
+        # (ISSUE 5/9/16): deterministic in (size, dtype, topology, table),
+        # so every rank at the same knob epoch resolves the same format and
+        # the coordinator's cross-rank wire validation holds.
+        self._format_entry(entry, self._knobs)
+        arr = entry["array"]
         with self._lock:
             if name in self._inflight:
                 raise HorovodInternalError(
@@ -1755,6 +1720,198 @@ class PyEngine:
                     method=method)
                 self._m_saved_method[method] = ctr
             ctr.inc(saved_bytes)
+
+    # -- live knob retuning (ISSUE 16) -------------------------------------
+
+    def _format_entry(self, e: dict, ks: dict) -> None:
+        """Resolve and apply the wire format of one entry under the knob
+        snapshot ``ks`` — the single formatting point for first enqueue AND
+        the knob-epoch reformat path. Claims the error-feedback residual
+        (the pop makes the claim literal) and remembers it in
+        ``res_claimed`` so :meth:`_unformat_entry` can put it back."""
+        op, name = e["op"], e["name"]
+        arr = e["orig"]
+        fmt = "none"
+        if op == "allreduce":
+            pol = ks["policy"]
+            fmt = (pol.resolve(int(arr.nbytes), arr.dtype)
+                   if pol is not None else ks["compression"])
+        wire_tag = None      # request['wire']: a numpy dtype or "topk"
+        wire_np = None
+        wire_arr = None
+        wire_method = None
+        sparse_tiers = None
+        res_claimed = None
+        if fmt == "topk" and not topk_eligible(
+                arr.dtype, int(arr.nbytes), ks["topk_ratio"],
+                self._compression_min_bytes):
+            fmt = "none"  # non-f32 / below the floor: ship dense
+        if fmt == "topk":
+            # Claim the residual HERE, before the select — the redo path
+            # after a plane demotion replays the already-sparsified
+            # contribution (e['array']/e['wire_array']) and must never fold
+            # the residual a second time (ISSUE 9 satellite; the pop makes
+            # the claim literal).
+            ef = self._topk_error_feedback
+            res = self._residuals.pop(name, None) if ef else None
+            res_claimed = res
+            if (res is not None and res.shape == arr.shape
+                    and res.dtype == arr.dtype):
+                arr = arr + res
+            flat = np.ascontiguousarray(arr).ravel()
+            k = topk_k(flat.size, ks["topk_ratio"])
+            t_idx, t_val = topk_select(flat, k)
+            dense = topk_densify(t_idx, t_val, flat.size).reshape(arr.shape)
+            if ef:
+                # The un-sent mass: everything the selection dropped plus
+                # nothing else (selected values ship exactly), carried into
+                # the NEXT submission of this name (DGC).
+                self._residuals[name] = arr - dense
+            arr = dense
+            wire_tag = "topk"
+            wire_method = "topk"
+            # Star uploads ship the packed sparse frame of the whole tensor.
+            wire_arr = topk_pack(t_idx, t_val)
+            sparse_tiers = (ks["policy"].sparse_tiers()
+                            if ks["policy"] is not None else None)
+        elif fmt in ("fp16", "bf16"):
+            wire_np = numpy_wire_dtype(fmt, arr.dtype)
+        if wire_np is not None:
+            res = (self._residuals.pop(name, None)
+                   if self._error_feedback else None)
+            res_claimed = res
+            if (res is not None and res.shape == arr.shape
+                    and res.dtype == arr.dtype):
+                arr = arr + res
+            # Quantize the contribution once, here: both data planes then
+            # move/reduce the exact wire-representable value, which is what
+            # keeps star==ring and cold==cached bitwise under compression.
+            wire_arr = np.ascontiguousarray(arr).astype(wire_np)
+            deq = wire_arr.astype(arr.dtype)
+            if self._error_feedback:
+                self._residuals[name] = arr - deq
+            arr = deq
+            wire_tag = wire_np
+            wire_method = fmt
+        e["array"] = arr if fmt != "none" else e["orig"]
+        e["wire"] = wire_tag
+        e["wire_array"] = wire_arr
+        e["wire_method"] = wire_method
+        e["sparse_tiers"] = sparse_tiers
+        e["ke"] = int(ks["epoch"])
+        e["res_claimed"] = res_claimed
+
+    def _unformat_entry(self, e: dict) -> None:
+        """Undo :meth:`_format_entry`'s error-feedback side effects so the
+        entry can be re-formatted under a NEW knob table. Safe because the
+        duplicate-name guard means nothing else touched this name's
+        residual slot since the entry was formatted."""
+        if e.get("wire") is not None:
+            self._residuals.pop(e["name"], None)
+            if e.get("res_claimed") is not None:
+                self._residuals[e["name"]] = e["res_claimed"]
+        e["res_claimed"] = None
+
+    def _apply_knob_table(self, table: dict, epoch: int) -> None:
+        """Adopt a committed knob table (engine-side knobs only: wire
+        compression + top-k ratio; unknown keys belong to other layers and
+        are ignored here). Replaces the enqueue snapshot atomically."""
+        comp = table.get("compression")
+        ratio = table.get("topk_ratio")
+        if comp is not None:
+            name, ratio_override = parse_spec(str(comp))
+            if ratio_override:
+                ratio = ratio_override
+            self._compression = str(comp)
+            self._compression_name = name
+            self._policy = (CompressionPolicy(self.config, self.topo)
+                            if name == "adaptive" else None)
+        if ratio is not None:
+            self._topk_ratio = float(ratio)
+        self._knobs = {
+            "epoch": int(epoch),
+            "compression": self._compression_name,
+            "topk_ratio": self._topk_ratio,
+            "policy": self._policy,
+        }
+        self._m_knob_changes.inc()
+        log("info",
+            f"knob epoch {epoch} applied on rank {self.topo.rank}: "
+            f"{ {k: v for k, v in table.items()} }")
+        try:
+            from ..tracing import flight as _flight
+
+            _flight.get_flight().event(
+                "knob_apply", rank=self.topo.rank, epoch=int(epoch),
+                table={k: str(v) for k, v in table.items()})
+        except Exception:  # noqa: BLE001 - telemetry never blocks the switch
+            pass
+
+    def set_knobs(self, table: dict) -> int:
+        """Commit a value-affecting knob change to the WHOLE world (ISSUE
+        16). Multi-process: the coordinator bumps its knob epoch, demotes
+        the data plane for one safe-switch cycle (in-flight collectives
+        replay bitwise through the ISSUE 8 redo machinery with their
+        already-formatted bytes), and every rank adopts the table
+        atomically from its next exchange response. Single-process: applied
+        immediately. Returns the committed epoch."""
+        if self.topo.size == 1 or self._client is None:
+            epoch = self._knob_epoch_seen + 1
+            self._knob_epoch_seen = epoch
+            self._apply_knob_table(dict(table), epoch)
+            return epoch
+        return self._client.knob_change(dict(table))
+
+    def knob_epoch(self) -> int:
+        """The knob epoch this rank has applied (0 = launch table)."""
+        return self._knob_epoch_seen
+
+    def _apply_knob_signals(self) -> None:
+        """Consume the coordinator's knob epoch + reformat signals from the
+        last exchange response. Runs on the engine thread AFTER the plane
+        signals (so recalled entries are already redo-marked and keep their
+        old-format bytes) and BEFORE the next submission cycle."""
+        knob = self._client.last_knob
+        if knob:
+            epoch = int(knob.get("epoch", 0))
+            if epoch > self._knob_epoch_seen:
+                self._knob_epoch_seen = epoch
+                self._apply_knob_table(dict(knob.get("table") or {}), epoch)
+                # Proactively re-format queued entries that have not been
+                # negotiated yet: they would only be bounced with a
+                # `reformat` answer next tick. Ring-directive redo replays
+                # (real seq) keep their already-formatted bytes — the
+                # interrupted collective replays bitwise under the OLD
+                # table by design — but recalled star pendings (sentinel
+                # seq -1) re-enter a fresh re-reduce and switch to the new
+                # table like everything else.
+                sentinel = {nm for nm, seq in self._client.last_redo
+                            if int(seq) == -1}
+                with self._lock:
+                    stale = [e for e in self._queue
+                             if e["op"] == "allreduce"
+                             and int(e.get("ke", 0)) != epoch
+                             and (e["name"] in sentinel
+                                  or (not e.get("redo")
+                                      and not e.get("sent")))]
+                for e in stale:
+                    self._unformat_entry(e)
+                    self._format_entry(e, self._knobs)
+                    if e["name"] in sentinel:
+                        e["sent"] = False
+        for nm in self._client.last_reformat:
+            # The coordinator refused this rank's stale-epoch contribution;
+            # re-format it under the table that rode the same response and
+            # re-submit with bytes. (Only sentinel-recalled redos can be
+            # bounced — ring-directive redos are exempt — so reformatting a
+            # redo-marked entry here is always the fresh-re-reduce case.)
+            with self._lock:
+                entry = next((e for e in self._queue if e["name"] == nm),
+                             None)
+            if entry is not None:
+                self._unformat_entry(entry)
+                self._format_entry(entry, self._knobs)
+                entry["sent"] = False
 
     # -- transport-resilience ladder (ISSUE 8) -----------------------------
 
@@ -2084,6 +2241,12 @@ class PyEngine:
                     # coordinator re-derives the ID from its own counter and
                     # uses this tag to VERIFY cross-rank agreement).
                     req["trace"] = e["tid"]
+                if e["op"] == "allreduce" and e.get("ke"):
+                    # Knob-epoch stamp (ISSUE 16): tells the coordinator
+                    # which knob table formatted this contribution, so a
+                    # mid-run retune bounces stale-format uploads into a
+                    # reformat instead of a hard wire-mismatch error.
+                    req["ke"] = int(e["ke"])
                 requests.append(req)
                 self._m_full.inc()
         # Redo answers (ISSUE 8): a link that died on a collective's FINAL
@@ -2178,6 +2341,10 @@ class PyEngine:
         # sees them) and BEFORE directives execute (so a recalled plane is
         # not used).
         self._apply_plane_signals()
+        # Knob signals AFTER plane signals: a knob epoch demotes the plane,
+        # and the redo marking above must run first so interrupted
+        # collectives keep their already-formatted (old-table) bytes.
+        self._apply_knob_signals()
         # Ring execution in global sequence order: the coordinator stamps
         # each ready allreduce with a monotonic seq, and every rank executes
         # them in that order, so the neighbour exchanges pair up.
@@ -2351,6 +2518,18 @@ class _Coordinator:
         self._redo_claim: dict[str, set] = {}
         self._repromote_s = _env_float("HOROVOD_PLANE_REPROMOTE_S", 30.0)
         self._repromote_at: Optional[float] = None
+        # --- live knob retuning (ISSUE 16) ---
+        # The knob epoch generalizes the demote/re-promote safe-switch from
+        # "plane" to "any value-affecting knob": a knob_change bumps this
+        # epoch, demotes the eager plane for one cycle (interrupted
+        # collectives replay bitwise through the redo machinery above), and
+        # the cumulative committed table rides every exchange response until
+        # each rank has applied it. Contributions formatted under a STALE
+        # epoch are bounced back (`reformat`) instead of tripping the
+        # cross-rank wire-mismatch error.
+        self._knob_epoch = 0
+        self._knob_table: dict = {}
+        self._knob_repromote_s = _env_float("HOROVOD_KNOB_REPROMOTE_S", 1.0)
         # Ranks whose control connection dropped uncleanly (no "bye"): their
         # collectives can never complete — fail them so survivors escalate
         # to the elastic reset instead of waiting for the stall watchdog.
@@ -2434,6 +2613,9 @@ class _Coordinator:
                     _send_msg(conn, self._handle_plane_fault(
                         msg["rank"], msg.get("names") or [],
                         msg.get("reason", "")), self.key)
+                elif kind == "knob_change":
+                    _send_msg(conn, self._handle_knob_change(
+                        msg["rank"], msg.get("table") or {}), self.key)
                 elif kind == "clock_probe":
                     # Trace clock alignment (tracing/clock.py): answer with
                     # this process's monotonic reading, nothing else — the
@@ -2538,30 +2720,7 @@ class _Coordinator:
         reporter must replay."""
         with self._cv:
             if self.ring_active:
-                self.ring_active = False
-                self._demote_epoch += 1
-                if self._repromote_s > 0:
-                    self._repromote_at = time.monotonic() + self._repromote_s
-                # Ring-plane contributions were metadata-only; the star
-                # replay needs bytes. Drop them so re-submissions (full
-                # request + tensor) take their place.
-                for entry in self._pending.values():
-                    for r in [r for r, (_q, a) in entry.items() if a is None]:
-                        del entry[r]
-                # Recall undelivered ring directives: ranks that have not
-                # claimed them yet renegotiate on the star; ranks that
-                # already executed retain their result for the redo.
-                for nm in list(self._results):
-                    err, val = self._results[nm]
-                    if err is None and isinstance(val, dict) \
-                            and val.get("__ring__"):
-                        # Ranks that already claimed the directive may have
-                        # finished it; ranks that never claimed it will
-                        # renegotiate and must claim the redo answer.
-                        was_claimed = set(self._claimed.get(nm, set()))
-                        del self._results[nm]
-                        self._claimed.pop(nm, None)
-                        self._want_redo(nm, finished=was_claimed)
+                self._demote_and_recall(self._repromote_s)
                 log("warning",
                     f"coordinator: eager data plane demoted to star after a "
                     f"link fault on rank {rank} "
@@ -2589,6 +2748,83 @@ class _Coordinator:
                     self._redo_claim[nm].discard(rank)
             self._cv.notify_all()
         return {"ok": 1}
+
+    def _demote_and_recall(self, cooldown: float) -> None:
+        """Demote the active eager plane to the star relay and recall its
+        undelivered directives into redo negotiations (caller holds the
+        lock). Shared by the link-fault path and the knob-epoch safe
+        switch; ``cooldown`` arms the re-promotion probe."""
+        self.ring_active = False
+        self._demote_epoch += 1
+        if cooldown > 0:
+            self._repromote_at = time.monotonic() + cooldown
+        # Ring-plane contributions were metadata-only; the star
+        # replay needs bytes. Drop them so re-submissions (full
+        # request + tensor) take their place.
+        for entry in self._pending.values():
+            for r in [r for r, (_q, a) in entry.items() if a is None]:
+                del entry[r]
+        # Recall undelivered ring directives: ranks that have not
+        # claimed them yet renegotiate on the star; ranks that
+        # already executed retain their result for the redo.
+        for nm in list(self._results):
+            err, val = self._results[nm]
+            if err is None and isinstance(val, dict) \
+                    and val.get("__ring__"):
+                # Ranks that already claimed the directive may have
+                # finished it; ranks that never claimed it will
+                # renegotiate and must claim the redo answer.
+                was_claimed = set(self._claimed.get(nm, set()))
+                del self._results[nm]
+                self._claimed.pop(nm, None)
+                self._want_redo(nm, finished=was_claimed)
+
+    def _handle_knob_change(self, rank: int, table: dict) -> dict:
+        """Commit a value-affecting knob table world-wide (ISSUE 16) via
+        the demote/re-promote safe switch. Three guarantees: (1) every
+        in-flight eager directive replays BITWISE under its old format
+        (recalled through the redo machinery — retained results or a
+        canonical star re-reduce over the already-formatted bytes); (2)
+        pending star negotiations are recalled into a fresh-only redo (seq
+        sentinel -1: a stale retained copy of a previous same-name
+        execution must never answer them) and re-collected after every
+        rank reformats; (3) no rank mixes tables within one collective —
+        stale-epoch contributions are bounced, never ingested."""
+        with self._cv:
+            self._knob_table.update({str(k): v for k, v in table.items()})
+            self._knob_epoch += 1
+            # ALWAYS bump the demote epoch: ranks run _redo_inflight on it,
+            # which redo-marks their sent-but-unanswered entries so the
+            # engine-side knob apply skips them (they replay old-format).
+            if self.ring_active:
+                self._demote_and_recall(self._knob_repromote_s)
+                log("info",
+                    f"coordinator: eager plane demoted for knob epoch "
+                    f"{self._knob_epoch} (rank {rank}); re-promotion probe "
+                    f"in {self._knob_repromote_s:g}s")
+            else:
+                self._demote_epoch += 1
+            # Recall every pending (incomplete) allreduce negotiation: its
+            # collected contributions may span knob epochs. Fresh-only redo
+            # (sentinel seq -1) — every rank re-ships bytes formatted under
+            # the NEW table and the star folds them canonically.
+            for nm in list(self._pending):
+                reqs = [q for (q, _a) in self._pending[nm].values()]
+                if not reqs or reqs[0].get("op") != "allreduce":
+                    continue
+                del self._pending[nm]
+                self._first_seen.pop(nm, None)
+                if nm not in self._results and nm not in self._redo_wanted:
+                    self._redo_wanted[nm] = -1
+                    self._redo_claim[nm] = set()
+            # Flush the response cache: cached request dicts carry the OLD
+            # epoch's wire signature and ke stamp, and a stale bit must
+            # never let two formats meet in one collective. Tombstones keep
+            # in-flight bits resolvable (they bounce on the ke check) and
+            # the per-rank eviction queues re-teach every mirror.
+            self._queue_evictions(self._cache.flush())
+            self._cv.notify_all()
+            return {"ok": 1, "epoch": self._knob_epoch}
 
     def _want_redo(self, name: str, finished: Optional[set] = None) -> None:
         """Open a redo negotiation for ``name`` (caller holds the lock): the
@@ -2777,12 +3013,30 @@ class _Coordinator:
                             and rank not in self._pending.get(req["name"], {})):
                         self._cache.misses += 1
             all_reqs = full_reqs + self._resolve_bits(bits)
+            reformat: list[str] = []
             for req in all_reqs:
                 name = req["name"]
                 # Re-poll after a partial response: the result is already
                 # waiting for this rank — don't contribute again (a stale
                 # entry would poison the next same-name collective).
                 if name in self._results and rank not in self._claimed.get(name, set()):
+                    continue
+                if (req["op"] == "allreduce"
+                        and int(req.get("ke", 0)) != self._knob_epoch
+                        and self._redo_wanted.get(name, -1) == -1):
+                    # Knob-epoch safe switch (ISSUE 16): this contribution
+                    # was formatted under a stale knob table — bounce it for
+                    # re-formatting instead of ingesting (mixing tables
+                    # within one collective would trip the wire-mismatch
+                    # validation, or worse, silently fold mixed precision).
+                    # RING-directive redos (real seq) are EXEMPT: every rank
+                    # re-ships its old-format bytes consistently, which is
+                    # exactly how an interrupted collective replays bitwise.
+                    # Recalled star pendings (sentinel seq -1) are NOT: a
+                    # late rank may first learn of the recall on this very
+                    # response, so the fresh re-reduce collects only
+                    # new-table contributions.
+                    reformat.append(name)
                     continue
                 entry = self._pending.setdefault(name, {})
                 self._first_seen.setdefault(name, time.monotonic())
@@ -2839,7 +3093,10 @@ class _Coordinator:
             # original enqueue age (reference CheckForStalledTensors,
             # operations.cc:1625-1672).
             out: dict[str, tuple[Optional[str], Any]] = {}
-            names = [r["name"] for r in all_reqs]
+            # Bounced (stale knob epoch) names re-submit next cycle — the
+            # grace loop must not stall waiting for contributions this very
+            # response is rejecting.
+            names = [r["name"] for r in all_reqs if r["name"] not in reformat]
             empty_deadline = time.monotonic() + 0.1
             grace: Optional[float] = None
             while True:
@@ -2887,6 +3144,14 @@ class _Coordinator:
                 # closes the redo without re-reducing anything.
                 resp["redo"] = [[nm, seq]
                                 for nm, seq in self._redo_wanted.items()]
+            if self._knob_epoch:
+                # Knob-table commit (ISSUE 16): the cumulative table rides
+                # every response once a knob changed; ranks apply it with
+                # one epoch compare. Absent in the steady state.
+                resp["knob"] = {"epoch": self._knob_epoch,
+                                "table": dict(self._knob_table)}
+            if reformat:
+                resp["reformat"] = reformat
             return resp
 
     def stall_candidates(self) -> list:
@@ -3079,6 +3344,11 @@ class _Client:
         # the redo names it wants this rank's retained ring results for.
         self.last_plane: dict = {}
         self.last_redo: list = []
+        # Knob-epoch signals (ISSUE 16): the committed knob table riding the
+        # latest response, and the names whose stale-epoch contributions the
+        # coordinator bounced for re-formatting.
+        self.last_knob: dict = {}
+        self.last_reformat: list = []
 
     def local_host(self) -> str:
         """Local address of the control connection — the interface that
@@ -3121,6 +3391,16 @@ class _Client:
                                   "reason": str(reason)}, self.key)
             _recv_msg(self.sock, self.key)
 
+    def knob_change(self, table: dict) -> int:
+        """Commit a value-affecting knob table to the coordinator (ISSUE
+        16): it bumps the knob epoch, demotes the plane for one safe-switch
+        cycle, and piggybacks the table on every rank's next exchange
+        response. Returns the committed epoch."""
+        with self._lock:
+            _send_msg(self.sock, {"kind": "knob_change", "rank": self.rank,
+                                  "table": dict(table)}, self.key)
+            return int(_recv_msg(self.sock, self.key).get("epoch", 0))
+
     def exchange(self, requests: list[dict], arrays: dict,
                  bits: int = 0, redo_results: Optional[dict] = None) -> dict:
         with self._lock:
@@ -3135,10 +3415,13 @@ class _Client:
                                resp.get("evict") or [])
             self.last_plane = resp.get("plane") or {}
             self.last_redo = resp.get("redo") or []
+            self.last_knob = resp.get("knob") or {}
+            self.last_reformat = resp.get("reformat") or []
             out = resp["results"]
         else:  # pragma: no cover - legacy shape
             self.last_cache = ([], [])
             self.last_plane, self.last_redo = {}, []
+            self.last_knob, self.last_reformat = {}, []
             out = resp
         # Unwrap per-rank results (reducescatter / alltoall)
         for name, (err, val) in list(out.items()):

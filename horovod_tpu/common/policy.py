@@ -167,14 +167,33 @@ class CompressionPolicy:
         }
 
 
-def compiled_tier_format(nbytes: int, dtype, tier: str) -> str:
+#: The dense format the compiled plane substitutes when the adaptive
+#: table answers 'topk' for a fused bucket. This substitution is BY
+#: DESIGN, not a gap (ISSUE 16 closes the ROADMAP open question): XLA
+#: collectives have static shapes, so a runtime-sparse frame is
+#: structurally unservable there — the nearest value-reducing format on
+#: the same tier is the bf16 cast, and `adaptive` promises "the policy's
+#: best SERVABLE format per tier", not "identical bytes to eager".
+#: `horovod_compiled_adaptive_fallback_total` keeps counting the
+#: substituting traces purely for observability.
+COMPILED_TOPK_SUBSTITUTE = "bf16"
+
+
+def compiled_tier_format(nbytes: int, dtype, tier: str,
+                         with_fallback: bool = False):
     """The compiled plane's per-bucket tier resolve (ISSUE 13 satellite):
     the SAME value-changing table the eager engines evaluate per tensor,
-    applied to one fused bucket on one fabric tier. Returns a format NAME
-    ('none'/'bf16'/'topk') — the caller substitutes the nearest servable
-    dense format for 'topk' (XLA collectives cannot ship runtime-sparse
-    frames) and counts that fallback. Evaluated at trace time only."""
-    return CompressionPolicy().decide(int(nbytes), dtype, tier)
+    applied to one fused bucket on one fabric tier, with the 'topk'
+    answer substituted by :data:`COMPILED_TOPK_SUBSTITUTE` — see its note
+    for why that substitution is the designed behaviour. Returns the
+    servable format NAME ('none'/'bf16'), or ``(format, substituted)``
+    when ``with_fallback`` so the caller can count substituting traces.
+    Evaluated at trace time only."""
+    fmt = CompressionPolicy().decide(int(nbytes), dtype, tier)
+    substituted = fmt == "topk"
+    if substituted:
+        fmt = COMPILED_TOPK_SUBSTITUTE
+    return (fmt, substituted) if with_fallback else fmt
 
 
 def resolve_format(compression: Optional[str], policy,

@@ -89,7 +89,7 @@ PY_REQUEST_KEY_FIELDS = ["name", "op", "dtype", "root", "shape", "average",
 # Python full-request dict keys (base + optional), the python half of the
 # native Request struct.
 PY_REQUEST_FIELDS = ["name", "op", "shape", "dtype", "root", "average"]
-PY_REQUEST_OPTIONAL_FIELDS = ["wire", "trace"]
+PY_REQUEST_OPTIONAL_FIELDS = ["wire", "trace", "ke"]
 
 SPEC_REL = os.path.join("docs", "protocol_spec.json")
 

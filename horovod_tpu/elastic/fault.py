@@ -27,7 +27,9 @@ transport-resilience ladder instead of killing processes:
 - ``HOROVOD_FAULT_NET={delay,reset,corrupt,drop}``: what to inject on a
   matching outbound frame. ``delay`` sleeps ``HOROVOD_FAULT_NET_DELAY_MS``
   (default 1000) before sending — absorbed by the receive retry budget
-  (rung 1). ``reset`` abort-closes the socket (RST to the peer) — a hard
+  (rung 1); ``HOROVOD_FAULT_NET_DELAY_PER_MB`` (default 0) adds a
+  bytes-proportional term (ms per MiB of payload) on top, modeling a
+  bandwidth-collapsed link instead of a latency spike. ``reset`` abort-closes the socket (RST to the peer) — a hard
   link fault, absorbed by plane demotion (rung 2). ``corrupt`` flips a MAC
   byte so the receiver rejects the frame (``horovod_frames_rejected_total``)
   and fails the link — also rung 2. ``drop`` swallows the frame: the
@@ -146,9 +148,19 @@ def net_fault(scope: str) -> str | None:
     return spec
 
 
-def net_fault_delay_s() -> float:
-    return float(os.environ.get("HOROVOD_FAULT_NET_DELAY_MS", "") or 1000.0) \
-        / 1000.0
+def net_fault_delay_s(nbytes: int = 0) -> float:
+    """Injected per-frame delay. ``HOROVOD_FAULT_NET_DELAY_MS`` is a flat
+    per-frame latency (default 1000). ``HOROVOD_FAULT_NET_DELAY_PER_MB``
+    adds a bytes-proportional component (ms per MiB of frame payload,
+    default 0) — that models a bandwidth-collapsed link rather than a
+    latency spike, which is the fault class where shrinking the wire
+    format (bf16/top-k) genuinely restores throughput. The controller
+    chaos leg (tools/controller_smoke.py) uses it so the canary's
+    commit-vs-rollback verdict reflects a real causal win, not luck."""
+    flat = float(os.environ.get("HOROVOD_FAULT_NET_DELAY_MS", "") or 1000.0)
+    per_mb = float(
+        os.environ.get("HOROVOD_FAULT_NET_DELAY_PER_MB", "") or 0.0)
+    return (flat + per_mb * (nbytes / float(1 << 20))) / 1000.0
 
 
 def reset_net_fault_state() -> None:

@@ -384,3 +384,85 @@ def tune(step_factory: Callable[..., Callable[[], None]],
             f.write(",".join(cols + ["steps_per_s"]) + "\n")
             f.write("\n".join(log_rows) + "\n")
     return TuneReport(best=table[0], table=table)
+
+
+class OnlineTuner:
+    """Warm-startable ONLINE face over the same GP/EI acquisition ``tune``
+    runs offline (ISSUE 16): the runtime controller feeds it live
+    (threshold, num_buckets) -> steps/s observations as canaries commit,
+    and asks for the next continuous-knob candidate without ever pausing
+    the job for an offline sweep.
+
+    ``seed`` warm-starts the model: a :class:`TuneReport` (offline run),
+    its ``table`` list, or a plain ``{(threshold, buckets): steps_per_s}``
+    dict. Observations from the live job overwrite seeded points at the
+    same coordinates — the running job is the ground truth, the offline
+    model just shapes the prior."""
+
+    def __init__(self, th_bounds: tuple = (
+            DEFAULT_THRESHOLDS[0], DEFAULT_THRESHOLDS[-1]),
+            nb_bounds: tuple = (1, 32),
+            seed=None) -> None:
+        self.th_bounds = (int(th_bounds[0]), int(th_bounds[1]))
+        self.nb_bounds = (int(nb_bounds[0]), int(nb_bounds[1]))
+        self.measured: dict[tuple[int, int], float] = {}
+        if seed is not None:
+            self.warm_start(seed)
+
+    def warm_start(self, seed) -> int:
+        """Fold an offline model in; returns the number of points loaded."""
+        table = getattr(seed, "table", seed)
+        n = 0
+        if isinstance(table, dict):
+            for key, rate in table.items():
+                th, nb = (key if isinstance(key, tuple) else (key, 1))
+                self.measured[(int(th), int(nb))] = float(rate)
+                n += 1
+            return n
+        for m in table:
+            self.measured[(int(m.fusion_threshold),
+                           int(m.num_buckets))] = float(m.steps_per_s)
+            n += 1
+        return n
+
+    def observe(self, threshold: int, num_buckets: int,
+                steps_per_s: float) -> None:
+        self.measured[(int(threshold), int(num_buckets))] = \
+            float(steps_per_s)
+
+    def best(self) -> Optional[tuple[int, int]]:
+        if not self.measured:
+            return None
+        return max(self.measured, key=self.measured.get)
+
+    def suggest(self) -> Optional[tuple[int, int]]:
+        """Next (threshold, num_buckets) to canary: argmax EI over the
+        joint space, falling back to the 1-D threshold acquisition when
+        the bucket dimension has no spread yet. A COLD model (too few
+        points for EI to rank anything — the whole reason a warm start
+        helps) bootstraps with a deterministic probe sequence: both
+        threshold extremes, then one bucketed mid-point, exactly the
+        spread the GP needs before the acquisition takes over. None =
+        nothing left worth a canary."""
+        if len(self.measured) < 3:
+            mid = int(round((self.th_bounds[0] * self.th_bounds[1]) ** 0.5))
+            for cand in ((self.th_bounds[1], self.nb_bounds[0]),
+                         (self.th_bounds[0], self.nb_bounds[0]),
+                         (mid, min(max(4, self.nb_bounds[0]),
+                                   self.nb_bounds[1]))):
+                if cand not in self.measured:
+                    return cand
+            return None
+        nbs = {nb for _, nb in self.measured}
+        if len(nbs) > 1:
+            nxt = _ei_suggest_joint(self.measured, self.th_bounds,
+                                    self.nb_bounds)
+            if nxt is not None and nxt not in self.measured:
+                return nxt
+            return None
+        nb = next(iter(nbs), 1)
+        flat = {th: v for (th, _), v in self.measured.items()}
+        th = _ei_suggest(flat, *self.th_bounds)
+        if th is None or (th, nb) in self.measured:
+            return None
+        return (int(th), int(nb))

@@ -84,6 +84,10 @@ class AnomalyDetector:
                                 os.environ.get("HOROVOD_ANOMALY_COOLDOWN_S",
                                                "") or 30.0)
         self.on_fire = on_fire
+        # Multi-subscriber fan-out (ISSUE 16): the runtime controller (and
+        # anything else) attaches with subscribe() without displacing the
+        # constructor's on_fire callback.
+        self._subscribers: list[Callable[[str, dict], None]] = []
         self._flight = flight
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -258,4 +262,23 @@ class AnomalyDetector:
                 self.on_fire(kind, detail)
             except Exception:
                 pass
+        with self._lock:
+            subs = list(self._subscribers)
+        for cb in subs:
+            try:
+                cb(kind, dict(detail))
+            except Exception:   # a broken subscriber must not mute others
+                pass
         return True
+
+    def subscribe(self, cb: Callable[[str, dict], None]) -> None:
+        """Attach a firing subscriber: ``cb(kind, detail)`` runs (after the
+        counter/flight capture and the constructor ``on_fire``) on every
+        firing. Exceptions are swallowed per subscriber."""
+        with self._lock:
+            self._subscribers.append(cb)
+
+    def unsubscribe(self, cb: Callable[[str, dict], None]) -> None:
+        with self._lock:
+            if cb in self._subscribers:
+                self._subscribers.remove(cb)

@@ -241,13 +241,11 @@ def wire_dtype_for_bucket(compression, dtype, nbytes: int, op,
 
 # One-shot warning latch: topk on the compiled plane runs dense (see the
 # resolution block in fused_allreduce); say so once, not per trace.
+# (The 'adaptive' analog stopped warning in ISSUE 16: the bf16
+# substitution moved into common/policy.py compiled_tier_format as the
+# DESIGNED tier answer — see COMPILED_TOPK_SUBSTITUTE — and a designed
+# behaviour is not warning material. The fallback counter remains.)
 _TOPK_COMPILED_WARNED = False
-# Same latch for 'adaptive': the compiled plane substitutes its dense
-# tier table (ici=none, dcn=bf16) for the eager policy's topk tier. The
-# warning fires once; the counter fires per substituting trace so the
-# fallback is visible in pod snapshots long after the log line scrolled
-# away (ROADMAP known-satellite; ISSUE 12).
-_ADAPTIVE_COMPILED_WARNED = False
 
 
 def fused_allreduce(
@@ -396,18 +394,19 @@ def fused_allreduce(
                 and not os.environ.get("HOROVOD_DCN_COMPRESSION", "")):
             # Adaptive DCN tier, per fused bucket (ISSUE 13 satellite): the
             # policy table answers with the same (size, dtype, tier) inputs
-            # the eager engines use. Only the genuinely unservable 'topk'
-            # answer counts a fallback (XLA collectives cannot ship
-            # runtime-sparse frames) and substitutes the bf16 cast.
+            # the eager engines use, with the topk answer already
+            # substituted by the designed servable format
+            # (policy.COMPILED_TOPK_SUBSTITUTE — XLA collectives cannot
+            # ship runtime-sparse frames). The counter tracks substituting
+            # traces for observability; no warning, this is the table.
             from ..common.policy import compiled_tier_format
 
             _fmts = []
             _fallbacks = 0
             for buf in buffers:
-                fmt = compiled_tier_format(int(buf.nbytes), buf.dtype, "dcn")
-                if fmt == "topk":
-                    _fallbacks += 1
-                    fmt = "bf16"
+                fmt, substituted = compiled_tier_format(
+                    int(buf.nbytes), buf.dtype, "dcn", with_fallback=True)
+                _fallbacks += 1 if substituted else 0
                 _fmts.append(fmt)
             dcn_wire = [wire_dtype_for_bucket(f, buf.dtype, int(buf.nbytes),
                                               op, compression_min_bytes)
@@ -419,21 +418,11 @@ def fused_allreduce(
                 _metrics_registry().counter(
                     "horovod_compiled_adaptive_fallback_total",
                     help="compiled-plane traces where an 'adaptive' DCN "
-                         "tier resolved to the unservable topk format and "
-                         "substituted the bf16 cast (XLA collectives "
-                         "cannot ship runtime-sparse frames)").inc()
-                global _ADAPTIVE_COMPILED_WARNED
-                if not _ADAPTIVE_COMPILED_WARNED:
-                    _ADAPTIVE_COMPILED_WARNED = True
-                    from ..utils.logging import log
-
-                    log("warning",
-                        "HOROVOD_COMPRESSION=adaptive: the policy table "
-                        "picked topk for a compiled DCN bucket; the "
-                        "compiled plane ships the bf16 cast instead — "
-                        "topk frames are eager-only "
-                        "(horovod_compiled_adaptive_fallback_total counts "
-                        "these traces)")
+                         "tier answered topk and shipped the designed "
+                         "substitute (common/policy.py "
+                         "COMPILED_TOPK_SUBSTITUTE) instead — by design, "
+                         "not an error: XLA collectives cannot ship "
+                         "runtime-sparse frames").inc()
         else:
             if dcn_compression is None:
                 dcn_compression = (

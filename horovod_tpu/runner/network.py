@@ -140,17 +140,18 @@ class Channel:
         h.update(payload)
         return h.digest()
 
-    def _inject_fault(self) -> Optional[str]:
+    def _inject_fault(self, nbytes: int = 0) -> Optional[str]:
         """Chaos hook (HOROVOD_FAULT_NET): decide and pre-apply this frame's
         injected fault. Returns "drop" when the frame must be swallowed
         (before the sequence number advances — the receiver then sees the
         NEXT frame early and fails the link, the broken-middlebox model);
         "corrupt" when the caller should flip a MAC byte; None otherwise.
-        "delay" sleeps here; "reset" abort-closes the socket (RST to the
-        peer) and raises."""
+        "delay" sleeps here (``nbytes`` feeds the bytes-proportional
+        HOROVOD_FAULT_NET_DELAY_PER_MB term); "reset" abort-closes the
+        socket (RST to the peer) and raises."""
         action = self._fault.net_fault(self.scope)
         if action == "delay":
-            time.sleep(self._fault.net_fault_delay_s())
+            time.sleep(self._fault.net_fault_delay_s(nbytes))
             return None
         if action == "reset":
             try:
@@ -170,7 +171,7 @@ class Channel:
         payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
         corrupt = False
         if self._fault is not None:
-            action = self._inject_fault()
+            action = self._inject_fault(len(payload))
             if action == "drop":
                 # The dropped frame still consumes a sequence number — the
                 # receiver authenticates the NEXT frame against the dropped
@@ -215,7 +216,7 @@ class Channel:
         view = memoryview(data).cast("B")
         corrupt = False
         if self._fault is not None:
-            action = self._inject_fault()
+            action = self._inject_fault(len(view))
             if action == "drop":
                 # Seq still advances — see send(): the swallowed frame must
                 # fail the receiver's HMAC check, not silently alias the

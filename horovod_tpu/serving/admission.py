@@ -59,6 +59,12 @@ class AdmissionController:
         with self._lock:
             return self._drain_rate
 
+    def set_slo_ms(self, slo_ms: float) -> None:
+        """Live SLO-budget retune (control/serving.py): the cached budget
+        is updated together with the config the report reads."""
+        self.cfg.slo_ms = float(slo_ms)
+        self.slo_s = float(slo_ms) / 1000.0
+
     # -- the admission decision ----------------------------------------------
 
     def projected_wait_s(self, queue_depth: int, replicas: int) -> float:
@@ -144,6 +150,11 @@ class KVAdmission:
     def release_rate(self) -> Optional[float]:
         with self._lock:
             return self._release_rate
+
+    def set_slo_ms(self, ttft_slo_ms: float) -> None:
+        """Live TTFT-budget retune (control/serving.py)."""
+        self.llm.ttft_slo_ms = float(ttft_slo_ms)
+        self.ttft_budget_s = float(ttft_slo_ms) / 1000.0
 
     def projected_wait_s(self, blocks_needed: int, free_blocks: int,
                          queued_blocks: int) -> float:

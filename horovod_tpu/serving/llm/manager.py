@@ -50,6 +50,10 @@ class PoolManager(ReplicaManager):
             raise ValueError(f"unknown pool role {role!r}")
         pool_cfg = dataclasses.replace(
             cfg, min_replicas=n_replicas, max_replicas=n_replicas)
+        # The router's LIVE config (pool_cfg above is a pinned copy):
+        # autoscale_cfg() splices its steering knobs back in when the
+        # runtime controller owns the serving plane.
+        self.shared_cfg = cfg
         super().__init__(pool_cfg, batcher=None, admission=None,
                          checkpoint=server.checkpoint,
                          builder=server.builder,
@@ -69,8 +73,38 @@ class PoolManager(ReplicaManager):
         return env
 
     def _queue_depth(self) -> int:
-        return self.server.prefill_q.depth() if self.role == "prefill" \
-            else self.server.handoff_q.depth()
+        if self.role == "prefill":
+            return self.server.prefill_q.depth()
+        if self.role == "decode":
+            # The greedy feed loop moves handed-off sequences straight
+            # into the replica's iteration scheduler, so the router-side
+            # handoff queue stays near-empty even when decode is the
+            # bottleneck — the real pending-decode demand sits INSIDE the
+            # replicas. Steer the autoscaler on both.
+            return self.server.decode_demand()
+        return self.server.handoff_q.depth()
+
+    def autoscale_cfg(self):
+        """Decode-pool scale-out under the runtime controller (ISSUE 16).
+
+        By default every LLM pool is pinned to its configured replica
+        count (min == max above) — the disaggregated topology is an
+        operator decision. When the serving controller owns the plane
+        (HOROVOD_CONTROLLER=1 started one on the router), the decode pool
+        gains the job-level ``max_replicas`` ceiling and reads
+        ``target_queue``/``cooldown_s`` LIVE from the router's shared
+        config, so a committed ``target_queue`` cut (the drain_collapse
+        mitigation) lowers the scale-out threshold on the next supervisor
+        tick — that is how an injected decode slowdown's goodput recovers
+        without human action (tools/controller_smoke.py proves it)."""
+        if self.role != "decode" or self.server.controller is None:
+            return self.cfg
+        shared = self.shared_cfg
+        return dataclasses.replace(
+            self.cfg,
+            max_replicas=max(self.cfg.max_replicas, shared.max_replicas),
+            target_queue=shared.target_queue,
+            cooldown_s=shared.cooldown_s)
 
     def _mark_dead(self, rep: _Replica, reason: str) -> None:
         if rep.state == "dead":
@@ -78,6 +112,10 @@ class PoolManager(ReplicaManager):
         super()._mark_dead(rep, reason)
         with self._lock:
             lost = list(self._inflight.pop(rep.rid, {}).values())
+        # Its sequences requeue below, so the dead replica's last stat
+        # mirror (active/waiting/blocks) must not keep counting as live
+        # demand in the gauges and the autoscaler's steering figure.
+        self.server.drop_replica_stats(rep.rid)
         if lost:
             self.server.retry_or_fail(lost)
 
@@ -132,11 +170,21 @@ class PoolManager(ReplicaManager):
     def _decode_worker(self, rep: _Replica) -> None:
         last_poll_t = time.monotonic()
         tracer = get_serve_tracer()
+        # Per-replica feed backpressure: a saturated replica's worker loop
+        # never idle-sleeps, so without a cap it would vacuum every
+        # handed-off sequence into its OWN scheduler and starve a newly
+        # scaled-out sibling (sequences cannot migrate once submitted).
+        # Feed each replica only to max_active plus a small prefetch
+        # buffer; the excess stays on the router queue where any idle
+        # replica can take it.
+        cap = self.server.llm.max_active + 2
         while not self._closed.is_set() and rep.state == "serving":
             in_hand = None
             try:
+                with self._lock:
+                    pending = len(self._inflight.get(rep.rid, {}))
                 fed = 0
-                while fed < _FEED_BATCH:
+                while fed < min(_FEED_BATCH, cap - pending):
                     item = self.server.take_decode_feed()
                     if item is None:
                         break

@@ -32,6 +32,7 @@ import time
 from typing import Optional, Tuple
 
 from ...metrics import registry as _registry
+from ...control.serving import maybe_start_serving_controller
 from ...metrics.anomaly import AnomalyDetector
 from ...tracing.serve import init_serve_tracer
 from ...utils.logging import log
@@ -83,6 +84,7 @@ class LLMServer:
         self._rep_sequences: dict[int, list] = {}
         self.tracer = None          # set by start() (tracing/serve.py)
         self.anomaly = None         # set by start() (metrics/anomaly.py)
+        self.controller = None      # set by start() (control/serving.py)
         # -- llm telemetry (docs/metrics_schema.json serving_llm_*) --------
         self._active_g = self.reg.gauge(
             "horovod_serve_llm_active_sequences",
@@ -140,6 +142,9 @@ class LLMServer:
         self.tracer = init_serve_tracer("serve-router")
         self.anomaly = AnomalyDetector.start_from_env(
             reg=self.reg, slo_s=self.llm.ttft_slo_ms / 1000.0)
+        self.controller = maybe_start_serving_controller(
+            self.cfg, admission=self.admission, anomaly=self.anomaly,
+            reg=self.reg)
         for pool in self.pools.values():
             pool.start()
         self._frontend = ServeFrontend(self)
@@ -169,6 +174,8 @@ class LLMServer:
         if self._frontend is not None:
             self._frontend.stop()
             self._frontend = None
+        if self.controller is not None:
+            self.controller.stop()
         if self.anomaly is not None:
             self.anomaly.stop()
         for q in (self.prefill_q, self.handoff_q):
@@ -404,6 +411,25 @@ class LLMServer:
         if agg["iterations_total"]:
             self._occupancy_g.set(
                 agg["occupancy_sum"] / agg["iterations_total"])
+
+    def drop_replica_stats(self, rep_key: int) -> None:
+        """A decode replica died: forget its last scheduler snapshot. Its
+        sequences are requeued through re-prefill, so leaving the mirror
+        in place would double-count them (gauges AND the autoscaler's
+        decode_demand would see phantom waiting/active sequences)."""
+        with self._stats_lock:
+            self._rep_stats.pop(rep_key, None)
+            self._rep_sequences.pop(rep_key, None)
+
+    def decode_demand(self) -> int:
+        """Pending decode work the pool autoscaler steers on: the router
+        handoff queue PLUS sequences queued inside decode replicas — the
+        greedy feed loop hides the backlog in the replica schedulers, so
+        the handoff queue alone under-reports a decode bottleneck."""
+        with self._stats_lock:
+            waiting = sum(s.get("waiting", 0)
+                          for s in self._rep_stats.values())
+        return self.handoff_q.depth() + int(waiting)
 
     def mirror_sequences(self, rep_key: int, sequences: list) -> None:
         """Latest per-sequence scheduler state from one decode replica —

@@ -273,7 +273,8 @@ class ReplicaManager:
         depth = self._queue_depth()
         if depth > 0:
             self._last_busy_t = now
-        decision = autoscale_decision(depth, self._desired, self.cfg, now,
+        decision = autoscale_decision(depth, self._desired,
+                                      self.autoscale_cfg(), now,
                                       self._last_scale_t, self._last_busy_t)
         if decision:
             self._desired += decision
@@ -371,6 +372,15 @@ class ReplicaManager:
     def _queue_depth(self) -> int:
         """The pending-work figure the autoscaler steers on."""
         return self.batcher.depth()
+
+    def autoscale_cfg(self):
+        """The config the scale decision reads. The base manager holds the
+        router's live ServeConfig, so a committed controller retune of
+        ``target_queue``/``max_replicas`` (control/serving.py) moves the
+        scale-out threshold on the next tick without a restart; pool
+        subclasses that pin a copied config override this to splice the
+        live steering knobs back in."""
+        return self.cfg
 
     # -- dispatch worker (one per live replica) ------------------------------
 

@@ -29,6 +29,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from ..metrics import registry as _registry
+from ..control.serving import maybe_start_serving_controller
 from ..metrics.anomaly import AnomalyDetector
 from ..tracing.serve import init_serve_tracer
 from ..utils.logging import log
@@ -59,6 +60,7 @@ class InferenceServer:
         self._started_t: Optional[float] = None
         self.tracer = None          # set by start() (tracing/serve.py)
         self.anomaly = None         # set by start() (metrics/anomaly.py)
+        self.controller = None      # set by start() (control/serving.py)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -67,6 +69,9 @@ class InferenceServer:
         self.tracer = init_serve_tracer("serve-router")
         self.anomaly = AnomalyDetector.start_from_env(
             reg=self.reg, slo_s=self.cfg.slo_ms / 1000.0)
+        self.controller = maybe_start_serving_controller(
+            self.cfg, admission=self.admission, anomaly=self.anomaly,
+            reg=self.reg)
         self.manager.start()
         self._frontend = ServeFrontend(self)
         self.port = self._frontend.port
@@ -97,6 +102,8 @@ class InferenceServer:
         if self._frontend is not None:
             self._frontend.stop()
             self._frontend = None
+        if self.controller is not None:
+            self.controller.stop()
         if self.anomaly is not None:
             self.anomaly.stop()
         self.batcher.close()

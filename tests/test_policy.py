@@ -113,3 +113,28 @@ def test_resolve_format_helper():
     assert resolve_format("adaptive", pol, MED, np.float32) == "bf16"
     # No policy wired (non-engine callers): adaptive degrades to none.
     assert resolve_format("adaptive", None, BIG, np.float32) == "none"
+
+
+def test_compiled_tier_format_substitutes_topk_by_design():
+    """ISSUE 16 satellite — the ROADMAP open question is retired: the
+    compiled plane's topk substitution is the DESIGNED table answer
+    (policy.COMPILED_TOPK_SUBSTITUTE), not a warned-about gap. This test
+    pins the substitution so a table change that silently re-opens it
+    fails loudly."""
+    from horovod_tpu.common.policy import (COMPILED_TOPK_SUBSTITUTE,
+                                           compiled_tier_format)
+
+    assert COMPILED_TOPK_SUBSTITUTE == "bf16"
+    # The eager table answers topk for a big f32 DCN bucket; the compiled
+    # resolve ships the designed substitute and reports the substitution.
+    assert CompressionPolicy().decide(BIG, np.float32, "dcn") == "topk"
+    assert compiled_tier_format(BIG, np.float32, "dcn") == \
+        COMPILED_TOPK_SUBSTITUTE
+    fmt, substituted = compiled_tier_format(BIG, np.float32, "dcn",
+                                            with_fallback=True)
+    assert fmt == COMPILED_TOPK_SUBSTITUTE and substituted is True
+    # Already-servable answers pass through with no substitution flagged.
+    assert compiled_tier_format(MED, np.float32, "dcn",
+                                with_fallback=True) == ("bf16", False)
+    assert compiled_tier_format(BIG, np.float32, "ici",
+                                with_fallback=True) == ("none", False)

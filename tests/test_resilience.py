@@ -419,6 +419,92 @@ def test_reprobe_held_while_a_rank_is_dead(coord):
         assert coord._reprobe_epoch == 0    # dead rank: stay on the star
 
 
+# ------------------------------------- knob-change epochs (ISSUE 16 units)
+
+def test_knob_change_bumps_epochs_and_recalls_pending(coord):
+    coord.ring_active = True
+    seq = _seed_directive(coord, "t", claimed={0, 1})
+    coord._pending["u"] = {0: ({"op": "allreduce"}, np.ones(2)),
+                           2: ({"op": "allreduce"}, np.ones(2))}
+    out = coord._handle_knob_change(0, {"compression": "fp16"})
+    assert out == {"ok": 1, "epoch": 1}
+    # the safe switch rides the plane-demotion epoch
+    assert coord.ring_active is False and coord._demote_epoch == 1
+    assert coord._repromote_at is not None
+    # undelivered ring directive: seq-tagged redo (bitwise replay); recalled
+    # star pending: fresh-only redo (sentinel -1 — a stale retained copy of
+    # a previous same-name execution must never answer it)
+    assert coord._redo_wanted == {"t": seq, "u": -1}
+    assert coord._redo_claim["u"] == set()
+    assert "u" not in coord._pending
+    # stale retained copies cannot close the sentinel redo
+    coord._handle_exchange(3, [], {}, redo_results={"u": (seq, np.ones(2))})
+    assert "u" not in coord._results
+
+
+def test_knob_change_without_ring_still_bumps_demote_epoch(coord):
+    assert coord._handle_knob_change(1, {"topk_ratio": 0.05})["epoch"] == 1
+    # ranks must run _redo_inflight (re-ship bytes for sent entries) even
+    # though there was no eager plane to demote
+    assert coord._demote_epoch == 1 and coord._repromote_at is None
+    # cumulative table: a second change merges, epoch advances
+    coord._handle_knob_change(1, {"compression": "bf16"})
+    assert coord._knob_epoch == 2
+    assert coord._knob_table == {"topk_ratio": 0.05, "compression": "bf16"}
+
+
+def test_exchange_response_carries_knob_table(coord):
+    out = coord._handle_exchange(0, [], {})
+    assert "knob" not in out and "reformat" not in out   # steady state
+    coord._handle_knob_change(2, {"compression": "fp16"})
+    out = coord._handle_exchange(0, [], {})
+    assert out["knob"] == {"epoch": 1, "table": {"compression": "fp16"}}
+
+
+def test_stale_knob_epoch_contribution_bounced_then_ingested(coord):
+    coord._handle_knob_change(0, {"compression": "fp16"})
+    req = {"name": "g", "op": "allreduce", "shape": (2,),
+           "dtype": "float32", "root": 0, "average": True}
+    # formatted under epoch 0 (no ke): bounced, never ingested
+    out = coord._handle_exchange(1, [dict(req)], {"g": np.ones(2)})
+    assert out["reformat"] == ["g"] and "g" not in out["results"]
+    assert "g" not in coord._pending
+    # re-formatted under the committed epoch: ingested normally
+    wire = dict(req, ke=1, wire="float16")
+    for r in range(4):
+        out = coord._handle_exchange(
+            r, [dict(wire)], {"g": np.ones(2, dtype=np.float16)})
+    err, val = out["results"]["g"]
+    assert err is None
+
+
+def test_ring_redo_exempt_from_knob_epoch_bounce(coord):
+    coord.ring_active = True
+    seq = _seed_directive(coord, "t", claimed=set())
+    coord._handle_knob_change(0, {"compression": "fp16"})
+    assert coord._redo_wanted["t"] == seq
+    # the recalled directive's replay re-ships OLD-format bytes (no ke):
+    # exempt from the bounce — this is the bitwise replay path
+    out = coord._handle_exchange(
+        0, [{"name": "t", "op": "allreduce", "shape": (2,),
+             "dtype": "float32", "root": 0, "average": True}],
+        {"t": np.ones(2, dtype=np.float32)})
+    assert "reformat" not in out
+    assert 0 in coord._pending["t"]
+
+
+def test_knob_change_flushes_response_cache(coord):
+    req = {"name": "c", "op": "allreduce", "shape": (2,),
+           "dtype": "float32", "root": 0, "average": True}
+    for r in range(4):
+        out = coord._handle_exchange(r, [dict(req)], {"c": np.ones(2)})
+    assert out["assign"], "negotiation was not cached"
+    bit = out["assign"][0][0]
+    coord._handle_knob_change(0, {"compression": "fp16"})
+    out = coord._handle_exchange(0, [], {})
+    assert bit in out["evict"], "stale wire-signature bit must be evicted"
+
+
 # ----------------------------------------------------------- e2e (4-proc)
 
 WORKER = r"""
@@ -461,6 +547,87 @@ try:
 finally:
     eng.shutdown()
 """
+
+
+KNOB_WORKER = r"""
+import hashlib, json, os, sys, time
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import PyEngine, HorovodInternalError
+from horovod_tpu.common.topology import Topology
+from horovod_tpu import metrics as hvd_metrics
+
+rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+steps = int(os.environ["T_STEPS"]); flip = int(os.environ["T_FLIP"])
+settle = int(os.environ["T_SETTLE"])
+eng = PyEngine(Topology(rank, world, 0, 1, rank, world),
+               Config(cycle_time_ms=1.0, stall_check_disable=True))
+errors = 0
+digest = hashlib.sha256()
+try:
+    for i in range(steps):
+        if i == flip and rank == 0:
+            # Live wire-dtype retune mid-run, with collectives in flight on
+            # the other ranks: the coordinator's knob epoch must land it
+            # atomically on the whole world.
+            eng.set_knobs({"compression": "fp16"})
+        for t in range(2):
+            try:
+                out = eng.run("allreduce",
+                              np.arange(128, dtype=np.float32) * (rank + 1)
+                              + i + t, f"g.{t}")
+                digest.update(out.tobytes())
+            except HorovodInternalError:
+                errors += 1
+        time.sleep(0.01)
+    for j in range(settle):
+        eng.run("allreduce", np.ones(4, dtype=np.float32), f"s.{j}")
+        time.sleep(0.05)
+    snap = hvd_metrics.registry().snapshot()
+    print(json.dumps({
+        "hash": digest.hexdigest(), "errors": errors,
+        "epoch": eng.knob_epoch(),
+        "knob_changes": snap["counters"].get(
+            "horovod_knob_changes_total", 0),
+        "fp16_saved": snap["counters"].get(
+            'horovod_wire_bytes_saved_total{method="fp16"}', 0),
+        "demotions": snap["counters"].get("horovod_plane_demotions_total", 0),
+        "repromotions": snap["counters"].get(
+            "horovod_plane_repromotions_total", 0),
+        "plane": snap["gauges"].get("horovod_plane_current", -1),
+    }), flush=True)
+finally:
+    eng.shutdown()
+"""
+
+
+@pytest.mark.slow
+def test_knob_flip_mid_run_stays_bitwise_consistent():
+    """ISSUE 16: flipping the wire dtype mid-run through the coordinator
+    knob epoch keeps all four ranks bitwise identical (interrupted
+    collectives replay under their old format; later steps quantize under
+    the new one), with zero internal errors, the demote/re-promote safe
+    switch exercised, and fp16 savings flowing after the flip."""
+    from launch_util import launch_world
+
+    ranks = launch_world(4, KNOB_WORKER, extra_env={
+        "HOROVOD_ENGINE": "python", "HOROVOD_RING_DATA_PLANE": "1",
+        "HOROVOD_NETWORK_TIMEOUT": "0.4", "HOROVOD_NETWORK_RETRIES": "3",
+        "T_STEPS": "14", "T_FLIP": "7", "T_SETTLE": "40",
+        "HOROVOD_PLANE_REPROMOTE_S": "30",
+        "HOROVOD_KNOB_REPROMOTE_S": "0.5"})
+    for r in ranks:
+        o = r["out"]
+        assert o["errors"] == 0, "knob switch escalated to an internal error"
+        assert o["epoch"] == 1, "knob epoch did not reach every rank"
+        assert o["knob_changes"] >= 1
+        assert o["fp16_saved"] > 0, "new wire format never used post-flip"
+        assert o["demotions"] >= 1, "safe switch did not demote the plane"
+        assert o["repromotions"] >= 1, "knob cooldown never re-promoted"
+        assert o["plane"] == 1, "world did not return to the ring plane"
+    assert len({r["out"]["hash"] for r in ranks}) == 1, \
+        "ranks diverged bitwise across the live knob switch"
 
 
 @pytest.mark.slow

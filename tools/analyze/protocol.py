@@ -60,6 +60,12 @@ REQUEST_FIELD_MAP = {
     "trace_seq": "trace",
     "shape": "shape",
 }
+PY_REQUEST_ONLY = {
+    "ke": "knob-epoch stamp (ISSUE 16 live retuning) — the python "
+          "coordinator rejects entries negotiated under a stale knob "
+          "table; the native engine's knob sync rides the autotuner "
+          "broadcast (knob_version) instead",
+}
 
 # wire.h TickRequest (per-tick rank->coordinator frame) <-> the python
 # exchange message envelope (_Client.exchange msg dict).
@@ -106,6 +112,14 @@ PY_RESPONSE_ONLY = {
     "__per_rank__": "per-rank result envelope (reducescatter / alltoall) "
                     "unwrapped client-side; native returns per-rank slices "
                     "from the ring directly",
+    "knob": "knob-epoch table broadcast (ISSUE 16 live retuning) — the "
+            "coordinator's atomic all-rank knob switch; the native "
+            "engine syncs knobs through the autotuner fields "
+            "(knob_version/fusion_threshold/...) above",
+    "reformat": "knob-epoch replay instruction (ISSUE 16): entries "
+                "caught mid-negotiation by a knob switch re-quantize "
+                "under the new table before the collective runs — "
+                "python resilience plane only",
 }
 
 # cache.h cache_key(Request) <-> response_cache.request_key(dict): the two
@@ -210,6 +224,7 @@ def extract(root: str) -> dict:
         },
         "parity": {
             "request_field_map": REQUEST_FIELD_MAP,
+            "python_request_only": PY_REQUEST_ONLY,
             "tick_field_map": TICK_FIELD_MAP,
             "python_tick_only": PY_TICK_ONLY,
             "response_field_map": RESPONSE_FIELD_MAP,
@@ -279,7 +294,7 @@ def check(root: str, spec: Optional[dict] = None) -> list[Finding]:
     req_wire = msgs.get("Request", {}).get("wire_order", [])
     py_req = py["request_fields"] + py["request_optional_fields"]
     _check_mapping(findings, "Request", req_wire, py_req,
-                   REQUEST_FIELD_MAP, {}, "Request")
+                   REQUEST_FIELD_MAP, PY_REQUEST_ONLY, "Request")
 
     # -- TickRequest <-> exchange envelope
     tick_wire = msgs.get("TickRequest", {}).get("wire_order", [])

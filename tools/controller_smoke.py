@@ -1,0 +1,476 @@
+#!/usr/bin/env python
+"""CI smoke for the ISSUE 16 runtime controller (wired into ci.sh).
+
+Three legs, each proving one line of the self-driving-performance
+contract end to end with REAL injected faults (never mocked sensors):
+
+1. **training / DCN degradation**: a 4-process Python-engine ring world
+   where rank 1 injects a bytes-proportional delay on its ring links
+   (``HOROVOD_FAULT_NET=delay`` + ``HOROVOD_FAULT_NET_DELAY_PER_MB`` —
+   a bandwidth-collapsed cross-host tier, the fault class where smaller
+   wire formats genuinely help). Rank 0 drives a
+   :class:`~horovod_tpu.control.training.TrainingController` attached to
+   its engine: the degradation rule must commit a sparser wire format
+   within ``N`` steps of fault onset (the tier goes sparse), the
+   recovery probe must walk the ladder back to full width after the
+   fault window closes, every mid-run switch lands through the
+   coordinator knob epoch (``horovod_knob_changes_total`` on EVERY
+   rank), results stay bitwise identical across ranks the whole run,
+   and the decisions are visible in the flight ring (the debug bundle's
+   source).
+
+2. **serving / decode slowdown**: a real disaggregated LLM server with
+   ``HOROVOD_CONTROLLER=1``. After a nominal warm-up the decode replica
+   is restarted under ``HOROVOD_FAULT_DECODE_DELAY_MS`` (every decode
+   iteration slowed) — goodput collapses, ``drain_collapse`` fires, the
+   controller canaries a ``target_queue`` cut, and the committed cut
+   lowers the decode pool's scale-out threshold (the pool reads the
+   shared config LIVE under the controller) so a second decode replica
+   spawns and tokens/s recovers — zero human action, zero failed
+   requests.
+
+3. **nominal silence**: a fresh controller-enabled server under clean
+   load — zero anomaly firings and zero controller proposals (a healthy
+   plane must not be churned).
+
+Prints one perf-gate JSON line (``controller_smoke_recovery_ratio``:
+recovered-window tokens/s over collapsed-window tokens/s in leg 2).
+Exits non-zero with a reason on any violation. Wall-clock ~45 s.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# -- leg 1: training / DCN degradation ---------------------------------------
+
+WORLD = 4
+STEPS = 70
+ELEMS = 65536                 # 256 KiB f32 per tensor
+PACE_S = 0.05                 # nominal inter-step pacing
+FAULT_STEP = 8                # fault onset, in steps
+FAULT_STEPS = 12              # fault window length, in ring-frame steps
+SPARSE_WITHIN = 20            # degradation commit deadline (steps from onset)
+# Outbound ring frames per step on one rank: (world-1) reduce-scatter +
+# (world-1) allgather sends for the single tensor.
+FRAMES_PER_STEP = 2 * (WORLD - 1)
+
+WORKER = r"""
+import hashlib, json, os, sys, time
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.engine import PyEngine, HorovodInternalError
+from horovod_tpu.common.topology import Topology
+from horovod_tpu import metrics as hvd_metrics
+
+rank = int(os.environ["HOROVOD_RANK"]); world = int(os.environ["HOROVOD_SIZE"])
+steps = int(os.environ["SMOKE_STEPS"]); n = int(os.environ["SMOKE_ELEMS"])
+pace = float(os.environ["SMOKE_PACE_S"])
+eng = PyEngine(Topology(rank, world, 0, 1, rank, world),
+               Config(cycle_time_ms=1.0, stall_check_disable=True))
+tc = None
+if rank == 0:
+    from horovod_tpu.control.training import TrainingController
+    tc = TrainingController(engine=eng, canary_steps=2, cooldown_s=0.0,
+                            tolerance=0.3)
+errors = 0
+digest = hashlib.sha256()
+sparse_commit_step = None
+recovery_commit_step = None
+seen = 0
+try:
+    last = time.monotonic()
+    for i in range(steps):
+        try:
+            out = eng.run("allreduce",
+                          np.arange(n, dtype=np.float32) * (rank + 1) + i,
+                          "grad.0")
+            digest.update(out.tobytes())
+        except HorovodInternalError:
+            errors += 1
+        time.sleep(pace)
+        now = time.monotonic(); dt = now - last; last = now
+        if tc is not None:
+            tc.on_step(1.0 / max(dt, 1e-9))
+            hist = tc.loop.history
+            for p in hist[seen:]:
+                if p["knob"] != "compression" or p["verdict"] != "commit":
+                    continue
+                if "degradation" in p["reason"] and sparse_commit_step is None:
+                    sparse_commit_step = i
+                if "recovery" in p["reason"]:
+                    recovery_commit_step = i
+            seen = len(hist)
+    snap = hvd_metrics.registry().snapshot()
+    c = snap["counters"]
+    rep = tc.report() if tc is not None else {}
+    flight_controller = 0
+    if tc is not None:
+        from horovod_tpu.tracing import flight as _flight
+        flight_controller = sum(
+            1 for r in _flight.get_flight().records()
+            if r.get("flight_event") in ("controller", "knob_apply"))
+    print(json.dumps({
+        "rank": rank,
+        "hash": digest.hexdigest(),
+        "errors": errors,
+        "knob_changes": c.get("horovod_knob_changes_total", 0),
+        "elastic_resets": c.get("horovod_elastic_resets_total", 0),
+        "sparse_commit_step": sparse_commit_step,
+        "recovery_commit_step": recovery_commit_step,
+        "compression": (rep.get("values") or {}).get("compression"),
+        "degraded": rep.get("degraded"),
+        "decisions": len(rep.get("decisions") or []),
+        "flight_controller": flight_controller,
+    }), flush=True)
+finally:
+    if tc is not None:
+        tc.close()
+    eng.shutdown()
+"""
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def fail(msg: str) -> None:
+    print(f"controller smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run_training_world() -> list[dict]:
+    port = free_port()
+    secret = secrets.token_hex(16)
+    procs = []
+    for rank in range(WORLD):
+        env = dict(os.environ)
+        env.update({
+            "HVD_REPO": REPO,
+            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(WORLD),
+            "HOROVOD_COORD_ADDR": f"127.0.0.1:{port}",
+            "HOROVOD_SECRET": secret,
+            "HOROVOD_ENGINE": "python",
+            "HOROVOD_RING_DATA_PLANE": "1",
+            # The injected delays are tens of ms: keep them far inside the
+            # receive deadline so the ONLY demotions are knob-epoch safe
+            # switches, never transport timeouts.
+            "HOROVOD_NETWORK_TIMEOUT": "5",
+            "HOROVOD_NETWORK_RETRIES": "3",
+            "HOROVOD_PLANE_REPROMOTE_S": "0",
+            "HOROVOD_KNOB_REPROMOTE_S": "0.05",
+            "SMOKE_STEPS": str(STEPS),
+            "SMOKE_ELEMS": str(ELEMS),
+            "SMOKE_PACE_S": str(PACE_S),
+            # Rank 1's ring links lose bandwidth, not just latency: the
+            # per-MiB term makes a narrower wire format a REAL mitigation,
+            # so the canary's commit is a causal win, not a coin flip.
+            "HOROVOD_FAULT_NET": "delay",
+            "HOROVOD_FAULT_NET_RANK": "1",
+            "HOROVOD_FAULT_NET_SCOPE": "ring",
+            "HOROVOD_FAULT_NET_AFTER": str(FAULT_STEP * FRAMES_PER_STEP),
+            "HOROVOD_FAULT_NET_COUNT": str(FAULT_STEPS * FRAMES_PER_STEP),
+            "HOROVOD_FAULT_NET_DELAY_MS": "2",
+            "HOROVOD_FAULT_NET_DELAY_PER_MB": "800",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=180)
+            if p.returncode != 0:
+                fail(f"training worker rc={p.returncode}:\n{stderr[-2000:]}")
+            outs.append(json.loads(stdout.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return outs
+
+
+def leg_training() -> None:
+    outs = run_training_world()
+    r0 = next(r for r in outs if r["rank"] == 0)
+    for r in outs:
+        if r["errors"]:
+            fail(f"training: rank {r['rank']} saw {r['errors']} "
+                 "HorovodInternalError(s)")
+        if r["elastic_resets"]:
+            fail(f"training: rank {r['rank']} counted "
+                 f"{r['elastic_resets']} elastic resets (want 0)")
+        if r["knob_changes"] < 2:
+            fail(f"training: rank {r['rank']} applied only "
+                 f"{r['knob_changes']} knob epochs — the mid-run switches "
+                 "did not land world-wide")
+    hashes = {r["hash"] for r in outs}
+    if len(hashes) != 1:
+        fail("training: results diverge bitwise across ranks under live "
+             f"retuning: { {r['rank']: r['hash'][:12] for r in outs} }")
+    if r0["sparse_commit_step"] is None:
+        fail(f"training: no degradation commit at all — report: {r0}")
+    if r0["sparse_commit_step"] - FAULT_STEP > SPARSE_WITHIN:
+        fail(f"training: tier went sparse at step "
+             f"{r0['sparse_commit_step']}, more than {SPARSE_WITHIN} steps "
+             f"after fault onset at {FAULT_STEP}")
+    if r0["recovery_commit_step"] is None or r0["compression"] != "none" \
+            or r0["degraded"]:
+        fail("training: never recovered full width after the fault "
+             f"cleared — report: {r0}")
+    if not r0["flight_controller"]:
+        fail("training: controller decisions absent from the flight ring "
+             "(debug bundles would not explain the retunes)")
+    print(f"controller smoke: training OK — sparse at step "
+          f"{r0['sparse_commit_step']} (fault at {FAULT_STEP}), recovered "
+          f"at step {r0['recovery_commit_step']}, {r0['decisions']} "
+          f"decisions, knob epochs on all ranks, bitwise identical")
+
+
+# -- legs 2/3: serving --------------------------------------------------------
+
+MAX_NEW = 16
+
+
+def post(port: int, payload: dict, timeout: float = 60.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class Load:
+    """Continuous background load; per-response completion timestamps let
+    the legs compute windowed goodput after the fact."""
+
+    def __init__(self, port: int, clients: int, vocab: int):
+        self.port = port
+        self.clients = clients
+        self.vocab = vocab
+        self.stop = threading.Event()
+        self.lock = threading.Lock()
+        self.done: list[tuple[float, int]] = []   # (t_done, decode tokens)
+        self.codes: dict[int, int] = {}
+        self.errors: list[str] = []
+        self.threads: list[threading.Thread] = []
+
+    def _loop(self, ci: int) -> None:
+        j = 0
+        while not self.stop.is_set():
+            j += 1
+            n = 1 + (ci * 3 + j) % 8
+            prompt = [(ci * 13 + j + k) % self.vocab for k in range(n)]
+            try:
+                code, body = post(self.port,
+                                  {"prompt": prompt, "max_tokens": MAX_NEW})
+                with self.lock:
+                    self.codes[code] = self.codes.get(code, 0) + 1
+                    if code == 200:
+                        self.done.append((time.monotonic(),
+                                          max(body["n_tokens"] - 1, 0)))
+            except urllib.error.HTTPError as e:
+                with self.lock:
+                    self.codes[e.code] = self.codes.get(e.code, 0) + 1
+                    if len(self.errors) < 5:
+                        self.errors.append(f"HTTP {e.code}")
+            except OSError as e:
+                with self.lock:
+                    self.codes[-1] = self.codes.get(-1, 0) + 1
+                    if len(self.errors) < 5:
+                        self.errors.append(repr(e))
+
+    def start(self) -> "Load":
+        self.threads = [threading.Thread(target=self._loop, args=(i,),
+                                         daemon=True)
+                        for i in range(self.clients)]
+        for t in self.threads:
+            t.start()
+        return self
+
+    def finish(self) -> None:
+        self.stop.set()
+        for t in self.threads:
+            t.join(timeout=90)
+
+    def tokens_per_s(self, t0: float, t1: float) -> float:
+        with self.lock:
+            tok = sum(n for t, n in self.done if t0 <= t < t1)
+        return tok / max(t1 - t0, 1e-9)
+
+
+def _clear_decode_fault_env() -> None:
+    for name in ("HOROVOD_FAULT_DECODE_DELAY_MS",
+                 "HOROVOD_FAULT_DECODE_DELAY_AFTER"):
+        if name in os.environ:
+            del os.environ[name]
+
+
+def _serving_env(extra: dict) -> None:
+    os.environ.update({
+        "HOROVOD_CONTROLLER": "1",
+        "HOROVOD_CONTROLLER_CANARY_STEPS": "2",
+        "HOROVOD_CONTROLLER_COOLDOWN_S": "0",
+        "HOROVOD_CONTROLLER_TICK_S": "0.4",
+        "HOROVOD_ANOMALY_INTERVAL_S": "0.25",
+        "HOROVOD_ANOMALY_COOLDOWN_S": "1",
+        "HOROVOD_SERVE_LLM_MAX_ACTIVE": "4",
+    })
+    os.environ.update(extra)
+
+
+def leg_serving() -> float:
+    from horovod_tpu.serving.config import LLMConfig, ServeConfig
+    from horovod_tpu.serving.llm import LLMServer
+
+    _serving_env({})
+    _clear_decode_fault_env()
+    # target_queue starts ABOVE the warm-phase decode demand (~= the
+    # client count): the pool must not scale out before the fault, so
+    # that the post-fault scale-out is causally the controller's cut.
+    cfg = ServeConfig.from_env(port=0, slo_ms=60000.0, max_retries=6,
+                               target_queue=16.0, max_replicas=2,
+                               cooldown_s=1.0)
+    llm_cfg = LLMConfig.from_env(colocated=0, prefill_replicas=1,
+                                 decode_replicas=1)
+    server = LLMServer(config=cfg, llm_config=llm_cfg).start()
+    load = None
+    try:
+        if not server.wait_ready(60):
+            fail("serving: pools never became ready")
+        if server.controller is None:
+            fail("serving: HOROVOD_CONTROLLER=1 did not start a "
+                 "controller on the router")
+        load = Load(server.port, clients=10, vocab=llm_cfg.vocab).start()
+        time.sleep(3.0)                   # warm the anomaly baselines
+
+        # Restart the decode replica under an injected per-iteration
+        # slowdown (the respawn inherits the fault env) — decode goodput
+        # collapses from one instant, attributable to the fault alone.
+        os.environ["HOROVOD_FAULT_DECODE_DELAY_MS"] = "40"
+        os.environ["HOROVOD_FAULT_DECODE_DELAY_AFTER"] = "0"
+        decode = server.pools["decode"]
+        pids = [v["pid"] for v in decode.describe()["replicas"].values()
+                if v["state"] == "serving"]
+        if len(pids) != 1:
+            fail(f"serving: expected 1 serving decode replica, got {pids}")
+        t_fault = time.monotonic()
+        os.kill(pids[0], signal.SIGKILL)
+        time.sleep(15.0)                  # collapse -> retune -> scale-out
+        _clear_decode_fault_env()
+        load.finish()
+
+        bad = {c: n for c, n in load.codes.items() if c != 200}
+        if bad:
+            fail(f"serving: non-200 responses under the fault {bad}; "
+                 f"first errors: {load.errors}")
+        kinds = {ev["kind"] for ev in server.anomaly.history} \
+            if server.anomaly else set()
+        if "drain_collapse" not in kinds:
+            fail(f"serving: drain_collapse never fired (fired: {kinds})")
+        commits = [p for p in server.controller.loop.history
+                   if p["verdict"] == "commit"]
+        if not any(p["knob"] == "target_queue" for p in commits):
+            fail("serving: no committed target_queue cut — history: "
+                 f"{server.controller.loop.history}")
+        live = [v for v in decode.describe()["replicas"].values()
+                if v["state"] in ("starting", "serving")]
+        if len(live) < 2:
+            fail(f"serving: decode pool never scaled out "
+                 f"(replicas: {decode.describe()})")
+        # Collapsed window: outage + the single slow respawn (the scale-up
+        # replica cannot be serving before ~+3.5s: the cut commits ~+2s
+        # and spawn-to-ready takes seconds). Recovered window: both slow
+        # replicas serving. One slow replica caps at max_active/delay
+        # ~= 100 tok/s, so the absolute floor below can ONLY be cleared
+        # by the scaled-out second replica.
+        collapsed = load.tokens_per_s(t_fault + 1.0, t_fault + 4.0)
+        recovered = load.tokens_per_s(t_fault + 10.0, t_fault + 14.0)
+        ratio = recovered / max(collapsed, 1.0)
+        if ratio < 1.3 or recovered < 140.0:
+            fail(f"serving: goodput did not recover — collapsed "
+                 f"{collapsed:.1f} tok/s, late window {recovered:.1f} "
+                 f"tok/s (need ratio >= 1.3, got {ratio:.2f}, and "
+                 f">= 140 tok/s absolute)")
+        print(f"controller smoke: serving OK — collapsed "
+              f"{collapsed:.0f} tok/s -> recovered {recovered:.0f} tok/s "
+              f"(x{ratio:.2f}), {len(commits)} commit(s), decode pool at "
+              f"{len(live)} replicas, zero failed requests")
+        return ratio
+    finally:
+        if load is not None:
+            load.stop.set()
+        server.stop()
+        _clear_decode_fault_env()
+
+
+def leg_nominal() -> None:
+    from horovod_tpu.serving.config import LLMConfig, ServeConfig
+    from horovod_tpu.serving.llm import LLMServer
+
+    _serving_env({})
+    cfg = ServeConfig.from_env(port=0, slo_ms=60000.0, max_retries=4,
+                               target_queue=8.0, max_replicas=2,
+                               cooldown_s=1.0)
+    llm_cfg = LLMConfig.from_env(colocated=0, prefill_replicas=1,
+                                 decode_replicas=1)
+    server = LLMServer(config=cfg, llm_config=llm_cfg).start()
+    load = None
+    try:
+        if not server.wait_ready(60):
+            fail("nominal: pools never became ready")
+        load = Load(server.port, clients=6, vocab=llm_cfg.vocab).start()
+        time.sleep(4.0)
+        load.finish()
+        if not load.codes.get(200):
+            fail(f"nominal: no 200s: {load.codes} {load.errors}")
+        # This server's OWN detector history (the process-global anomaly
+        # counters still carry leg 2's firings).
+        fired = [ev["kind"] for ev in server.anomaly.history] \
+            if server.anomaly else []
+        if fired:
+            fail(f"nominal: anomaly fired under clean load with the "
+                 f"controller on: {fired}")
+        if server.controller.loop.history:
+            fail("nominal: the controller churned a healthy plane: "
+                 f"{server.controller.loop.history}")
+        print(f"controller smoke: nominal OK — "
+              f"{load.codes.get(200)} x 200, zero firings, zero proposals")
+    finally:
+        if load is not None:
+            load.stop.set()
+        server.stop()
+
+
+def main() -> int:
+    leg_training()
+    ratio = leg_serving()
+    leg_nominal()
+    print(json.dumps({"metric": "controller_smoke_recovery_ratio",
+                      "value": round(ratio, 4), "unit": "x",
+                      "smoke": True}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
