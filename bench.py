@@ -1657,6 +1657,211 @@ def serve_llm_bench_main() -> None:
     budget.emit(out)
 
 
+def _synth_hist(count: int, rank: int) -> dict:
+    """Histogram snapshot in registry.to_dict shape (cumulative buckets)."""
+    bounds = [1e-4 * (4.0 ** k) for k in range(11)]
+    step = max(count // len(bounds), 1)
+    cum = 0
+    buckets = []
+    for b in bounds:
+        cum = min(cum + step, count)
+        buckets.append([b, cum])
+    buckets.append(["+Inf", count])
+    return {"count": count, "sum": count * 0.01 + rank * 1e-4,
+            "p50": 0.01, "p90": 0.02, "p99": 0.04, "buckets": buckets}
+
+
+def _synth_snapshot(rank: int, tick: int) -> dict:
+    """A realistic per-rank metrics snapshot: ~70 series of which only a
+    handful CHANGE per collection tick (step counters, one latency
+    histogram) — the regime the telemetry tree's delta compression exists
+    for. Deterministic in (rank, tick), so both bench arms ship byte-
+    identical information."""
+    counters = {f'horovod_allreduce_ops_total{{bucket="{i}"}}':
+                float(1000 + i) for i in range(40)}
+    counters["horovod_steps_total"] = float(tick)
+    counters["horovod_allreduce_bytes_total"] = tick * 1.5e6 + rank
+    gauges = {f'horovod_fusion_buffer_bytes{{plane="{i}"}}':
+              float((1 << 20) + i) for i in range(20)}
+    gauges["horovod_step_time_s"] = 0.1 + 0.001 * ((rank + tick) % 7)
+    hists = {f'horovod_allreduce_seconds{{op="{h}"}}':
+             _synth_hist(100 * (tick if h == 0 else 1) + rank + h, rank)
+             for h in range(6)}
+    return {"schema": "horovod_tpu.metrics.v1",
+            "time_unix_s": 1.7e9 + tick,
+            "counters": counters, "gauges": gauges, "histograms": hists,
+            "info": {"device": f"tpu:{rank}"}}
+
+
+def _telemetry_scale_once(world: int, hosts: int, ticks: int) -> dict:
+    """One grid size of the --telemetry-scale A/B.
+
+    FLAT arm: ``world`` clients each push a FULL snapshot to the driver
+    every tick (the pre-tree ``metrics`` path, TaskAgent.report_metrics).
+    TREE arm: ranks push DELTAS to their host's TelemetryAgent; each
+    leader pushes ONE delta-compressed host partial to the driver
+    (``host_metrics``). Both arms are measured on the same real
+    HMAC-framed wire (BasicService.stats bytes_in), and both pod views
+    must come out bitwise identical — the reduction only counts if
+    nothing was lost."""
+    import secrets
+    import shutil
+    import tempfile
+
+    from horovod_tpu.metrics.aggregate import merge_snapshots
+    from horovod_tpu.runner.network import BasicClient
+    from horovod_tpu.runner.service import DriverService
+    from horovod_tpu.telemetry.agent import (RankTelemetryClient,
+                                             TelemetryAgent)
+    from horovod_tpu.tracing.bundle import make_bundle
+    from horovod_tpu.tracing.flight import FlightRecorder
+
+    key = secrets.token_bytes(32)
+    per_host = world // hosts
+    snaps = {}   # rank -> latest snapshot (the expected flat merge input)
+
+    def settle(svc):
+        # stats are flushed server-side right after each response is sent;
+        # one drained tick later they are exact.
+        deadline = time.monotonic() + 2.0
+        last = -1
+        while time.monotonic() < deadline:
+            cur = svc.stats()["requests_total"]
+            if cur == last:
+                break
+            last = cur
+            time.sleep(0.02)
+        return svc.stats()
+
+    # -- flat arm ------------------------------------------------------------
+    root = DriverService(world, key)
+    clients = [BasicClient([("127.0.0.1", root.port)], key, timeout=30.0)
+               for _ in range(world)]
+    settle(root)
+    base = root.stats()["bytes_in"]
+    steady0 = None
+    for t in range(1, ticks + 1):
+        if t == 2:
+            steady0 = settle(root)["bytes_in"]
+        for r, c in enumerate(clients):
+            snaps[r] = _synth_snapshot(r, t)
+            c.request({"kind": "metrics", "rank": r, "snapshot": snaps[r]})
+    st = settle(root)
+    flat_bytes_per_tick = (st["bytes_in"] - steady0) / (ticks - 1)
+    flat_conns = st["connections_total"]
+    flat_pod = root.pod_metrics()
+    for c in clients:
+        c.close()
+    root.stop()
+
+    # -- tree arm ------------------------------------------------------------
+    tmp = tempfile.mkdtemp(prefix="hvd-telemetry-scale-")
+    root = DriverService(world, key)
+    agents, rank_clients = [], []
+    try:
+        for h in range(hosts):
+            fdir = os.path.join(tmp, f"host-{h:02d}")
+            os.makedirs(fdir, exist_ok=True)
+            fr = FlightRecorder(f"rank{h * per_host}", flight_dir=fdir)
+            fr.event("bench", note="telemetry-scale synthetic record")
+            fr.close()
+            ag = TelemetryAgent(
+                key, host_name=f"host-{h:02d}", flight_dir=fdir,
+                trace_dir="", interval_s=3600.0,
+                expected_ranks=range(h * per_host, (h + 1) * per_host))
+            ag.attach_root([("127.0.0.1", root.port)], probe_rounds=2,
+                           start_loop=False)
+            agents.append(ag)
+            for r in range(h * per_host, (h + 1) * per_host):
+                rank_clients.append(RankTelemetryClient(
+                    [("127.0.0.1", ag.port)], key, r))
+        settle(root)
+        steady0 = None
+        for t in range(1, ticks + 1):
+            if t == 2:
+                steady0 = settle(root)["bytes_in"]
+            for rc in rank_clients:
+                rc.push(_synth_snapshot(rc.rank, t))
+            for ag in agents:
+                ag.push_to_root_once()
+        st = settle(root)
+        tree_bytes_per_tick = (st["bytes_in"] - steady0) / (ticks - 1)
+        tree_conns = st["connections_total"]
+        tree_pod = root.pod_metrics()
+        leader_bytes = sum(settle(ag)["bytes_in"] for ag in agents)
+
+        # one-command bundle THROUGH the leaders: wall-clock + coverage
+        t0 = time.monotonic()
+        bundle = make_bundle(
+            os.path.join(tmp, "bundle"),
+            leaders=[f"127.0.0.1:{ag.port}" for ag in agents],
+            leader_key=key)
+        bundle_s = time.monotonic() - t0
+    finally:
+        for rc in rank_clients:
+            rc.close()
+        for ag in agents:
+            ag.stop()
+        root.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    pods_equal = flat_pod == tree_pod
+    expected = merge_snapshots([snaps[r] for r in range(world)])
+    expected.pop("time_unix_s", None)
+    for pod in (flat_pod, tree_pod):
+        pod.pop("time_unix_s", None)
+    return {
+        "world": world, "hosts": hosts, "ticks": ticks,
+        "flat_root_bytes_per_tick": round(flat_bytes_per_tick),
+        "tree_root_bytes_per_tick": round(tree_bytes_per_tick),
+        "root_byte_reduction": round(
+            flat_bytes_per_tick / max(tree_bytes_per_tick, 1.0), 2),
+        "flat_root_connections": flat_conns,
+        "tree_root_connections": tree_conns,
+        "leader_ingest_bytes_total": leader_bytes,
+        "pod_views_bitwise_equal": bool(pods_equal),
+        "tree_pod_equals_flat_merge": bool(tree_pod == expected),
+        "bundle_wall_clock_s": round(bundle_s, 3),
+        "bundle_hosts_swept": bundle["hosts_swept"],
+        "bundle_coverage_gaps": bundle["coverage_gaps"],
+    }
+
+
+def telemetry_scale_main() -> None:
+    """bench.py --telemetry-scale: measure the telemetry tree's root-side
+    cost against the flat O(world) fan-in, at world 64 (8 hosts x 8
+    ranks) and 128 (16 x 8). Headline: root ingest bytes per collection
+    tick, flat / tree — gated in ci.sh at >= 6x (measured ~>= 8x).
+    Correctness rides along: both arms' pod views must be bitwise equal.
+    Pure control-plane loopback TCP; runs before any jax import."""
+    budget = _Budget.install("telemetry_scale_root_byte_reduction", "x")
+    ticks = int(os.environ.get("HVD_TELEMETRY_TICKS", "") or
+                ("4" if _smoke_on() else "6"))
+    grids = [(64, 8)]
+    if not _smoke_on():
+        grids.append((128, 16))
+    out = {"metric": "telemetry_scale_root_byte_reduction", "value": 0.0,
+           "unit": "x", "smoke": _smoke_on(), "grids": []}
+    try:
+        for world, hosts in grids:
+            if budget.skip_if_low(f"grid-{world}", 45):
+                break
+            budget.stage(f"grid-{world}")
+            out["grids"].append(_telemetry_scale_once(world, hosts, ticks))
+    except Exception as e:  # noqa: BLE001 - partial beats silent (contract)
+        out.update({"partial": True, "reason": f"{type(e).__name__}: {e}"})
+        budget.emit(out)
+        return
+    g64 = next((g for g in out["grids"] if g["world"] == 64), None)
+    if g64 is not None:
+        out["value"] = g64["root_byte_reduction"]
+        out["bundle_wall_clock_s"] = g64["bundle_wall_clock_s"]
+        out["pod_views_bitwise_equal"] = all(
+            g["pod_views_bitwise_equal"] and g["tree_pod_equals_flat_merge"]
+            for g in out["grids"])
+    budget.emit(out)
+
+
 def main() -> None:
     if "--eager-worker" in sys.argv:
         return eager_worker_main()
@@ -1666,6 +1871,8 @@ def main() -> None:
         return compression_ab_main()
     if "--hier-ab" in sys.argv:
         return hier_ab_main()
+    if "--telemetry-scale" in sys.argv:
+        return telemetry_scale_main()
 
     # Arm the watchdog BEFORE the first jax import: on a degraded platform
     # backend init itself can wedge (the BENCH_r05 signature), and the

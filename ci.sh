@@ -122,6 +122,21 @@ python tools/perf_gate.py --current /tmp/hvd_llm_smoke.log \
 echo "== obs smoke (ISSUE 15 observability: injected decode slowdown fires the ttft_slo anomaly + flight dump; SIGKILL'd decode replica's mmap flight ring survives; one-command bundle names the dead replica, merges a strict mixed-plane trace, and a /v1/generate request is followable admit->queue->prefill->handoff->decode->retire with TTFT decomposed by phase) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
+echo "== pod obs smoke (ISSUE 17 telemetry tree: 8-host x 8-rank grid through per-host leaders — O(hosts) root connections, host-then-root merge bitwise == flat, composed rank->leader->root clock offsets, one rank SIGKILL'd mid-run: one-command bundle through the leaders names the dead rank's host coverage gap and an unreachable leader, the dead ring decode is in the bundle, silent host fires telemetry_lag naming it) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/pod_obs_smoke.py | tee /tmp/hvd_pod_obs_smoke.log
+python tools/perf_gate.py --current /tmp/hvd_pod_obs_smoke.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric pod_obs_root_byte_reduction \
+  --min-abs pod_obs_root_byte_reduction=6 --allow-missing-baseline
+
+echo "== telemetry-scale bench + gate (ISSUE 17: root ingest bytes per collection tick at world 64, flat fan-in vs tree — the reduction metric must exist and clear the 6x floor, with both arms' pod views bitwise equal) =="
+HVD_BENCH_SMOKE=1 timeout -k 10 240 env JAX_PLATFORMS=cpu \
+  python bench.py --telemetry-scale | tee /tmp/hvd_telemetry_scale.log
+python tools/perf_gate.py --current /tmp/hvd_telemetry_scale.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric telemetry_scale_root_byte_reduction \
+  --min-abs telemetry_scale_root_byte_reduction=6 --allow-missing-baseline
+
 echo "== controller smoke (ISSUE 16 self-driving performance: 4-proc DCN bandwidth-collapse goes sparse via a canaried knob epoch within 20 steps and recovers full width bitwise-identically; decode-slowdown collapse fires drain_collapse, the committed target_queue cut scales the decode pool out and goodput recovers with zero failed requests; a healthy plane sees zero firings and zero proposals) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/controller_smoke.py | tee /tmp/hvd_controller_smoke.log
 python tools/perf_gate.py --current /tmp/hvd_controller_smoke.log \

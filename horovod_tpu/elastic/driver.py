@@ -463,6 +463,12 @@ def _emit_elastic_pod_metrics(driver, events: ElasticEventLog,
             "blacklisted": blacklist.blacklisted(),
             "events": [e["event"] for e in events.events],
         }
+        # Telemetry-tree coverage, when host leaders were pushing through
+        # the tree: per-host snapshot age + expected ranks, so the final
+        # snapshot records which hosts were still reporting at the end.
+        tele = getattr(driver, "_telemetry", None)
+        if tele is not None:
+            pod["info"]["elastic"]["telemetry"] = tele.staleness()
         if path:
             with open(path, "w") as f:
                 json.dump(pod, f, indent=2)

@@ -20,7 +20,12 @@ consume):
 - ``demotion_storm`` — eager plane demotions summed over the trailing
   window at/above ``DEMOTION_STORM``;
 - ``wire_drift``    — wire bytes per tick drifting past ``factor x`` the
-  established baseline (a compression/policy regression showing up live).
+  established baseline (a compression/policy regression showing up live);
+- ``telemetry_lag`` — a host's telemetry snapshot at the tree root is older
+  than ``TELEMETRY_LAG_TICKS`` collection intervals (the telemetry tree's
+  ``horovod_telemetry_snapshot_age_ticks{host}`` gauge): the pod view is
+  STALE for the named hosts, so the controller and humans must stop
+  trusting those numbers instead of acting on them.
 
 Every firing increments ``horovod_anomaly_total{kind=...}``, drops a
 structured event into the process flight ring and trips a flight dump —
@@ -32,6 +37,7 @@ limited by ``HOROVOD_ANOMALY_COOLDOWN_S``.
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 from typing import Callable, Optional
@@ -47,6 +53,7 @@ PREEMPT_STORM = 10        # preemptions per tick that count as a storm
 DEMOTION_STORM = 3        # demotions over the trailing window
 DEMOTION_WINDOW = 20      # ticks in that trailing window
 MIN_DRAIN_BASELINE = 4.0  # tokens/requests per tick a collapse needs
+TELEMETRY_LAG_TICKS = 3   # host snapshot age (collection intervals) = stale
 
 _EWMA_ALPHA = 0.2
 
@@ -61,9 +68,21 @@ def _series_sum(table: dict, name: str) -> float:
     return total
 
 
+def _series_items(table: dict, name: str):
+    """Yield ``(series_key, value)`` for every label combination of
+    ``name`` — rules that must NAME the offending label (which host is
+    stale) need the per-series values, not the sum."""
+    for key, v in table.items():
+        if key == name or key.startswith(name + "{"):
+            yield key, float(v)
+
+
+_HOST_LABEL_RE = re.compile(r'host="([^"]*)"')
+
+
 class AnomalyDetector:
     KINDS = ("ttft_slo", "drain_collapse", "shed_spike", "preempt_storm",
-             "demotion_storm", "wire_drift")
+             "demotion_storm", "wire_drift", "telemetry_lag")
 
     def __init__(self, reg: Optional[MetricsRegistry] = None,
                  slo_s: Optional[float] = None,
@@ -233,6 +252,25 @@ class AnomalyDetector:
                               {"per_tick": wire,
                                "baseline": round(wire_base, 1)}):
                     fired.append("wire_drift")
+
+        # telemetry_lag — a stale host partial at the telemetry-tree root.
+        # The root publishes per-host snapshot ages (in collection ticks);
+        # any host past the threshold means the POD VIEW is stale for that
+        # host, which must be surfaced, not silently averaged over.
+        stale: list[str] = []
+        max_age = 0.0
+        for key, age in _series_items(
+                gauges, "horovod_telemetry_snapshot_age_ticks"):
+            if age > TELEMETRY_LAG_TICKS:
+                m = _HOST_LABEL_RE.search(key)
+                stale.append(m.group(1) if m else key)
+                max_age = max(max_age, age)
+        if stale:
+            if self._fire("telemetry_lag", now,
+                          {"hosts": sorted(stale),
+                           "max_age_ticks": round(max_age, 1),
+                           "threshold_ticks": TELEMETRY_LAG_TICKS}):
+                fired.append("telemetry_lag")
         return fired
 
     # -- firing --------------------------------------------------------------

@@ -218,6 +218,20 @@ class MetricsRegistry:
         return self._get("histogram", Histogram, name, help, labels,
                          buckets=buckets)
 
+    def remove(self, name: str, **labels) -> bool:
+        """Drop one series (any kind) from the registry; True when it
+        existed. Label-keyed series whose subject can LEAVE — the telemetry
+        tree's per-host staleness gauges when an elastic reset removes the
+        host — must be removable, or the orphaned series keeps aging and
+        alarms on a host that is legitimately gone."""
+        lk = _label_key(labels)
+        with self._lock:
+            removed = False
+            for kind in ("counter", "gauge", "histogram"):
+                removed |= self._metrics.pop((kind, name, lk),
+                                             None) is not None
+            return removed
+
     def set_info(self, name: str, value) -> None:
         """Attach a non-numeric annotation (e.g. the latest stall report) to
         snapshots. Not a Prometheus series; JSON-only."""

@@ -60,6 +60,13 @@ class HostAgent(BasicService):
       mid-run without re-keying the world.
     - ``poll`` ``{job_id}`` → ``{ok, workers: [{index, pid, returncode}]}``.
     - ``kill`` ``{job_id}`` → ``{ok}`` — terminate the job's worker trees.
+    - ``telemetry`` ``{cmd: start|stop, job_id, flight_dir?, trace_dir?,
+      interval_s?, expected_ranks?}`` → ``{ok, port, host}`` — host a
+      telemetry-tree agent (telemetry/agent.py) for the job, keyed with the
+      same derived job secret the workers hold, so the job's ranks can push
+      metric deltas and probe the host clock without extra key exchange.
+      The telemetry agent's lifetime is the job's: ``kill`` and driver
+      disconnect stop it with the workers.
     """
 
     def __init__(self, key: bytes, host: str = "0.0.0.0", port: int = 0) -> None:
@@ -67,6 +74,8 @@ class HostAgent(BasicService):
         self._jobs_lock = threading.Lock()
         # job_id -> {"procs": {index: Popen}, "owner": client_addr}
         self._jobs: dict[str, dict] = {}
+        # job_id -> TelemetryAgent (hosted for that job's ranks)
+        self._telemetry: dict[str, Any] = {}
         self._spawned_total = 0
         self._exited_nonzero_total = 0
         self._exit_counted: set[int] = set()  # pids already tallied
@@ -106,7 +115,51 @@ class HostAgent(BasicService):
         if kind == "kill":
             self._kill_job(req["job_id"])
             return {"ok": True}
+        if kind == "telemetry":
+            return self._telemetry_cmd(req, client_addr)
         return {"ok": False, "error": f"unknown request {kind}"}
+
+    def _telemetry_cmd(self, req: Any, client_addr) -> Any:
+        job_id = str(req.get("job_id", ""))
+        cmd = req.get("cmd", "start")
+        if cmd == "stop":
+            self._stop_telemetry(job_id)
+            return {"ok": True}
+        if cmd != "start":
+            return {"ok": False, "error": f"unknown telemetry cmd {cmd!r}"}
+        with self._jobs_lock:
+            ta = self._telemetry.get(job_id)
+            if ta is not None:   # idempotent: re-start returns the live one
+                return {"ok": True, "port": ta.port, "host": ta.host_name}
+        from ..telemetry.agent import TelemetryAgent
+
+        job_secret = derive_key(self.key, b"hvd-job:" + job_id.encode())
+        try:
+            ta = TelemetryAgent(
+                job_secret,
+                flight_dir=req.get("flight_dir") or None,
+                trace_dir=req.get("trace_dir") or None,
+                interval_s=req.get("interval_s"),
+                expected_ranks=req.get("expected_ranks"))
+        except Exception as e:
+            return {"ok": False,
+                    "error": f"telemetry agent failed on {host_hash()}: {e}"}
+        with self._jobs_lock:
+            live = self._telemetry.get(job_id)
+            if live is not None:   # lost the race; keep the first
+                ta.stop()
+                return {"ok": True, "port": live.port, "host": live.host_name}
+            self._telemetry[job_id] = ta
+        return {"ok": True, "port": ta.port, "host": ta.host_name}
+
+    def _stop_telemetry(self, job_id: str) -> None:
+        with self._jobs_lock:
+            ta = self._telemetry.pop(job_id, None)
+        if ta is not None:
+            try:
+                ta.stop()
+            except Exception:
+                pass
 
     def _spawn(self, req: Any, client_addr) -> Any:
         job_id = req["job_id"]
@@ -159,6 +212,7 @@ class HostAgent(BasicService):
     def _kill_job(self, job_id: str) -> None:
         with self._jobs_lock:
             job = self._jobs.pop(job_id, None)
+        self._stop_telemetry(job_id)
         if job is not None:
             terminate_trees(list(job["procs"].values()))
 
@@ -174,8 +228,11 @@ class HostAgent(BasicService):
     def stop(self) -> None:
         with self._jobs_lock:
             jobs = list(self._jobs)
+            tele = list(self._telemetry)
         for jid in jobs:
             self._kill_job(jid)
+        for jid in tele:
+            self._stop_telemetry(jid)
         super().stop()
 
 

@@ -104,9 +104,17 @@ class Channel:
         # a queue+thread, so caller-side timing would measure the queue, not
         # the wire. None (the default) costs one attribute check per frame.
         self.io_hook = None
+        # Wire accounting (telemetry tree, ISSUE 17): every frame's full
+        # on-the-wire size (MAC + length word + payload, plus the handshake)
+        # is tallied so services can report ingest/egress bytes — the number
+        # the O(hosts)-vs-O(world) fan-in claim is gated on. Two plain int
+        # adds per frame; no locking (a Channel is single-owner per side).
+        self.bytes_sent = 0
+        self.bytes_received = 0
         if server:
             nonce = _secrets.token_bytes(_NONCE_LEN)
             sock.sendall(_MAGIC + nonce)
+            self.bytes_sent += len(_MAGIC) + _NONCE_LEN
         else:
             try:
                 head = _recv_exact(sock, len(_MAGIC) + _NONCE_LEN)
@@ -124,6 +132,7 @@ class Channel:
                 raise PermissionError(
                     "bad handshake magic: peer is not an hvd service")
             nonce = head[len(_MAGIC):]
+            self.bytes_received += len(head)
         self._key = hmac.new(key, b"hvd-session:" + nonce,
                              hashlib.sha256).digest()
         self._send_dir = b"S" if server else b"C"
@@ -186,6 +195,7 @@ class Channel:
         self._send_seq += 1
         resilience.send_all(
             self.sock, mac + struct.pack("!Q", len(payload)) + payload)
+        self.bytes_sent += 32 + 8 + len(payload)
 
     def recv(self) -> Any:
         digest = _recv_exact(self.sock, 32)
@@ -200,6 +210,7 @@ class Channel:
                 "HMAC digest mismatch: unauthenticated, replayed, or "
                 "reordered message")
         self._recv_seq += 1
+        self.bytes_received += 32 + 8 + n
         return pickle.loads(payload)
 
     # Raw-buffer frames: the eager ring data plane moves numpy chunk bytes
@@ -232,6 +243,7 @@ class Channel:
         t0 = time.monotonic_ns() if hook else 0
         resilience.send_all(self.sock, mac + struct.pack("!Q", len(view)))
         resilience.send_all(self.sock, view)
+        self.bytes_sent += 32 + 8 + len(view)
         if hook:
             hook("send", len(view), t0, time.monotonic_ns())
 
@@ -251,6 +263,7 @@ class Channel:
                 "HMAC digest mismatch: unauthenticated, replayed, or "
                 "reordered message")
         self._recv_seq += 1
+        self.bytes_received += 32 + 8 + n
         if hook:
             hook("recv", n, t0, time.monotonic_ns())
         return payload
@@ -265,8 +278,30 @@ class BasicService:
         self.server = socket.create_server((host, port))
         self.port = self.server.getsockname()[1]
         self._stop = threading.Event()
+        # Service-level wire accounting (telemetry tree): totals across all
+        # connections, flushed from each Channel's per-frame counters after
+        # every served request. stats() deltas taken around a collection
+        # tick give the root's actual ingest per tick — the measured number
+        # behind the O(hosts) claim, not an estimate.
+        self._stats_lock = threading.Lock()
+        self._bytes_in = 0
+        self._bytes_out = 0
+        self._connections_total = 0
+        self._requests_total = 0
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    def stats(self) -> dict:
+        """Wire totals since construction: ``bytes_in``/``bytes_out`` (full
+        frame sizes incl. MAC + length word + handshake), ``connections_total``
+        accepted, ``requests_total`` served."""
+        with self._stats_lock:
+            return {
+                "bytes_in": self._bytes_in,
+                "bytes_out": self._bytes_out,
+                "connections_total": self._connections_total,
+                "requests_total": self._requests_total,
+            }
 
     def addresses(self) -> list[tuple[str, int]]:
         """All reachable (ip, port) pairs for this service (reference probes
@@ -300,8 +335,21 @@ class BasicService:
             threading.Thread(target=self._serve, args=(conn, addr), daemon=True).start()
 
     def _serve(self, conn: socket.socket, addr) -> None:
+        ch = None
+        flushed_in = flushed_out = 0
+
+        def _flush_stats() -> None:
+            nonlocal flushed_in, flushed_out
+            with self._stats_lock:
+                self._bytes_in += ch.bytes_received - flushed_in
+                self._bytes_out += ch.bytes_sent - flushed_out
+            flushed_in = ch.bytes_received
+            flushed_out = ch.bytes_sent
+
         try:
             ch = Channel(conn, self.key, server=True)
+            with self._stats_lock:
+                self._connections_total += 1
             while not self._stop.is_set():
                 req = ch.recv()
                 if isinstance(req, dict) and req.get("kind") == "clock_probe":
@@ -314,9 +362,14 @@ class BasicService:
                 else:
                     resp = self.handle(req, addr)
                 ch.send(resp)
+                with self._stats_lock:
+                    self._requests_total += 1
+                _flush_stats()
         except (ConnectionError, OSError, EOFError, PermissionError):
             pass
         finally:
+            if ch is not None:
+                _flush_stats()
             try:
                 conn.close()
             except OSError:

@@ -51,8 +51,24 @@ class DriverService(BasicService):
         # request or attached to the final result payload); rank 0 of the
         # control plane — this driver — merges them into the pod view.
         self._metrics: dict[int, dict] = {}
+        # Telemetry-tree root (ISSUE 17): host leaders push MERGED host
+        # partials via `host_metrics` instead of every rank pushing its own
+        # snapshot — root connections and bytes per tick become O(hosts).
+        # Created lazily on the first leader push so flat (tree-less) jobs
+        # pay nothing.
+        self._telemetry: Optional[Any] = None
         self.coord_addr: Optional[str] = None
         self.jax_coord_addr: Optional[str] = None
+
+    def telemetry_root(self):
+        """The tree root aggregator (telemetry/root.py), created on first
+        use — also the launcher's handle for staleness/coverage views."""
+        with self._lock:
+            if self._telemetry is None:
+                from ..telemetry.root import RootAggregator
+
+                self._telemetry = RootAggregator()
+            return self._telemetry
 
     # -- protocol
 
@@ -106,6 +122,11 @@ class DriverService(BasicService):
             with self._cv:
                 self._metrics[req["rank"]] = req["snapshot"]
             return {"ok": True}
+        if kind == "host_metrics":
+            # Telemetry-tree leader push: one MERGED host partial (delta-
+            # compressed) per host per collection tick (telemetry/agent.py
+            # push_to_root_once → telemetry/root.py ingest).
+            return self.telemetry_root().ingest(req)
         if kind == "clock_probe":
             # Distributed-tracing clock alignment (tracing/clock.py): one
             # NTP-style round trip — the caller brackets this response with
@@ -201,19 +222,44 @@ class DriverService(BasicService):
                 for r, v in results.items()}
 
     def pod_metrics(self) -> Optional[dict]:
-        """Pod-wide merge of the per-rank metrics snapshots collected so far
-        (mid-run pushes and/or final result payloads); None when no rank has
-        reported telemetry."""
+        """Pod-wide merge of the telemetry collected so far — host partials
+        pushed by telemetry-tree leaders (``host_metrics``) plus per-rank
+        snapshots pushed directly (``metrics`` / final result payloads);
+        None when nothing has reported. A rank covered by a host partial is
+        never double-counted against its own direct push, and because the
+        merge is associative with exact sums (metrics/aggregate.py), the
+        result is bitwise what the flat all-ranks merge would produce."""
         with self._lock:
-            if not self._metrics:
-                return None
-            snaps: list = [None] * self.num_proc
-            for r, s in self._metrics.items():
-                if 0 <= r < self.num_proc:
-                    snaps[r] = s
-        from ..metrics import merge_snapshots
+            snaps = {r: s for r, s in self._metrics.items()
+                     if 0 <= r < self.num_proc}
+            telemetry = getattr(self, "_telemetry", None)
+        host_parts: list = []
+        covered: set = set()
+        if telemetry is not None:
+            covered = telemetry.covered_ranks()
+            host_parts = telemetry.partials()
+            # Readers drive staleness refresh: a host that went silent only
+            # ages through here (its own pushes obviously stopped).
+            telemetry.publish()
+        if not snaps and not host_parts:
+            return None
+        from ..metrics.aggregate import (
+            finalize_partial,
+            lift_snapshot,
+            merge_partials,
+        )
 
-        return merge_snapshots(snaps)
+        # Combine in global rank order (host partials slot in at their
+        # lowest member rank) so bucket first-seen order matches the flat
+        # merge exactly.
+        keyed = [(min((int(r) for r in p.get("rank_ids", [])),
+                      default=self.num_proc), p) for p in host_parts]
+        keyed += [(r, lift_snapshot(r, s)) for r, s in sorted(snaps.items())
+                  if r not in covered]
+        keyed.sort(key=lambda kv: kv[0])
+        part = merge_partials([p for _, p in keyed])
+        part["ranks"] = max(int(self.num_proc), int(part["ranks"]))
+        return finalize_partial(part)
 
     def result_pending_index(self, index: int) -> bool:
         """True while no result has arrived for the worker at task ``index``
@@ -385,6 +431,14 @@ class ElasticDriverService(DriverService):
         self._reg_waiting.clear()
         self._pending.clear()
         self._results = {}   # results are per generation
+        if self._telemetry is not None:
+            # Membership changed: drop telemetry-tree state for hosts that
+            # left the world, so an orphaned staleness gauge can't age into
+            # a spurious `telemetry_lag` firing (root.forget_host).
+            try:
+                self._telemetry.keep_only(by_host)
+            except Exception:
+                pass
 
     # -- launcher accessors
 

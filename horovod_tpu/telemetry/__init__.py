@@ -1,0 +1,34 @@
+"""Pod-scale telemetry tree (ISSUE 17 tentpole).
+
+Every telemetry path built in ISSUEs 2/6/15 — pod metrics snapshots,
+trace-span collection, flight-ring sweeps, NTP clock probes, stall
+reports — originally fanned in O(world) through the coordinator's single
+socket loop. This package restructures all of them as a two-level tree,
+the Dapper pattern (local collection daemons + aggregation before the
+slow tier, PAPERS.md Observability):
+
+- :mod:`tree`  — the plan: which rank leads each host (same election as
+  the hier data plane: lowest rank on the host) and the collection
+  interval knob.
+- :mod:`agent` — :class:`~horovod_tpu.telemetry.agent.TelemetryAgent`,
+  the per-host leader service (hosted by the runner HostAgent process):
+  ranks push metrics-snapshot DELTAS to it, it answers their clock probes
+  locally with composed offsets, batches their watchdog/anomaly events,
+  and serves pull-based ``sweep`` endpoints for flight rings and trace
+  spans. :class:`~horovod_tpu.telemetry.agent.RankTelemetryClient` is the
+  rank side.
+- :mod:`root`  — :class:`~horovod_tpu.telemetry.root.RootAggregator`,
+  the coordinator side: ingests per-host partials (associative merge,
+  metrics/aggregate.py), tracks per-host staleness (feeding the
+  ``telemetry_lag`` anomaly), and exposes the pod view.
+
+Root connections and control bytes per collection tick are O(hosts), not
+O(world); the host-then-root merge is bitwise-identical to the flat merge
+by construction (exact rational sums, rounded once at finalize).
+"""
+
+from __future__ import annotations
+
+from .agent import RankTelemetryClient, TelemetryAgent  # noqa: F401
+from .root import RootAggregator  # noqa: F401
+from .tree import TreePlan, interval_s_from_env, plan_tree  # noqa: F401

@@ -15,6 +15,16 @@ a human (or a bug report) can carry:
 - ``MANIFEST.md`` — the human-readable index: which processes dumped and
   why, which replicas died, which anomalies fired, what is in each file.
 
+At pod scale the interesting flight rings and span files live on OTHER
+hosts. ``--leader host:port`` (repeatable) sweeps them through the
+telemetry-tree host leaders (telemetry/agent.py ``sweep``): rings are
+decoded host-side and streamed back host-by-host, so the bundle machine
+opens O(hosts) connections, never O(world). Every leader is accounted for
+in the MANIFEST's **Pod coverage** section — a leader that cannot be
+reached, a rank that stopped pushing, or a ring that fails to decode is
+NAMED (host, reason, what is missing), because a silent gap in a debug
+bundle reads as "nothing happened there", which is exactly backwards.
+
 Exit 0 with the bundle path on stdout; 1 when there was nothing at all
 to collect. docs/debugging.md walks through reading the result.
 """
@@ -33,13 +43,25 @@ from . import flight as _flight
 _EVENT_KINDS = ("replica_death", "anomaly", "stall", "plane_demote")
 
 
-def _collect_flight(flight_dir: str, out: str) -> tuple[list, list]:
+def _flight_row_and_events(name: str, kind: str, doc: dict
+                           ) -> tuple[dict, list]:
+    row = {"file": f"flight/{name}", "kind": kind,
+           "proc": doc.get("proc", "?"),
+           "reason": doc.get("reason", "?") if kind == "dump" else "-",
+           "records": len(doc.get("records", []))}
+    events = [dict(rec, _source=name) for rec in doc.get("records", [])
+              if rec.get("flight_event") in _EVENT_KINDS]
+    return row, events
+
+
+def _collect_flight(flight_dir: str, out: str) -> tuple[list, list, list]:
     """Copy dumps + decode rings into ``out``/flight; returns
-    (inventory rows, notable events)."""
+    (inventory rows, notable events, NAMED decode failures)."""
     rows: list[dict] = []
     events: list[dict] = []
+    errors: list[dict] = []
     if not flight_dir or not os.path.isdir(flight_dir):
-        return rows, events
+        return rows, events, errors
     dst = os.path.join(out, "flight")
     os.makedirs(dst, exist_ok=True)
     for path in sorted(glob.glob(os.path.join(flight_dir,
@@ -48,32 +70,132 @@ def _collect_flight(flight_dir: str, out: str) -> tuple[list, list]:
         try:
             with open(path) as f:
                 doc = json.load(f)
-        except (OSError, ValueError):
+        except (OSError, ValueError) as e:
+            errors.append({"file": name, "host": "local",
+                           "error": str(e)[:200]})
             continue
         shutil.copy(path, os.path.join(dst, name))
-        rows.append({"file": f"flight/{name}", "kind": "dump",
-                     "proc": doc.get("proc", "?"),
-                     "reason": doc.get("reason", "?"),
-                     "records": len(doc.get("records", []))})
-        for rec in doc.get("records", []):
-            if rec.get("flight_event") in _EVENT_KINDS:
-                events.append(dict(rec, _source=name))
+        row, evs = _flight_row_and_events(name, "dump", doc)
+        rows.append(row)
+        events.extend(evs)
     for path in sorted(glob.glob(os.path.join(flight_dir,
                                               "flight-*.ring"))):
         try:
             ring = _flight.read_ring(path)
-        except (OSError, ValueError):
+        except Exception as e:  # torn rings raise struct.error too
+            errors.append({"file": os.path.basename(path), "host": "local",
+                           "error": str(e)[:200]})
             continue
         name = os.path.basename(path) + ".json"
         with open(os.path.join(dst, name), "w") as f:
             json.dump(ring, f)
-        rows.append({"file": f"flight/{name}", "kind": "ring",
-                     "proc": ring.get("proc", "?"), "reason": "-",
-                     "records": len(ring.get("records", []))})
-        for rec in ring.get("records", []):
-            if rec.get("flight_event") in _EVENT_KINDS:
-                events.append(dict(rec, _source=name))
-    return rows, events
+        row, evs = _flight_row_and_events(name, "ring", ring)
+        rows.append(row)
+        events.extend(evs)
+    return rows, events, errors
+
+
+def _leader_key(hex_key: Optional[str]) -> bytes:
+    """The sweep credential: ``--leader-key`` hex, else the job secret the
+    ranks already hold (HOROVOD_SECRET / HOROVOD_AGENT_SECRET)."""
+    raw = hex_key or os.environ.get("HOROVOD_SECRET") \
+        or os.environ.get("HOROVOD_AGENT_SECRET")
+    if not raw:
+        raise SystemExit(
+            "bundle --leader needs the telemetry secret: pass --leader-key "
+            "or set HOROVOD_SECRET (hex)")
+    return bytes.fromhex(raw)
+
+
+def _judge_coverage(host: str, cov: dict) -> dict:
+    """Turn one leader's per-rank coverage into a named verdict row.
+    A rank is STALE past TELEMETRY_LAG_TICKS collection intervals — the
+    same threshold the ``telemetry_lag`` anomaly fires on."""
+    from ..metrics.anomaly import TELEMETRY_LAG_TICKS
+
+    interval = float(cov.get("interval_s") or 1.0)
+    expected = [int(r) for r in cov.get("expected") or []]
+    ranks = cov.get("ranks") or {}
+    missing = [r for r in expected if str(r) not in ranks]
+    stale = [int(r) for r, st in ranks.items()
+             if float(st.get("age_s", 0.0))
+             > TELEMETRY_LAG_TICKS * interval]
+    if missing or stale:
+        why = []
+        if missing:
+            why.append(f"ranks {missing} never pushed")
+        if stale:
+            why.append(f"ranks {sorted(stale)} stale "
+                       f">{TELEMETRY_LAG_TICKS} intervals")
+        status, reason = "partial", "; ".join(why)
+    else:
+        status, reason = "ok", "-"
+    return {"host": host, "status": status, "reason": reason,
+            "expected": len(expected), "reporting": len(ranks),
+            "missing": missing, "stale": sorted(stale)}
+
+
+def _collect_leaders(leaders: list, key: bytes, out: str
+                     ) -> tuple[list, list, list, list, Optional[str]]:
+    """Sweep every telemetry-tree leader; returns (coverage rows,
+    flight rows, flight decode failures, events, staged spans dir)."""
+    from ..runner.network import BasicClient
+
+    coverage: list[dict] = []
+    rows: list[dict] = []
+    errors: list[dict] = []
+    events: list[dict] = []
+    spans_dir: Optional[str] = None
+    dst = os.path.join(out, "flight")
+    for addr in leaders:
+        host_part, _, port_part = addr.rpartition(":")
+        try:
+            client = BasicClient([(host_part or "127.0.0.1",
+                                   int(port_part))], key,
+                                 timeout=60.0, connect_retry_s=5.0)
+        except (OSError, ValueError) as e:
+            coverage.append({"host": addr, "status": "unreachable",
+                             "reason": str(e)[:200], "expected": 0,
+                             "reporting": 0, "missing": [], "stale": []})
+            continue
+        try:
+            resp = client.request({"kind": "sweep",
+                                   "want": ["flight", "spans"]})
+        except Exception as e:  # noqa: BLE001 - a dead leader is the finding
+            coverage.append({"host": addr, "status": "unreachable",
+                             "reason": str(e)[:200], "expected": 0,
+                             "reporting": 0, "missing": [], "stale": []})
+            continue
+        finally:
+            try:
+                client.close()
+            except Exception:
+                pass
+        host = str(resp.get("host", addr))
+        coverage.append(_judge_coverage(host, resp.get("coverage") or {}))
+        for item in resp.get("flight") or []:
+            os.makedirs(dst, exist_ok=True)
+            name = f"{host}-{item['name']}"
+            with open(os.path.join(dst, name), "w") as f:
+                json.dump(item["doc"], f)
+            row, evs = _flight_row_and_events(name, item.get("kind", "?"),
+                                              item["doc"])
+            rows.append(row)
+            events.extend(evs)
+        for err in resp.get("flight_errors") or []:
+            errors.append(dict(err, host=host))
+        for item in resp.get("spans") or []:
+            if spans_dir is None:
+                spans_dir = os.path.join(out, "spans")
+                os.makedirs(spans_dir, exist_ok=True)
+            name = item["name"]
+            if os.path.exists(os.path.join(spans_dir, name)):
+                # same rank file swept from two leaders (shared FS): the
+                # copies are identical, keep the first
+                continue
+            with open(os.path.join(spans_dir, name), "w") as f:
+                f.write(item["text"])
+    return coverage, rows, errors, events, spans_dir
 
 
 def _collect_trace(trace_dir: str, out: str) -> tuple[Optional[dict],
@@ -127,7 +249,8 @@ def _collect_stats(sources: list, out: str) -> list:
 
 def _manifest(out: str, flight_rows: list, events: list,
               report: Optional[dict], trace_path: Optional[str],
-              stats_rows: list) -> str:
+              stats_rows: list, coverage_rows: Optional[list] = None,
+              flight_errors: Optional[list] = None) -> str:
     lines = ["# horovod_tpu debug bundle", "",
              f"Collected {time.strftime('%Y-%m-%d %H:%M:%S')} by "
              f"`python -m horovod_tpu.tracing.bundle`. How to read this: "
@@ -154,10 +277,32 @@ def _manifest(out: str, flight_rows: list, events: list,
     for e in other:
         lines.append(f"- event `{e.get('flight_event')}`: "
                      f"{json.dumps({k: v for k, v in e.items() if k not in ('flight_event', 't', '_source')})}")
-    if not (deaths or anomalies or other):
+    gaps = [r for r in (coverage_rows or []) if r["status"] != "ok"]
+    for r in gaps:
+        lines.append(f"- **host `{r['host']}` coverage {r['status']}**: "
+                     f"{r['reason']}")
+    for e in (flight_errors or []):
+        lines.append(f"- **flight file `{e['file']}` on {e['host']} "
+                     f"failed to decode**: {e['error']}")
+    if not (deaths or anomalies or other or gaps or flight_errors):
         lines.append("- no death/anomaly/stall events in the captured "
                      "window")
     lines.append("")
+    if coverage_rows is not None:
+        lines.append("## Pod coverage")
+        lines.append("")
+        lines.append("Per telemetry-tree leader: every swept host is "
+                     "accounted for — `unreachable` and `partial` rows "
+                     "mean the bundle is MISSING that host's data, not "
+                     "that nothing happened there.")
+        lines.append("")
+        lines.append("| host | status | expected | reporting | detail |")
+        lines.append("|---|---|---|---|---|")
+        for r in coverage_rows:
+            lines.append(f"| {r['host']} | {r['status']} | "
+                         f"{r['expected']} | {r['reporting']} | "
+                         f"{r['reason']} |")
+        lines.append("")
     if trace_path:
         lines.append("## Merged trace")
         lines.append("")
@@ -182,6 +327,9 @@ def _manifest(out: str, flight_rows: list, events: list,
                          f"{r['reason']} | {r['records']} |")
     else:
         lines.append("(none found)")
+    for e in (flight_errors or []):
+        lines.append(f"- `{e['file']}` ({e['host']}): DECODE FAILED — "
+                     f"{e['error']}")
     lines.append("")
     if stats_rows:
         lines.append("## Stats snapshots")
@@ -200,11 +348,34 @@ def _manifest(out: str, flight_rows: list, events: list,
 
 
 def make_bundle(out: str, trace_dir: str = "", flight_dir: str = "",
-                stats: Optional[list] = None) -> dict:
+                stats: Optional[list] = None,
+                leaders: Optional[list] = None,
+                leader_key: Optional[bytes] = None) -> dict:
     """Assemble a bundle directory; returns a summary dict (the CLI's
-    machine-readable line)."""
+    machine-readable line). With ``leaders`` the flight rings and span
+    files are swept through telemetry-tree host leaders host-by-host
+    (O(hosts) connections) and a Pod-coverage section names every gap."""
     os.makedirs(out, exist_ok=True)
-    flight_rows, events = _collect_flight(flight_dir, out)
+    flight_rows, events, flight_errors = _collect_flight(flight_dir, out)
+    coverage_rows: Optional[list] = None
+    if leaders:
+        coverage_rows, l_rows, l_errors, l_events, swept_spans = \
+            _collect_leaders(list(leaders), leader_key or _leader_key(None),
+                             out)
+        flight_rows += l_rows
+        flight_errors += l_errors
+        events += l_events
+        if swept_spans:
+            # Stage local span files next to the swept ones so the merged
+            # trace covers every host (names are per-rank/per-proc).
+            if trace_dir and os.path.isdir(trace_dir):
+                from .collector import span_files
+
+                for path in span_files(trace_dir):
+                    name = os.path.basename(path)
+                    if not os.path.exists(os.path.join(swept_spans, name)):
+                        shutil.copy(path, os.path.join(swept_spans, name))
+            trace_dir = swept_spans
     # A ring and its dumps overlap; report each underlying event once.
     seen: set = set()
     unique = []
@@ -217,10 +388,15 @@ def make_bundle(out: str, trace_dir: str = "", flight_dir: str = "",
     events = unique
     report, trace_path = _collect_trace(trace_dir, out)
     stats_rows = _collect_stats(list(stats or []), out)
-    _manifest(out, flight_rows, events, report, trace_path, stats_rows)
+    _manifest(out, flight_rows, events, report, trace_path, stats_rows,
+              coverage_rows, flight_errors)
     return {"bundle": out, "flight_files": len(flight_rows),
             "events": len(events), "trace": bool(trace_path),
             "stats": len([r for r in stats_rows if not r.get("error")]),
+            "hosts_swept": len(coverage_rows or []),
+            "coverage_gaps": [r["host"] for r in (coverage_rows or [])
+                              if r["status"] != "ok"],
+            "flight_decode_failures": len(flight_errors),
             "dead_replicas": sorted({e.get("replica") for e in events
                                      if e.get("flight_event") ==
                                      "replica_death"
@@ -245,12 +421,23 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--stats", action="append", default=[],
                     help="a /stats URL or saved snapshot file "
                          "(repeatable)")
+    ap.add_argument("--leader", action="append", default=[],
+                    help="a telemetry-tree host leader host:port to sweep "
+                         "flight rings and spans from (repeatable; every "
+                         "leader is accounted for in the MANIFEST's Pod "
+                         "coverage section)")
+    ap.add_argument("--leader-key", default=None,
+                    help="hex secret for the leaders (default "
+                         "$HOROVOD_SECRET or $HOROVOD_AGENT_SECRET)")
     args = ap.parse_args(argv)
     out = args.out or f"debug-bundle-{time.strftime('%Y%m%d-%H%M%S')}"
     summary = make_bundle(out, trace_dir=args.trace_dir,
-                          flight_dir=args.flight_dir, stats=args.stats)
+                          flight_dir=args.flight_dir, stats=args.stats,
+                          leaders=args.leader,
+                          leader_key=_leader_key(args.leader_key)
+                          if args.leader else None)
     if not summary["flight_files"] and not summary["trace"] \
-            and not summary["stats"]:
+            and not summary["stats"] and not summary["hosts_swept"]:
         print(f"bundle: nothing to collect (trace_dir="
               f"{args.trace_dir or '-'}, flight_dir="
               f"{args.flight_dir or '-'})")

@@ -17,6 +17,14 @@ The sample taken on the round with the SMALLEST round-trip is kept — on a
 quiet localhost control channel that bounds the error at tens of
 microseconds, far below the millisecond-scale phases the critical-path
 analyzer attributes.
+
+At pod scale ranks do not probe the coordinator directly — O(world)
+probes through one socket loop is exactly the fan-in the telemetry tree
+removes. A rank probes its host's telemetry leader (one LAN/loopback hop,
+tight RTT bound) and composes that estimate with the leader's own cached
+estimate against the coordinator (``compose_offsets``): offsets add, error
+bounds add. The composed bound stays small because each hop's bound is
+half of that hop's best RTT, and both hops are short.
 """
 
 from __future__ import annotations
@@ -54,3 +62,17 @@ def estimate_offset_ns(probe: Callable[[], int],
     if best_rtt is None:
         raise ConnectionError(f"clock probe failed every round: {last_err}")
     return int(best_offset), int(best_rtt // 2)
+
+
+def compose_offsets(hop_a: Tuple[int, int],
+                    hop_b: Tuple[int, int]) -> Tuple[int, int]:
+    """Compose two NTP estimates along a path: if ``hop_a`` maps local time
+    to an intermediary's clock and ``hop_b`` maps the intermediary's clock
+    to the reference, the composition maps local time to the reference.
+
+    Offsets add (``ref ~= mid + off_b ~= (local + off_a) + off_b``); error
+    bounds add (worst case, both hops err the same way). Returns
+    ``(offset_ns, error_bound_ns)`` like ``estimate_offset_ns``.
+    """
+    return (int(hop_a[0]) + int(hop_b[0]),
+            int(hop_a[1]) + int(hop_b[1]))

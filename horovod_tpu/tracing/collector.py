@@ -40,6 +40,14 @@ _LANE_NAMES = {0: "enqueue", 1: "negotiate", 2: "wire send", 3: "wire recv",
 _TRAIN_LANES = (0, 1, 2, 3, 4, 5)
 
 
+def span_files(trace_dir: str) -> list:
+    """Sorted ``spans-*.jsonl`` paths in a trace directory — one
+    enumeration shared by the local collector and the telemetry-tree
+    leaders' ``sweep`` endpoint (telemetry/agent.py), so a bundle built
+    through leaders sees the same file set a local merge would."""
+    return sorted(glob.glob(os.path.join(trace_dir, "spans-*.jsonl")))
+
+
 def load_spans(trace_dir: str) -> tuple[list[dict], dict]:
     """Read every span file — training ranks (``spans-rank<k>.jsonl``) AND
     serving processes (``spans-<proc>.jsonl``, tracing/serve.py) — apply
@@ -51,7 +59,7 @@ def load_spans(trace_dir: str) -> tuple[list[dict], dict]:
     """
     spans: list[dict] = []
     metas: dict = {}
-    for path in sorted(glob.glob(os.path.join(trace_dir, "spans-*.jsonl"))):
+    for path in span_files(trace_dir):
         offset = 0
         proc = None
         pending: list[dict] = []

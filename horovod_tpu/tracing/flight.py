@@ -254,6 +254,23 @@ def _decode_slots(mm, seq: int, capacity: int) -> list:
     return out
 
 
+def ring_files(flight_dir: str) -> list:
+    """Sorted ``flight-*.ring`` paths in a directory — one enumeration
+    shared by the local bundle sweep and the telemetry-tree leaders'
+    ``sweep`` endpoint (telemetry/agent.py), so both see the same set."""
+    import glob
+
+    return sorted(glob.glob(os.path.join(flight_dir, "flight-*.ring")))
+
+
+def dump_files(flight_dir: str) -> list:
+    """Sorted ``flight-*.json`` dump paths in a directory (same sharing
+    rationale as :func:`ring_files`)."""
+    import glob
+
+    return sorted(glob.glob(os.path.join(flight_dir, "flight-*.json")))
+
+
 def read_ring(path: str) -> dict:
     """Decode a ring file (live or left behind by a dead process) into
     ``{"proc", "meta", "records"}``. Tolerates torn slots — a process

@@ -1,0 +1,570 @@
+"""Pod-scale telemetry tree tests (ISSUE 17): the associative merge monoid
+(host-then-root bitwise == flat, fuzz + adversarial float fixtures), the
+delta wire format and its seq/need_full resync on both hops, composed
+clock offsets under injected per-hop jitter, the TelemetryAgent /
+RankTelemetryClient / RootAggregator protocol end to end over real TCP,
+the ``telemetry_lag`` anomaly (fires, NAMES the host, stops after
+forget_host), the leader ``/metrics.json?host=1`` view, bundle leader
+sweeps with named coverage gaps, and the watchdog/anomaly event plumbing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import secrets
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import pytest
+
+from horovod_tpu.metrics.aggregate import (  # noqa: E402
+    apply_snapshot_delta,
+    combine_partials,
+    empty_partial,
+    finalize_partial,
+    lift_snapshot,
+    merge_partials,
+    merge_snapshots,
+    snapshot_delta,
+)
+from horovod_tpu.metrics.anomaly import (  # noqa: E402
+    TELEMETRY_LAG_TICKS,
+    AnomalyDetector,
+)
+from horovod_tpu.metrics.registry import MetricsRegistry  # noqa: E402
+from horovod_tpu.telemetry import (  # noqa: E402
+    RankTelemetryClient,
+    TelemetryAgent,
+    interval_s_from_env,
+    plan_tree,
+)
+from horovod_tpu.telemetry.root import RootAggregator  # noqa: E402
+from horovod_tpu.tracing.clock import compose_offsets  # noqa: E402
+
+KEY = secrets.token_bytes(32)
+LOOP = "127.0.0.1"
+
+
+def _snap(rank: int, tick: int = 1, rng: random.Random = None) -> dict:
+    """A synthetic rank snapshot; with ``rng``, values are adversarial
+    floats (non-dyadic decimals, tiny/huge magnitudes) whose sums are
+    grouping-sensitive in plain fp arithmetic."""
+    rv = rng.random if rng else (lambda: 0.1)
+    counters = {"horovod_allreduce_ops_total": 3.0 * tick + rank,
+                "horovod_x_total": 0.1 + rank * 0.3 + rv() * 1e-9,
+                'horovod_labeled_total{op="ar"}': rv() * 1e12}
+    gauges = {"horovod_q_depth": rank * 0.7 + rv(),
+              "horovod_step_time_s": 0.1 * (1 + (rank + tick) % 3)}
+    hist = {"count": 10 * tick + rank, "sum": 0.3 * tick + rv(),
+            "p50": 0.1, "p90": 0.2, "p99": 0.3,
+            "buckets": [[0.1, 4 * tick], [1.0, 8 * tick],
+                        ["+Inf", 10 * tick + rank]]}
+    return {"schema": "horovod_tpu.metrics.v1",
+            "time_unix_s": 1.7e9 + tick + rank * 0.01,
+            "counters": counters, "gauges": gauges,
+            "histograms": {"horovod_lat_seconds": hist},
+            "info": {"device": f"tpu:{rank}"}}
+
+
+# --------------------------------------------------- the merge monoid
+
+
+def test_host_then_root_merge_bitwise_equals_flat_fuzz():
+    """The tentpole invariant: for random worlds and random host
+    groupings, lifting per host, combining host partials, then finalizing
+    is BITWISE identical to the flat merge — serialized JSON equality, so
+    every float bit pattern counts."""
+    rng = random.Random(1234)
+    for trial in range(25):
+        world = rng.randrange(2, 33)
+        snaps = [_snap(r, tick=rng.randrange(1, 5), rng=rng)
+                 for r in range(world)]
+        flat = merge_snapshots(snaps)
+        # random contiguous host grouping (the barrel-shift layout)
+        cuts = sorted(rng.sample(range(1, world), min(rng.randrange(0, 5),
+                                                      world - 1)))
+        groups, lo = [], 0
+        for c in cuts + [world]:
+            groups.append(list(range(lo, c)))
+            lo = c
+        host_parts = [merge_partials([lift_snapshot(r, snaps[r])
+                                      for r in g]) for g in groups]
+        tree = finalize_partial(merge_partials(host_parts))
+        assert json.dumps(tree, sort_keys=True) == \
+            json.dumps(flat, sort_keys=True), f"trial {trial} diverged"
+
+
+def test_merge_fixtures_nonassociative_floats():
+    """0.1 + 0.2 + 0.3 groups differently in fp ((a+b)+c != a+(b+c));
+    the exact-rational partial makes both groupings identical. None
+    snapshots (a rank that never reported) and non-finite values are
+    absorbed without poisoning the sums."""
+    snaps = []
+    for r, v in enumerate([0.1, 0.2, 0.3, float("nan"), float("inf")]):
+        s = _snap(r)
+        s["counters"] = {"horovod_t_total": v}
+        s["gauges"] = {}
+        s["histograms"] = {}
+        snaps.append(s)
+    snaps.append(None)
+    flat = merge_snapshots(snaps)
+    left = combine_partials(
+        combine_partials(lift_snapshot(0, snaps[0]),
+                         lift_snapshot(1, snaps[1])),
+        merge_partials([lift_snapshot(r, snaps[r]) for r in range(2, 6)]))
+    right = combine_partials(
+        lift_snapshot(0, snaps[0]),
+        merge_partials([lift_snapshot(r, snaps[r]) for r in range(1, 6)]))
+    assert json.dumps(finalize_partial(left), sort_keys=True) == \
+        json.dumps(finalize_partial(right), sort_keys=True) == \
+        json.dumps(flat, sort_keys=True)
+    # non-finite inputs counted as 0, not NaN-poisoning
+    assert flat["counters"]["horovod_t_total"] == pytest.approx(0.6)
+    assert flat["ranks_reporting"] == 5
+
+
+def test_partial_survives_json_wire():
+    """Host partials cross two TCP hops as JSON — a partial must combine
+    identically after a dumps/loads round trip (Fraction pairs are int
+    pairs, never floats)."""
+    part = merge_partials([lift_snapshot(r, _snap(r)) for r in range(4)])
+    wired = json.loads(json.dumps(part))
+    more = lift_snapshot(7, _snap(7))
+    assert json.dumps(finalize_partial(combine_partials(wired, more)),
+                      sort_keys=True) == \
+        json.dumps(finalize_partial(combine_partials(part, more)),
+                   sort_keys=True)
+    assert combine_partials(empty_partial(), wired)["ranks"] == \
+        part["ranks"]
+
+
+def test_snapshot_delta_roundtrip_and_size():
+    prev, cur = _snap(3, tick=1), _snap(3, tick=2)
+    cur["counters"]["horovod_new_total"] = 1.0
+    del cur["gauges"]["horovod_q_depth"]
+    d = snapshot_delta(prev, cur)
+    assert apply_snapshot_delta(prev, d) == cur
+    # unchanged series do not travel
+    tiny = dict(prev, time_unix_s=prev["time_unix_s"] + 1)
+    d2 = snapshot_delta(prev, tiny)
+    assert len(json.dumps(d2)) < len(json.dumps(prev)) / 4
+    # deltas work on PARTIALS too (the leader->root hop)
+    pa = merge_partials([lift_snapshot(r, _snap(r, 1)) for r in range(3)])
+    pb = merge_partials([lift_snapshot(r, _snap(r, 2)) for r in range(3)])
+    assert apply_snapshot_delta(pa, snapshot_delta(pa, pb)) == pb
+
+
+# --------------------------------------------------- clocks
+
+
+def test_compose_offsets_accuracy_under_jitter():
+    """Two simulated hops with asymmetric per-hop jitter: the composed
+    (offset, error) must bracket the true end-to-end offset within the
+    summed error bounds — the guarantee that makes tree-composed spans
+    still order correctly in the merged trace."""
+    from horovod_tpu.tracing.clock import estimate_offset_ns
+
+    rng = random.Random(7)
+    true_ab, true_bc = 5_000_000, -2_000_000   # a->b, b->c true offsets
+
+    def probe(true_off):
+        def one():
+            # min-RTT estimator: jittered both ways, bounded by max RTT
+            there = rng.randrange(10_000, 300_000)
+            back = rng.randrange(10_000, 300_000)
+            t = time.monotonic_ns() + true_off + there
+            time.sleep((there + back) / 1e9)
+            return t
+        return one
+
+    hop_ab = estimate_offset_ns(probe(true_ab), rounds=8)
+    hop_bc = estimate_offset_ns(probe(true_bc), rounds=8)
+    off, err = compose_offsets(hop_ab, hop_bc)
+    assert err >= hop_ab[1] and err >= hop_bc[1]
+    assert abs(off - (true_ab + true_bc)) <= err + 2_000_000
+    assert compose_offsets((3, 1), (-5, 2)) == (-2, 3)
+
+
+# --------------------------------------------------- agent protocol
+
+
+def test_agent_push_delta_and_need_full_resync(tmp_path):
+    reg = MetricsRegistry()
+    ag = TelemetryAgent(KEY, host_name="hA", flight_dir="", trace_dir="",
+                        interval_s=0.5, reg=reg)
+    try:
+        rc = RankTelemetryClient([(LOOP, ag.port)], KEY, rank=4)
+        assert rc.interval_s == 0.5
+        req1 = rc.push(_snap(4, 1))
+        assert req1["full"] is True
+        req2 = rc.push(_snap(4, 2))
+        assert req2["full"] is False   # delta-compressed steady state
+        assert len(json.dumps(req2["body"])) < \
+            len(json.dumps(req1["body"]))
+        view = ag.host_view()
+        assert json.dumps(view, sort_keys=True) == \
+            json.dumps(merge_snapshots([None] * 4 + [_snap(4, 2)]),
+                       sort_keys=True).replace('"ranks": 5', '"ranks": 1')
+        # seq gap (agent lost state): rank transparently resends full
+        with ag._state_lock:
+            ag._ranks.clear()
+        rc.push(_snap(4, 3))
+        assert ag.coverage()["ranks"]["4"]["seq"] == 2
+        # counted per ACCEPTED push: 2 + the resent full (the rejected
+        # delta that triggered need_full does not count)
+        assert reg.counter("horovod_telemetry_pushes_total",
+                           hop="rank").value == 3
+        rc.close()
+    finally:
+        ag.stop()
+
+
+def test_agent_events_batched_and_counted():
+    reg = MetricsRegistry()
+    ag = TelemetryAgent(KEY, host_name="hB", flight_dir="", trace_dir="",
+                        interval_s=1.0, reg=reg)
+    try:
+        rc = RankTelemetryClient([(LOOP, ag.port)], KEY, rank=0)
+        rc.push_events([{"kind": "stall", "rank": 0},
+                        {"kind": "anomaly", "anomaly": "ttft_slo"},
+                        {"kind": "custom"}])
+        rc.event_sink({"kind": "stall", "rank": 0})   # never raises
+        evs = ag.drain_events()
+        assert len(evs) == 4 and all(e["_rank"] == 0 for e in evs)
+        assert ag.drain_events() == []
+        assert reg.counter("horovod_telemetry_events_total",
+                           source="watchdog").value == 2
+        assert reg.counter("horovod_telemetry_events_total",
+                           source="anomaly").value == 1
+        assert reg.counter("horovod_telemetry_events_total",
+                           source="other").value == 1
+        rc.close()
+    finally:
+        ag.stop()
+
+
+def test_root_aggregator_delta_resync_and_coverage():
+    reg = MetricsRegistry()
+    clock = [100.0]
+    root = RootAggregator(interval_s=1.0, reg=reg, now=lambda: clock[0])
+    pa1 = merge_partials([lift_snapshot(r, _snap(r, 1)) for r in (0, 1)])
+    pa2 = merge_partials([lift_snapshot(r, _snap(r, 2)) for r in (0, 1)])
+    assert root.ingest({"host": "hA", "seq": 0, "full": True, "body": pa1,
+                        "interval_s": 1.0}) == \
+        {"ok": True, "need_full": False}
+    r = root.ingest({"host": "hA", "seq": 1, "full": False,
+                     "body": snapshot_delta(pa1, pa2), "interval_s": 1.0})
+    assert r == {"ok": True, "need_full": False}
+    assert root.partials() == [pa2]
+    # seq gap (root restarted relative to the leader) -> need_full
+    assert root.ingest({"host": "hA", "seq": 5, "full": False,
+                        "body": {}})["need_full"] is True
+    assert root.covered_ranks() == {0, 1}
+    clock[0] += 2.5
+    assert root.ages_ticks()["hA"] == pytest.approx(2.5)
+    assert reg.counter("horovod_telemetry_pushes_total",
+                       hop="host").value == 2
+    root.forget_host("hA")
+    assert root.hosts() == [] and root.covered_ranks() == set()
+
+
+# --------------------------------------------------- driver e2e
+
+
+def test_driver_tree_pod_metrics_bitwise_and_mixed(tmp_path):
+    """End to end over real TCP: ranks -> two TelemetryAgents -> driver
+    ``host_metrics``; plus one straggler rank pushing DIRECT via the flat
+    ``metrics`` path. pod_metrics must bitwise-equal the flat merge of
+    all snapshots — covered ranks are not double-counted even when the
+    same rank ALSO pushed directly."""
+    from horovod_tpu.runner.network import BasicClient
+    from horovod_tpu.runner.service import DriverService
+
+    world = 5
+    snaps = {r: _snap(r, tick=2) for r in range(world)}
+    driver = DriverService(world, KEY)
+    agents, rcs = [], []
+    try:
+        for h, ranks in enumerate([(0, 1), (2, 3)]):
+            ag = TelemetryAgent(KEY, host_name=f"h{h}", flight_dir="",
+                                trace_dir="", interval_s=1.0,
+                                expected_ranks=ranks,
+                                reg=MetricsRegistry())
+            ag.attach_root([(LOOP, driver.port)], probe_rounds=2,
+                           start_loop=False)
+            agents.append(ag)
+            for r in ranks:
+                rc = RankTelemetryClient([(LOOP, ag.port)], KEY, r)
+                rc.push(snaps[r])
+                rcs.append(rc)
+            ag.push_to_root_once()
+        # rank 4 is tree-less (no leader on its host): direct flat push
+        c = BasicClient([(LOOP, driver.port)], KEY, timeout=10.0)
+        c.request({"kind": "metrics", "rank": 4, "snapshot": snaps[4]})
+        # rank 0 ALSO pushes directly (e.g. final result payload):
+        # covered by host h0's partial, must not be double-counted
+        c.request({"kind": "metrics", "rank": 0, "snapshot": snaps[0]})
+        c.close()
+        pod = driver.pod_metrics()
+        flat = merge_snapshots([snaps[r] for r in range(world)])
+        assert json.dumps(pod, sort_keys=True) == \
+            json.dumps(flat, sort_keys=True)
+        assert pod["ranks"] == world and pod["ranks_reporting"] == world
+        # second tick: the leader->root hop is delta-compressed
+        snaps2 = {r: _snap(r, tick=3) for r in range(world)}
+        for rc in rcs:
+            rc.push(snaps2[rc.rank])
+        for ag in agents:
+            ag.push_to_root_once()
+            assert ag._root_seq == 2
+        st = driver.telemetry_root().staleness()
+        assert sorted(st) == ["h0", "h1"]
+        assert st["h0"]["expected"] == [0, 1]
+    finally:
+        for rc in rcs:
+            rc.close()
+        for ag in agents:
+            ag.stop()
+        driver.stop()
+
+
+def test_elastic_membership_prunes_telemetry_hosts():
+    """A generation formed without a host must forget that host's partial
+    and its staleness gauge (no spurious telemetry_lag on a host that
+    legitimately left)."""
+    from horovod_tpu.runner.service import ElasticDriverService
+
+    drv = ElasticDriverService(KEY)
+    try:
+        root = drv.telemetry_root()
+        for host in ("hGone", "hStays"):
+            root.ingest({"host": host, "seq": 0, "full": True,
+                         "body": lift_snapshot(0, _snap(0)),
+                         "interval_s": 1.0})
+        root.publish()
+        assert root.reg.remove("x_not_there") is False
+        drv.begin_reset({0, 1})
+        for i in (0, 1):
+            drv.handle({"kind": "register", "index": i,
+                        "host_hash": "hStays",
+                        "addresses": [(LOOP, 1)], "coord_port": 1,
+                        "jax_coord_port": 2}, None)
+        assert drv.generation == 1
+        assert root.hosts() == ["hStays"]
+        gauges = root.reg.snapshot()["gauges"]
+        assert 'horovod_telemetry_snapshot_age_ticks{host="hGone"}' \
+            not in gauges
+        assert 'horovod_telemetry_snapshot_age_ticks{host="hStays"}' \
+            in gauges
+    finally:
+        drv.stop()
+
+
+# --------------------------------------------------- telemetry_lag
+
+
+def test_telemetry_lag_fires_names_host_and_clears():
+    reg = MetricsRegistry()
+    clock = [50.0]
+    root = RootAggregator(interval_s=0.5, reg=reg, now=lambda: clock[0])
+
+    class _NullFlight:
+        def event(self, *a, **k):
+            pass
+
+        def dump(self, *a, **k):
+            return ""
+
+    det = AnomalyDetector(reg=reg, cooldown_s=1e9, flight=_NullFlight())
+    root.ingest({"host": "hFresh", "seq": 0, "full": True,
+                 "body": lift_snapshot(0, _snap(0)), "interval_s": 0.5})
+    root.ingest({"host": "hDead", "seq": 0, "full": True,
+                 "body": lift_snapshot(1, _snap(1)), "interval_s": 0.5})
+    root.publish()
+    assert det.tick() == []   # both fresh
+    clock[0] += (TELEMETRY_LAG_TICKS + 1) * 0.5
+    root.ingest({"host": "hFresh", "seq": 1, "full": False,
+                 "body": snapshot_delta(lift_snapshot(0, _snap(0)),
+                                        lift_snapshot(0, _snap(0, 2))),
+                 "interval_s": 0.5})
+    assert "telemetry_lag" in det.tick()
+    ev = det.history[-1]
+    assert ev["hosts"] == ["hDead"] and ev["threshold_ticks"] == \
+        TELEMETRY_LAG_TICKS
+    assert ev["max_age_ticks"] > TELEMETRY_LAG_TICKS
+    assert reg.counter("horovod_anomaly_total",
+                       kind="telemetry_lag").value == 1
+    # the host leaves membership: its gauge goes with it, no refire
+    root.forget_host("hDead")
+    det2 = AnomalyDetector(reg=reg, cooldown_s=1e9, flight=_NullFlight())
+    root.publish()
+    assert "telemetry_lag" not in det2.tick()
+    gauges = reg.snapshot()["gauges"]
+    assert 'horovod_telemetry_snapshot_age_ticks{host="hDead"}' \
+        not in gauges
+
+
+# --------------------------------------------------- exposition
+
+
+def test_metrics_http_host_view():
+    from horovod_tpu.metrics.exposition import MetricsServer
+
+    reg = MetricsRegistry()
+    reg.counter("horovod_local_total").inc(2)
+    view = {"box": None}
+    srv = MetricsServer(0, reg=reg, host_view=lambda: view["box"])
+    plain = MetricsServer(0, reg=reg)
+    try:
+        url = f"http://{LOOP}:{srv.port}/metrics.json"
+        # leader with no pushes yet: 503, a scraper should retry
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "?host=1")
+        assert ei.value.code == 503
+        view["box"] = finalize_partial(
+            merge_partials([lift_snapshot(r, _snap(r))
+                            for r in range(3)]))
+        doc = json.loads(urllib.request.urlopen(
+            url + "?host=1").read())
+        assert doc["schema"] == "horovod_tpu.metrics.pod.v1"
+        assert doc["ranks_reporting"] == 3
+        # the un-suffixed path still serves the PROCESS view
+        doc2 = json.loads(urllib.request.urlopen(url).read())
+        assert doc2["schema"] == "horovod_tpu.metrics.v1"
+        # a non-leader exposes no host view: 404 names the reason
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{LOOP}:{plain.port}/metrics.json?host=1")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+        plain.stop()
+
+
+# --------------------------------------------------- bundle sweeps
+
+
+def test_bundle_leader_sweep_names_gaps(tmp_path):
+    from horovod_tpu.tracing.bundle import make_bundle
+    from horovod_tpu.tracing.flight import FlightRecorder
+
+    fdir = tmp_path / "flight"
+    tdir = tmp_path / "trace"
+    fdir.mkdir()
+    tdir.mkdir()
+    fr = FlightRecorder("rank0", flight_dir=str(fdir))
+    fr.event("replica_death", replica=9, pid=1, state_was="up",
+             reason="test")
+    fr.close()
+    # a torn ring: decode must FAIL NAMED, not vanish
+    (fdir / "flight-rank1.ring").write_bytes(b"HVDFLT1\ngarbage")
+    (tdir / "spans-rank0.jsonl").write_text(
+        json.dumps({"meta": 1, "rank": 0, "clock_offset_ns": 0}) + "\n" +
+        json.dumps({"tid": "t#1", "rank": 0, "name": "g", "op": "ar",
+                    "phase": "enqueue", "t0": 10, "t1": 20}) + "\n")
+    ag = TelemetryAgent(KEY, host_name="hSwept", flight_dir=str(fdir),
+                        trace_dir=str(tdir), interval_s=100.0,
+                        expected_ranks=(0, 1), reg=MetricsRegistry())
+    rc = RankTelemetryClient([(LOOP, ag.port)], KEY, 0)
+    rc.push(_snap(0))
+    try:
+        out = tmp_path / "bundle"
+        summary = make_bundle(
+            str(out),
+            leaders=[f"{LOOP}:{ag.port}", f"{LOOP}:1"],   # :1 unreachable
+            leader_key=KEY)
+        manifest = (out / "MANIFEST.md").read_text()
+        assert "## Pod coverage" in manifest
+        # expected rank 1 never pushed -> partial, NAMED
+        assert "| hSwept | partial |" in manifest
+        assert "ranks [1] never pushed" in manifest
+        # the dead leader is named unreachable
+        assert f"| {LOOP}:1 | unreachable |" in manifest
+        assert summary["coverage_gaps"] == ["hSwept", f"{LOOP}:1"]
+        # the torn ring decode failure is NAMED with its host
+        assert summary["flight_decode_failures"] == 1
+        assert "flight-rank1.ring" in manifest and "hSwept" in manifest
+        # the good ring's replica_death surfaced in the Verdict
+        assert "replica 9 died" in manifest
+        # swept spans built a merged trace
+        trace = json.loads((out / "trace.json").read_text())
+        assert any(e.get("ph") == "X" for e in trace["traceEvents"])
+    finally:
+        rc.close()
+        ag.stop()
+
+
+# --------------------------------------------------- event plumbing
+
+
+def test_watchdog_event_sink_receives_stall():
+    from horovod_tpu.metrics.watchdog import StallWatchdog
+
+    from horovod_tpu.metrics.watchdog import StallInfo
+
+    reg = MetricsRegistry()
+    got = []
+    wd = StallWatchdog(check_time_s=0.05, rank=3, reg=reg,
+                       event_sink=got.append)
+    try:
+        wd.add_source(lambda: [StallInfo(name="grad0", op="allreduce",
+                                         age_s=1.0)])
+        deadline = time.monotonic() + 5.0
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert got, "stall never reached the event sink"
+        ev = got[0]
+        assert ev["kind"] == "stall" and ev["rank"] == 3
+        assert ev["stalled"][0]["name"] == "grad0"
+    finally:
+        wd.stop()
+
+
+def test_service_stats_count_wire_bytes():
+    from horovod_tpu.runner.network import BasicClient, BasicService
+
+    class Echo(BasicService):
+        def handle(self, req, client_addr):
+            return {"ok": True, "echo": req.get("x")}
+
+    svc = Echo(KEY)
+    try:
+        c = BasicClient([(LOOP, svc.port)], KEY, timeout=10.0)
+        for i in range(3):
+            assert c.request({"kind": "e", "x": i})["echo"] == i
+        c.close()
+        deadline = time.monotonic() + 2.0
+        while svc.stats()["requests_total"] < 3 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        st = svc.stats()
+        assert st["connections_total"] == 1
+        assert st["requests_total"] == 3
+        # every frame costs 32B MAC + 8B length + payload (+ handshake)
+        assert st["bytes_in"] > 3 * 40 and st["bytes_out"] > 3 * 40
+    finally:
+        svc.stop()
+
+
+def test_tree_plan_and_interval_knob(monkeypatch):
+    plan = plan_tree(["hB", "hB", "hA", "hA", "hA"])
+    assert plan.hosts == ("hA", "hB")     # sorted, like rank assignment
+    assert plan.leader_of == {"hA": 2, "hB": 0}
+    assert plan.leader_for(4) == 2 and plan.leader_for(1) == 0
+    assert plan.is_leader(2) and not plan.is_leader(3)
+    assert plan.num_hosts == 2
+    with pytest.raises(KeyError):
+        plan.host_of(99)
+    monkeypatch.setenv("HOROVOD_TELEMETRY_INTERVAL_S", "2.5")
+    assert interval_s_from_env() == 2.5
+    monkeypatch.setenv("HOROVOD_TELEMETRY_INTERVAL_S", "0.0001")
+    assert interval_s_from_env() == 0.05   # floored, cannot busy-spin
+    monkeypatch.setenv("HOROVOD_TELEMETRY_INTERVAL_S", "bogus")
+    assert interval_s_from_env() == 1.0

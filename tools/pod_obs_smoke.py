@@ -1,0 +1,372 @@
+#!/usr/bin/env python
+"""CI smoke for the pod-scale telemetry tree (ISSUE 17; ci.sh).
+
+Simulated 8-host x 8-rank grid (world 64): per-host TelemetryAgents (the
+leaders a runner HostAgent would host), one REAL subprocess rank per host
+with its own flight ring + span file + delta pushes, the remaining ranks
+in-process. Proves the pod-scale debuggability contract end to end:
+
+1.  fan-in leg: 64 ranks' snapshots reach the driver through 8 leaders as
+    delta-compressed host partials; the root sees O(hosts) connections
+    and the merged pod view covers every rank BITWISE identically to the
+    flat merge of the same snapshots.
+2.  clock leg: a rank's composed offset (rank->leader + leader->root,
+    tracing/clock.py compose_offsets) stays sane on loopback — tight
+    error bound, near-zero offset.
+3.  SIGKILL leg: the subprocess rank on one host dies mid-run; its host
+    leader's coverage goes stale for that rank while the host partial
+    keeps serving the survivors.
+4.  telemetry_lag leg: one host's leader stops pushing; its root-side
+    snapshot age crosses TELEMETRY_LAG_TICKS collection intervals and the
+    anomaly detector must fire ``telemetry_lag`` NAMING that host.
+5.  bundle leg: one command (``python -m horovod_tpu.tracing.bundle
+    --leader ...``) sweeps flight rings and spans host-by-host through
+    the leaders; the MANIFEST's Pod coverage section names the dead
+    rank's host as partial (which rank, why) and a deliberately
+    unreachable leader as unreachable; the dead rank's mmap ring decode
+    is IN the bundle; the merged trace parses strictly.
+6.  gate leg: root ingest bytes per collection tick, flat fan-in vs tree
+    (same snapshot stream, same wire) — emitted as
+    ``pod_obs_root_byte_reduction`` and gated >= 6x in ci.sh.
+
+Exits non-zero with a reason on any violation. Wall-clock budget ~45 s.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+HOSTS = 8
+PER_HOST = 8
+WORLD = HOSTS * PER_HOST
+INTERVAL_S = 0.25
+DEAD_HOST = 3            # its subprocess rank gets SIGKILL'd
+SILENT_HOST = 6          # its leader stops pushing -> telemetry_lag
+UNREACHABLE_HOST = 7     # its leader is stopped before the bundle sweep
+
+
+def fail(msg: str) -> None:
+    print(f"pod obs smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def check(ok: bool, msg: str) -> None:
+    if not ok:
+        fail(msg)
+    print(f"  ok: {msg}")
+
+
+def worker_main() -> int:
+    """One real rank: flight ring + span file + telemetry pushes every
+    150 ms until killed. Its ring and spans must survive SIGKILL and
+    reach the bundle through the host leader's sweep."""
+    rank = int(os.environ["HVD_POD_OBS_RANK"])
+    port = int(os.environ["HVD_POD_OBS_AGENT_PORT"])
+    key = bytes.fromhex(os.environ["HVD_POD_OBS_KEY"])
+    from horovod_tpu.metrics import registry
+    from horovod_tpu.telemetry.agent import RankTelemetryClient
+    from horovod_tpu.tracing.flight import init_flight
+    from horovod_tpu.tracing.recorder import TraceRecorder, span_path
+
+    fr = init_flight(f"rank{rank}")
+    rc = RankTelemetryClient([("127.0.0.1", port)], key, rank)
+    off, err = rc.composed_clock_offset(rounds=4)
+    # line-buffered: a SIGKILL must not eat the spans already recorded
+    rec = TraceRecorder(
+        span_path(os.environ["HOROVOD_TRACE_DIR"], rank), rank,
+        clock_offset_ns=off, buffering=1)
+    reg = registry()
+    steps = reg.counter("horovod_pod_obs_worker_steps_total",
+                        help="pod-obs smoke worker heartbeat")
+    print(json.dumps({"worker": "ready", "rank": rank, "pid": os.getpid(),
+                      "clock_offset_ns": off, "clock_error_ns": err}),
+          flush=True)
+    n = 0
+    while True:
+        n += 1
+        steps.inc()
+        t0 = rec.now_ns()
+        time.sleep(0.01)
+        rec.span(f"pod-obs#{n}", f"grad/{rank}", "allreduce", "enqueue",
+                 t0, rec.now_ns())
+        fr.event("heartbeat", rank=rank, n=n)
+        try:
+            rc.push()
+        except Exception:
+            pass
+        time.sleep(0.15)
+    return 0
+
+
+def measure_flat_arm(snaps_by_tick: list) -> float:
+    """Replay the same per-tick snapshot stream through the pre-tree flat
+    path (every rank -> root, full snapshots) and return root ingest
+    bytes per steady-state tick."""
+    import secrets
+
+    from horovod_tpu.runner.network import BasicClient
+    from horovod_tpu.runner.service import DriverService
+
+    key = secrets.token_bytes(32)
+    root = DriverService(WORLD, key)
+    clients = [BasicClient([("127.0.0.1", root.port)], key, timeout=30.0)
+               for _ in range(WORLD)]
+    try:
+        base = None
+        for t, snaps in enumerate(snaps_by_tick):
+            if t == 1:
+                time.sleep(0.1)
+                base = root.stats()["bytes_in"]
+            for r, c in enumerate(clients):
+                c.request({"kind": "metrics", "rank": r,
+                           "snapshot": snaps[r]})
+        time.sleep(0.1)
+        return (root.stats()["bytes_in"] - base) / (len(snaps_by_tick) - 1)
+    finally:
+        for c in clients:
+            c.close()
+        root.stop()
+
+
+def main() -> int:
+    if "--worker" in sys.argv:
+        return worker_main()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import secrets
+
+    from bench import _synth_snapshot
+    from horovod_tpu.metrics import registry
+    from horovod_tpu.metrics.anomaly import (TELEMETRY_LAG_TICKS,
+                                             AnomalyDetector)
+    from horovod_tpu.runner.service import DriverService
+    from horovod_tpu.telemetry.agent import (RankTelemetryClient,
+                                             TelemetryAgent)
+
+    t_start = time.monotonic()
+    key = secrets.token_bytes(32)
+    tmp = tempfile.mkdtemp(prefix="hvd-pod-obs-")
+    registry().reset()
+
+    print(f"== pod obs smoke: {HOSTS} hosts x {PER_HOST} ranks, "
+          f"interval {INTERVAL_S}s ==")
+    root = DriverService(WORLD, key)
+    agents: list = []
+    in_proc: list = []
+    workers: list = []
+    try:
+        for h in range(HOSTS):
+            fdir = os.path.join(tmp, f"host-{h:02d}", "flight")
+            tdir = os.path.join(tmp, f"host-{h:02d}", "trace")
+            os.makedirs(fdir)
+            os.makedirs(tdir)
+            ag = TelemetryAgent(
+                key, host_name=f"host-{h:02d}", flight_dir=fdir,
+                trace_dir=tdir, interval_s=INTERVAL_S,
+                expected_ranks=range(h * PER_HOST, (h + 1) * PER_HOST))
+            ag.attach_root([("127.0.0.1", root.port)], probe_rounds=2,
+                           start_loop=False)
+            agents.append(ag)
+            # one REAL subprocess rank per host (the lowest), with its own
+            # flight ring + span file; the rest in-process
+            env = dict(os.environ,
+                       HVD_POD_OBS_RANK=str(h * PER_HOST),
+                       HVD_POD_OBS_AGENT_PORT=str(ag.port),
+                       HVD_POD_OBS_KEY=key.hex(),
+                       HOROVOD_FLIGHT_DIR=fdir, HOROVOD_TRACE_DIR=tdir)
+            p = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                env=env, stdout=subprocess.PIPE, text=True)
+            ready = json.loads(p.stdout.readline())
+            workers.append((p, ready))
+            for r in range(h * PER_HOST + 1, (h + 1) * PER_HOST):
+                in_proc.append(RankTelemetryClient(
+                    [("127.0.0.1", ag.port)], key, r))
+
+        # -- clock leg -------------------------------------------------------
+        off, err = in_proc[0].composed_clock_offset(rounds=4)
+        check(err > 0 and abs(off) < 0.2e9,
+              f"composed rank->leader->root clock offset sane on loopback "
+              f"(offset {off / 1e6:.3f} ms, error bound {err / 1e6:.3f} ms)")
+        worker_offs = [w[1]["clock_offset_ns"] for w in workers]
+        check(all(abs(o) < 0.2e9 for o in worker_offs),
+              f"all {len(workers)} subprocess ranks composed an offset "
+              f"through their leader (max |off| "
+              f"{max(abs(o) for o in worker_offs) / 1e6:.3f} ms)")
+
+        # -- fan-in leg: ticks with byte accounting --------------------------
+        ticks = 4
+        snaps_by_tick = []
+        steady0 = None
+        for t in range(1, ticks + 1):
+            if t == 2:
+                time.sleep(0.1)
+                steady0 = root.stats()["bytes_in"]
+            snaps = {}
+            for rc in in_proc:
+                snaps[rc.rank] = _synth_snapshot(rc.rank, t)
+                rc.push(snaps[rc.rank])
+            snaps_by_tick.append(snaps)
+            for ag in agents:
+                ag.push_to_root_once()
+            time.sleep(INTERVAL_S / 2)
+        time.sleep(0.1)
+        tree_per_tick = (root.stats()["bytes_in"] - steady0) / (ticks - 1)
+        conns = root.stats()["connections_total"]
+        check(conns == HOSTS,
+              f"root connections are O(hosts): {conns} == {HOSTS} "
+              f"for world {WORLD}")
+
+        pod = root.pod_metrics()
+        check(pod is not None and pod["ranks"] == WORLD
+              and pod["ranks_reporting"] == WORLD,
+              f"pod view covers every rank through the tree "
+              f"({pod['ranks_reporting']}/{pod['ranks']} reporting)")
+        check(pod["counters"].get("horovod_pod_obs_worker_steps_total",
+                                  0) >= HOSTS,
+              "subprocess ranks' real registry snapshots reached the root "
+              "through their leaders")
+
+        # hierarchical == flat, bitwise, on the in-process cohort
+        from horovod_tpu.metrics.aggregate import merge_snapshots
+        cohort = sorted(snaps_by_tick[-1])
+        flat_merge = merge_snapshots(
+            [snaps_by_tick[-1][r] for r in cohort])
+        tree_parts = [ag.handle({"kind": "host_metrics"}, None)["partial"]
+                      for ag in agents]
+        from horovod_tpu.metrics.aggregate import (finalize_partial,
+                                                   merge_partials)
+        tree_all = finalize_partial(merge_partials(tree_parts))
+        tree_cohort_counters = {
+            k: v for k, v in tree_all["counters"].items()
+            if k in flat_merge["counters"]}
+        check(tree_cohort_counters == flat_merge["counters"],
+              "host-then-root merge is bitwise identical to the flat "
+              "merge on the shared snapshot stream")
+
+        # -- SIGKILL leg -----------------------------------------------------
+        dead_rank = DEAD_HOST * PER_HOST
+        dead_pid = workers[DEAD_HOST][1]["pid"]
+        os.kill(dead_pid, signal.SIGKILL)
+        workers[DEAD_HOST][0].wait(timeout=10)
+        print(f"  SIGKILL'd rank {dead_rank} (pid {dead_pid}) on "
+              f"host-{DEAD_HOST:02d}")
+
+        # -- telemetry_lag leg: host leader goes silent ----------------------
+        silent_ticks = TELEMETRY_LAG_TICKS + 2
+        for t in range(ticks + 1, ticks + 1 + silent_ticks):
+            for rc in in_proc:
+                rc.push(_synth_snapshot(rc.rank, t))
+            for h, ag in enumerate(agents):
+                if h != SILENT_HOST:
+                    ag.push_to_root_once()
+            time.sleep(INTERVAL_S)
+        root.pod_metrics()   # readers refresh the staleness gauges
+        det = AnomalyDetector(reg=registry(), cooldown_s=0.1)
+        fired = det.tick()
+        check("telemetry_lag" in fired,
+              f"telemetry_lag fired after host-{SILENT_HOST:02d}'s leader "
+              f"went silent > {TELEMETRY_LAG_TICKS} intervals")
+        ev = next(e for e in det.history if e["kind"] == "telemetry_lag")
+        check(f"host-{SILENT_HOST:02d}" in ev["hosts"],
+              f"the anomaly NAMES the silent host: {ev['hosts']} "
+              f"(max age {ev['max_age_ticks']} ticks)")
+        lag_c = registry().counter("horovod_anomaly_total",
+                                   kind="telemetry_lag")
+        check(lag_c.value >= 1, "horovod_anomaly_total{kind=telemetry_lag} "
+                                "counted the firing")
+
+        # -- bundle leg ------------------------------------------------------
+        # Background push loops keep the SURVIVORS fresh while the bundle
+        # runs (the steady-state regime) — the only stale rank a sweep may
+        # see is the SIGKILL'd one.
+        for rc in in_proc:
+            rc.start()
+        agents[UNREACHABLE_HOST].stop()
+        out = os.path.join(tmp, "bundle")
+        leaders = []
+        for ag in agents:
+            leaders += ["--leader", f"127.0.0.1:{ag.port}"]
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.tracing.bundle",
+             "-o", out, "--leader-key", key.hex()] + leaders,
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+            env=dict(os.environ, HOROVOD_TRACE_DIR="",
+                     HOROVOD_FLIGHT_DIR=""))
+        bundle_s = time.monotonic() - t0
+        check(proc.returncode == 0,
+              f"one-command bundle through the leaders exits 0 in "
+              f"{bundle_s:.2f}s (stderr: {proc.stderr[-200:]!r})")
+        manifest = open(os.path.join(out, "MANIFEST.md")).read()
+        check("## Pod coverage" in manifest,
+              "MANIFEST has the Pod coverage section")
+        dead_row = next((ln for ln in manifest.splitlines()
+                         if ln.startswith(f"| host-{DEAD_HOST:02d} ")), "")
+        check("partial" in dead_row and f"[{dead_rank}]" in dead_row,
+              f"dead rank's host named with EXACTLY the dead rank's gap: "
+              f"{dead_row.strip()!r}")
+        check(manifest.count("| unreachable |") == 1,
+              "the stopped leader is named unreachable (exactly one)")
+        ring_name = f"host-{DEAD_HOST:02d}-flight-rank{dead_rank}.ring.json"
+        ring_doc = json.load(open(os.path.join(out, "flight", ring_name)))
+        check(any(r.get("flight_event") == "heartbeat"
+                  for r in ring_doc["records"]),
+              f"SIGKILL'd rank's mmap ring decode is in the bundle "
+              f"({ring_name}, {len(ring_doc['records'])} records)")
+        trace = json.load(open(os.path.join(out, "trace.json")))
+        evs = trace["traceEvents"]
+        check(evs and all(e["ph"] in ("X", "i", "M") for e in evs)
+              and any(e.get("pid") == dead_rank and e["ph"] == "X"
+                      for e in evs),
+              f"merged trace is strict and carries the dead rank's spans "
+              f"({len(evs)} events)")
+
+        # -- gate leg --------------------------------------------------------
+        flat_per_tick = measure_flat_arm(
+            [[s[r] if r in s else _synth_snapshot(r, t + 1)
+              for r in range(WORLD)]
+             for t, s in enumerate(snaps_by_tick)])
+        reduction = flat_per_tick / max(tree_per_tick, 1.0)
+        check(reduction >= 6.0,
+              f"root ingest bytes per tick: flat {flat_per_tick:.0f} vs "
+              f"tree {tree_per_tick:.0f} -> {reduction:.1f}x reduction")
+        print(json.dumps({
+            "metric": "pod_obs_root_byte_reduction",
+            "value": round(reduction, 2), "unit": "x",
+            "world": WORLD, "hosts": HOSTS,
+            "flat_root_bytes_per_tick": round(flat_per_tick),
+            "tree_root_bytes_per_tick": round(tree_per_tick),
+            "root_connections": conns,
+            "bundle_wall_clock_s": round(bundle_s, 2),
+            "elapsed_s": round(time.monotonic() - t_start, 1),
+        }), flush=True)
+        print("pod obs smoke PASSED")
+        return 0
+    finally:
+        for rc in in_proc:
+            try:
+                rc.close()
+            except Exception:
+                pass
+        for p, _ in workers:
+            if p.poll() is None:
+                p.kill()
+        for ag in agents:
+            try:
+                ag.stop()
+            except Exception:
+                pass
+        root.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
