@@ -1862,6 +1862,180 @@ def telemetry_scale_main() -> None:
     budget.emit(out)
 
 
+def _control_scale_once(world: int, hosts: int, poll_rounds: int) -> dict:
+    """One grid size of the --control-scale A/B.
+
+    FLAT arm: ``world`` workers each speak the runner control protocol —
+    register, wait_assignment, commit-time elastic_poll — straight to the
+    driver (pre-tree TaskAgent path). TREE arm: each host's ranks speak
+    the SAME protocol to their host's ControlAgent, which batches
+    registrations (``host_register``), groups assignment waits
+    (``host_wait_assignment``) and caches poll verdicts
+    (``host_elastic_poll``), so the root sees O(hosts) connections and
+    bytes. Both arms run the same three phases on the same HMAC-framed
+    wire: cold rendezvous at full world, ``poll_rounds`` of commit-time
+    membership polls, then an elastic reset with one member dropped."""
+    import secrets
+    import threading
+
+    from horovod_tpu.ctrl.agent import ControlAgent
+    from horovod_tpu.runner.network import BasicClient
+    from horovod_tpu.runner.service import ElasticDriverService
+
+    key = secrets.token_bytes(32)
+    per_host = world // hosts
+
+    def settle(svc):
+        deadline = time.monotonic() + 2.0
+        last = -1
+        while time.monotonic() < deadline:
+            cur = svc.stats()["requests_total"]
+            if cur == last:
+                break
+            last = cur
+            time.sleep(0.02)
+        return svc.stats()
+
+    def ctrl_bytes(st):
+        return st["bytes_in"] + st["bytes_out"]
+
+    def reg_req(i):
+        return {"kind": "register", "index": i,
+                "host_hash": f"host-{i // per_host:02d}",
+                "addresses": [("127.0.0.1", 40000 + i)],
+                "coord_port": 40000 + i, "jax_coord_port": 41000 + i}
+
+    def rendezvous(pairs, min_gen):
+        """All (index, client) pairs register + wait for an assignment in
+        generation ``min_gen``; returns the full-world wall clock."""
+        errs = []
+
+        def one(i, c):
+            c.request(reg_req(i))
+            r = c.request({"kind": "wait_assignment", "index": i,
+                           "min_generation": min_gen, "timeout": 120.0})
+            if not (isinstance(r, dict) and r.get("ok")):
+                errs.append((i, r))
+
+        threads = [threading.Thread(target=one, args=p, daemon=True)
+                   for p in pairs]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(150.0)
+        if errs:
+            raise RuntimeError(f"rendezvous failed: {errs[:3]}")
+        return time.monotonic() - t0
+
+    def run_arm(tree: bool) -> dict:
+        root = ElasticDriverService(key)
+        agents, clients = [], []
+        conn_base = root.stats()["connections_total"]
+        try:
+            if tree:
+                for h in range(hosts):
+                    ag = ControlAgent(key, host_name=f"host-{h:02d}",
+                                      batch_s=0.005, poll_s=30.0)
+                    ag.attach_root([("127.0.0.1", root.port)])
+                    agents.append(ag)
+                addr = lambda i: ("127.0.0.1", agents[i // per_host].port)  # noqa: E731
+            else:
+                addr = lambda i: ("127.0.0.1", root.port)  # noqa: E731
+            clients = [BasicClient([addr(i)], key, timeout=150.0)
+                       for i in range(world)]
+            base = settle(root)
+
+            root.begin_reset(set(range(world)))
+            rendezvous_s = rendezvous(list(enumerate(clients)), 1)
+            st1 = settle(root)
+
+            # the commit-time steady state: every rank polls membership and
+            # aligns its trace clock each round — the tree answers the
+            # probe on-host and the poll from the per-host verdict cache
+            for _ in range(poll_rounds):
+                for i, c in enumerate(clients):
+                    r = c.request({"kind": "elastic_poll", "index": i,
+                                   "generation": 1})
+                    if not r.get("ok") or r.get("reset_required"):
+                        raise RuntimeError(f"bad poll verdict for {i}: {r}")
+                    p = c.request({"kind": "clock_probe"})
+                    if not p.get("ok"):
+                        raise RuntimeError(f"clock probe failed for {i}: {p}")
+            st2 = settle(root)
+
+            # drop the last member; survivors re-rendezvous as generation 2
+            root.begin_reset(set(range(world - 1)))
+            reset_s = rendezvous(list(enumerate(clients))[:world - 1], 2)
+            st3 = settle(root)
+            return {
+                "rendezvous_s": round(rendezvous_s, 3),
+                "reset_s": round(reset_s, 3),
+                "rendezvous_bytes": ctrl_bytes(st1) - ctrl_bytes(base),
+                "poll_bytes_per_round": round(
+                    (ctrl_bytes(st2) - ctrl_bytes(st1)) / poll_rounds),
+                "reset_bytes": ctrl_bytes(st3) - ctrl_bytes(st2),
+                "total_bytes": ctrl_bytes(st3) - ctrl_bytes(base),
+                "root_connections": st3["connections_total"] - conn_base,
+            }
+        finally:
+            for c in clients:
+                c.close()
+            for ag in agents:
+                ag.stop()
+            root.stop()
+
+    flat = run_arm(tree=False)
+    tree = run_arm(tree=True)
+    return {
+        "world": world, "hosts": hosts, "poll_rounds": poll_rounds,
+        "flat": flat, "tree": tree,
+        "root_byte_reduction": round(
+            flat["total_bytes"] / max(tree["total_bytes"], 1), 2),
+        "root_connection_reduction": round(
+            flat["root_connections"] / max(tree["root_connections"], 1), 2),
+        "rendezvous_speedup": round(
+            flat["rendezvous_s"] / max(tree["rendezvous_s"], 1e-9), 2),
+        "reset_speedup": round(
+            flat["reset_s"] / max(tree["reset_s"], 1e-9), 2),
+    }
+
+
+def control_scale_main() -> None:
+    """bench.py --control-scale: measure the control tree's root-side cost
+    against the flat O(world) runner plane, at world 64 (8 hosts x 8
+    ranks) and 128 (16 x 8). Headline: root control bytes across one
+    cold rendezvous + steady-state polls + one elastic reset, flat /
+    tree — gated in ci.sh at >= 6x. Latency rides along: tree
+    rendezvous and elastic reset wall clock must not regress. Pure
+    control-plane loopback TCP; runs before any jax import."""
+    budget = _Budget.install("control_scale_root_byte_reduction", "x")
+    poll_rounds = int(os.environ.get("HVD_CTRL_POLL_ROUNDS", "") or
+                      ("3" if _smoke_on() else "6"))
+    grids = [(64, 8)]
+    if not _smoke_on():
+        grids.append((128, 16))
+    out = {"metric": "control_scale_root_byte_reduction", "value": 0.0,
+           "unit": "x", "smoke": _smoke_on(), "grids": []}
+    try:
+        for world, hosts in grids:
+            if budget.skip_if_low(f"grid-{world}", 60):
+                break
+            budget.stage(f"grid-{world}")
+            out["grids"].append(_control_scale_once(world, hosts, poll_rounds))
+    except Exception as e:  # noqa: BLE001 - partial beats silent (contract)
+        out.update({"partial": True, "reason": f"{type(e).__name__}: {e}"})
+        budget.emit(out)
+        return
+    g64 = next((g for g in out["grids"] if g["world"] == 64), None)
+    if g64 is not None:
+        out["value"] = g64["root_byte_reduction"]
+        out["root_connection_reduction"] = g64["root_connection_reduction"]
+        out["tree_rendezvous_s"] = g64["tree"]["rendezvous_s"]
+        out["tree_reset_s"] = g64["tree"]["reset_s"]
+    budget.emit(out)
+
+
 def main() -> None:
     if "--eager-worker" in sys.argv:
         return eager_worker_main()
@@ -1873,6 +2047,8 @@ def main() -> None:
         return hier_ab_main()
     if "--telemetry-scale" in sys.argv:
         return telemetry_scale_main()
+    if "--control-scale" in sys.argv:
+        return control_scale_main()
 
     # Arm the watchdog BEFORE the first jax import: on a degraded platform
     # backend init itself can wedge (the BENCH_r05 signature), and the

@@ -152,6 +152,21 @@ python tools/perf_gate.py --current /tmp/hvd_controller_ab.log \
   --require-metric controller_convergence_ratio \
   --min-abs controller_convergence_ratio=0.90 --allow-missing-baseline
 
+echo "== ctrl smoke (ISSUE 18 control tree + async checkpoints: 8-host x 8-rank grid rendezvous through per-host control leaders with O(hosts) root connections, one rank SIGKILL'd AND one leader killed mid-run folded into exactly one elastic reset, survivors resume from the background async commit, the joiner host cold-starts by streaming the committed checkpoint bitwise-identically from a surviving leader, root control bytes gated >= 6x under flat replay) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/ctrl_smoke.py | tee /tmp/hvd_ctrl_smoke.log
+python tools/perf_gate.py --current /tmp/hvd_ctrl_smoke.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric ctrl_smoke_root_byte_reduction \
+  --min-abs ctrl_smoke_root_byte_reduction=6 --allow-missing-baseline
+
+echo "== control-scale bench + gate (ISSUE 18: flat vs tree rendezvous/elastic-reset latency and root control bytes at world 64 — the byte reduction must exist and clear the 6x floor with O(hosts) root connections) =="
+HVD_BENCH_SMOKE=1 timeout -k 10 240 env JAX_PLATFORMS=cpu \
+  python bench.py --control-scale | tee /tmp/hvd_control_scale.log
+python tools/perf_gate.py --current /tmp/hvd_control_scale.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric control_scale_root_byte_reduction \
+  --min-abs control_scale_root_byte_reduction=6 --allow-missing-baseline
+
 echo "== fast tier (includes the launcher e2e: test_run_happy_path) =="
 python -m pytest tests/ -m fast -q
 

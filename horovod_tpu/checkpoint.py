@@ -64,6 +64,19 @@ def _fsync_tree(path: str) -> None:
                 os.close(fd)
 
 
+def _test_pause(point: str) -> None:
+    """Deterministic kill window for the crash-consistency tests: when
+    ``HOROVOD_CKPT_TEST_STALL`` names this pipeline point (``stage`` —
+    staged copy exists but carries no ``.ok`` yet; ``rename`` — between
+    the swap's two renames, the brief no-target window), the commit holds
+    for ``HOROVOD_CKPT_TEST_STALL_S`` so the test can SIGKILL the writer
+    exactly there. No-op unless explicitly armed."""
+    if os.environ.get("HOROVOD_CKPT_TEST_STALL", "") == point:
+        import time
+
+        time.sleep(float(os.environ.get("HOROVOD_CKPT_TEST_STALL_S", "30")))
+
+
 def _heal_interrupted(target: str) -> None:
     """Adopt or discard leftovers of an interrupted commit next to
     ``target``: a complete staged copy (``.tmp.* + .ok``) replaces a missing
@@ -115,6 +128,7 @@ def _swap_into_place(tmp: str, target: str) -> None:
     trash = f"{target}.trash.{os.path.basename(tmp).rsplit('.', 1)[-1]}"
     if os.path.exists(target):
         os.rename(target, trash)
+    _test_pause("rename")
     os.rename(tmp, target)
     try:  # publish the renames before declaring the commit durable
         fd = os.open(os.path.dirname(target) or os.curdir, os.O_RDONLY)
@@ -131,6 +145,43 @@ def _swap_into_place(tmp: str, target: str) -> None:
     shutil.rmtree(trash, ignore_errors=True)
 
 
+def save_local(path: str, state: Any, step: Optional[int] = None) -> None:
+    """The single-writer commit pipeline — stage, fsync, ``.ok``, atomic
+    rename — with NO rank gate and NO completion barrier. This is the core
+    :func:`save` wraps, and what the background writer
+    (:class:`horovod_tpu.ckpt_async.AsyncCheckpointer`) runs off the step
+    path: collectives may only run on the training thread, so the async
+    writer must use the barrier-free form."""
+    import numpy as np
+
+    import jax
+
+    ocp = _ocp()
+    ckptr = ocp.StandardCheckpointer()
+    target = os.path.join(os.path.abspath(path), f"step_{step}") \
+        if step is not None else os.path.abspath(path)
+    # numpy SCALARS (np.int64(7) epoch counters and friends) are not
+    # ndarrays, and orbax's StandardCheckpointHandler rejects them on
+    # some versions ("Unsupported type: <class 'numpy.int64'>") — lift
+    # them to 0-d arrays, which restore round-trips (int() on a 0-d
+    # array works) and every orbax accepts.
+    state = jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
+        state)
+    # Crash-consistent commit (ISSUE 8): stage next to the target, make
+    # it durable, then swap with atomic renames — a worker killed
+    # mid-commit can never corrupt the restore point the elastic ladder
+    # depends on. Also adopts/cleans leftovers of a previous kill.
+    _heal_interrupted(target)
+    os.makedirs(os.path.dirname(target) or os.curdir, exist_ok=True)
+    tmp = f"{target}.tmp.{os.getpid()}"
+    ckptr.save(tmp, state, force=True)
+    ckptr.wait_until_finished()
+    _test_pause("stage")
+    _fsync_tree(tmp)
+    _swap_into_place(tmp, target)
+
+
 def save(path: str, state: Any, step: Optional[int] = None, force: bool = True) -> None:
     """Write a checkpoint from rank 0 only; other ranks return immediately
     (reference contract: 'save checkpoints only on worker 0 to prevent other
@@ -141,31 +192,7 @@ def save(path: str, state: Any, step: Optional[int] = None, force: bool = True) 
     # Uninitialized == single-process (a plain post-training export script);
     # rank 0 writes, and only a multi-rank world needs the barrier.
     if not basics.is_initialized() or basics.rank() == 0:
-        import jax
-
-        ocp = _ocp()
-        ckptr = ocp.StandardCheckpointer()
-        target = os.path.join(os.path.abspath(path), f"step_{step}") \
-            if step is not None else os.path.abspath(path)
-        # numpy SCALARS (np.int64(7) epoch counters and friends) are not
-        # ndarrays, and orbax's StandardCheckpointHandler rejects them on
-        # some versions ("Unsupported type: <class 'numpy.int64'>") — lift
-        # them to 0-d arrays, which restore round-trips (int() on a 0-d
-        # array works) and every orbax accepts.
-        state = jax.tree_util.tree_map(
-            lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
-            state)
-        # Crash-consistent commit (ISSUE 8): stage next to the target, make
-        # it durable, then swap with atomic renames — a worker killed
-        # mid-commit can never corrupt the restore point the elastic ladder
-        # depends on. Also adopts/cleans leftovers of a previous kill.
-        _heal_interrupted(target)
-        os.makedirs(os.path.dirname(target) or os.curdir, exist_ok=True)
-        tmp = f"{target}.tmp.{os.getpid()}"
-        ckptr.save(tmp, state, force=True)
-        ckptr.wait_until_finished()
-        _fsync_tree(tmp)
-        _swap_into_place(tmp, target)
+        save_local(path, state, step)
     if basics.is_initialized() and basics.size() > 1:
         # barrier: everyone waits until rank 0's save completed
         basics.engine().run("allreduce", np.zeros(1), f"ckpt.barrier.{path}.{step}")

@@ -1,0 +1,27 @@
+"""Asynchronous crash-consistent checkpointing + shard streaming (ISSUE 18).
+
+Two pieces that take the checkpoint OFF the step path and make it mobile:
+
+- :mod:`.writer` — :class:`AsyncCheckpointer`: a background committer that
+  runs the repo's crash-consistent pipeline (stage → fsync → ``.ok`` →
+  atomic rename, checkpoint.py ISSUE 8) while training continues. A step
+  blocks only when a PREVIOUS commit is still in flight; the blocked time
+  and the commit wall time are both measured
+  (``horovod_ckpt_step_block_seconds`` / ``horovod_ckpt_commit_seconds``).
+- :mod:`.stream` — checkpoint streaming: host leaders (ctrl/agent.py)
+  serve the latest committed files to elastic joiners and fresh serving
+  replicas, chunked, hash-verified, and landed with the SAME commit
+  discipline, so a fetched checkpoint is bitwise identical to a
+  filesystem restore and a kill mid-fetch can never publish a torn copy.
+
+Knobs: ``HOROVOD_CKPT_ASYNC`` (default on) gates the background writer in
+``ElasticState.commit``; ``HOROVOD_CKPT_STREAM_CHUNK_MB`` sizes fetch
+chunks; ``HOROVOD_CKPT_STREAM_FROM`` points a cold-starting process at
+peer host leaders.
+"""
+
+from .writer import AsyncCheckpointer, async_enabled
+from .stream import fetch_from_peer, serve_chunk, serve_manifest
+
+__all__ = ["AsyncCheckpointer", "async_enabled", "fetch_from_peer",
+           "serve_chunk", "serve_manifest"]

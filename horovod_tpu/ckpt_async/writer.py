@@ -1,0 +1,181 @@
+"""Background checkpoint committer (ISSUE 18).
+
+The synchronous contract (checkpoint.save inside ElasticState.commit)
+charges the FULL commit — orbax serialization, fsync walk, rename swap —
+to the training step that happened to be a checkpoint step. This module
+moves that work to a dedicated writer thread:
+
+- ``submit(state, step)`` hands the writer a snapshot BY REFERENCE and
+  returns. No copy is taken: the caller must hand over an immutable
+  snapshot it will replace, not mutate (``ElasticState._committed`` is
+  exactly that — every commit() binds a fresh deep copy, so the tree the
+  writer holds can never change under it).
+- A step blocks only when the PREVIOUS commit is still in flight — one
+  commit in the pipe, never a growing queue, so a slow filesystem applies
+  backpressure instead of accumulating unbounded snapshots. The blocked
+  wall time is observed in ``horovod_ckpt_step_block_seconds`` (the
+  step-path overhead the async design is judged on) and the commit itself
+  in ``horovod_ckpt_commit_seconds``.
+- Crash consistency is UNCHANGED: the writer calls the same
+  stage → fsync → ``.ok`` → atomic-rename pipeline (checkpoint.save), so
+  a SIGKILL at any instant leaves the old checkpoint, the new one, or an
+  adoptable staged copy — _heal_interrupted's contract.
+- A failed commit is not silent: the error is re-raised on the next
+  submit()/wait()/close() on the training thread.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ..metrics import registry as _registry
+from ..utils.logging import log
+
+
+def async_enabled() -> bool:
+    """``HOROVOD_CKPT_ASYNC`` gate, default ON (set 0/false to force the
+    synchronous writer)."""
+    return os.environ.get("HOROVOD_CKPT_ASYNC", "1") not in ("0", "false")
+
+
+# In-process writer registry: a cold start in the SAME process (elastic
+# full-restart tests, notebook restarts) must observe every commit already
+# submitted — drain(path) flushes any live writer for that directory before
+# the reader checks the filesystem. Cross-process readers need nothing: the
+# commit pipeline keeps the directory crash-consistent at every instant.
+_writers_lock = threading.Lock()
+_writers: dict[str, "AsyncCheckpointer"] = {}
+
+
+def drain(path: str, timeout: float = 120.0) -> bool:
+    """Flush any in-process async writer targeting ``path``. True when no
+    writer exists or it drained in time."""
+    with _writers_lock:
+        writer = _writers.get(os.path.abspath(path))
+    return True if writer is None else writer.wait(timeout)
+
+
+class AsyncCheckpointer:
+    """One background writer; at most one commit in flight."""
+
+    def __init__(self, path: str,
+                 save_fn: Optional[Callable[..., None]] = None) -> None:
+        self.path = path
+        if save_fn is None:
+            from .. import checkpoint as _ckpt
+
+            # Plain single-writer save: the engine barrier inside the
+            # collective save() must NOT run on this thread (collectives
+            # belong to the training thread), so the async writer always
+            # uses the barrier-free core.
+            save_fn = _ckpt.save_local
+        self._save_fn = save_fn
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._job: Optional[tuple[Any, Optional[int]]] = None
+        self._busy = False
+        self._error: Optional[BaseException] = None
+        self._commits = 0
+        self._closed = False
+        reg = _registry()
+        self._m_commit = reg.histogram(
+            "horovod_ckpt_commit_seconds",
+            help="wall time of one background checkpoint commit (stage + "
+                 "fsync + atomic rename)")
+        self._m_block = reg.histogram(
+            "horovod_ckpt_step_block_seconds",
+            help="time a training step spent blocked on a previous "
+                 "checkpoint commit still in flight")
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-async-writer", daemon=True)
+        self._thread.start()
+        with _writers_lock:
+            _writers[os.path.abspath(path)] = self
+
+    # -- training-thread API -------------------------------------------------
+
+    def submit(self, state: Any, step: Optional[int] = None) -> None:
+        """Queue one commit. Blocks only while a previous commit is in
+        flight (measured); raises any error the writer hit earlier."""
+        t0 = time.monotonic()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointer is closed")
+            while (self._busy or self._job is not None) and not self._closed:
+                self._cv.wait(0.1)
+            self._raise_pending_locked()
+            self._job = (state, step)
+            self._cv.notify_all()
+        blocked = time.monotonic() - t0
+        self._m_block.observe(blocked)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Drain: True when no commit is queued or in flight. Re-raises a
+        writer error."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._busy or self._job is not None:
+                rem = None if deadline is None else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    return False
+                self._cv.wait(0.1 if rem is None else min(0.1, rem))
+            self._raise_pending_locked()
+            return True
+
+    def close(self, timeout: float = 120.0) -> None:
+        """Finish the in-flight/queued commit, stop the thread, re-raise
+        any writer error."""
+        self.wait(timeout)
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10.0)
+        with _writers_lock:
+            if _writers.get(os.path.abspath(self.path)) is self:
+                del _writers[os.path.abspath(self.path)]
+        with self._cv:
+            self._raise_pending_locked()
+
+    @property
+    def commits(self) -> int:
+        with self._lock:
+            return self._commits
+
+    def _raise_pending_locked(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                f"background checkpoint commit to {self.path!r} failed"
+            ) from err
+
+    # -- writer thread -------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._job is None and not self._closed:
+                    self._cv.wait(0.2)
+                if self._job is None and self._closed:
+                    return
+                state, step = self._job  # type: ignore[misc]
+                self._job = None
+                self._busy = True
+            t0 = time.monotonic()
+            try:
+                self._save_fn(self.path, state, step)
+            except BaseException as e:  # noqa: BLE001 - surfaced to caller
+                log("warning",
+                    f"[ckpt] async commit to {self.path!r} failed: {e}")
+                with self._cv:
+                    self._error = e
+            else:
+                self._m_commit.observe(time.monotonic() - t0)
+                with self._cv:
+                    self._commits += 1
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()

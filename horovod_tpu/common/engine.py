@@ -1508,7 +1508,8 @@ class PyEngine:
                 self._coord = _Coordinator(topo.size, host, int(port), key=key,
                                            cache_capacity=cache_cap)
                 self._coord.start()
-            self._client = _Client(host, int(port), topo.rank, key=key)
+            self._client = _Client(host, int(port), topo.rank, key=key,
+                                   local=getattr(topo, "local_size", 1))
             # Clock alignment for the trace (tracing/clock.py): estimate
             # this rank's monotonic-clock offset to the coordinator over the
             # control channel BEFORE any spans matter. Rank 0 IS the
@@ -2544,6 +2545,16 @@ class _Coordinator:
         # bytes on the wire; full requests carry a `trace` tag that this
         # side checks against the derivation.
         self._trace_seq: dict[str, int] = {}
+        # Control-tree accounting (ISSUE 18): bytes the batch handlers did
+        # NOT send because an identical field (knob table, plane epochs,
+        # ring verdict) was hoisted out of a whole host's responses and
+        # shipped once.
+        self._m_hoisted = _metrics_registry().counter(
+            "horovod_ctrl_bytes_total",
+            help="Control-plane bytes by direction (up_out/up_in at host "
+                 "agents, absorbed = rank requests answered locally, "
+                 "hoisted = response bytes deduplicated by batching).",
+            dir="hoisted")
 
     def start(self) -> None:
         t = threading.Thread(target=self._accept_loop, name="hvd_coord_accept", daemon=True)
@@ -2585,6 +2596,11 @@ class _Coordinator:
 
     def _serve(self, conn: socket.socket) -> None:
         rank: Optional[int] = None
+        # Control-tree relay connections (ISSUE 18, ctrl/relay.py) carry a
+        # whole host's ranks on one socket: relay_hello declares them, so an
+        # unclean drop of the RELAY fails every rank behind it — the same
+        # rung-3 heartbeat invariant a flat connection gives one rank.
+        relay_for: set[int] = set()
         clean = False
         try:
             while not self._stop.is_set():
@@ -2603,12 +2619,38 @@ class _Coordinator:
                             with self._cv:
                                 self._owed -= 1
                                 self._cv.notify_all()
+                elif kind == "batch_exchange":
+                    out = self._handle_batch_exchange(msg["items"])
+                    owed = sum(1 for it in out["items"] if it["results"])
+                    try:
+                        _send_msg(conn, out, self.key)
+                    finally:
+                        if owed:
+                            with self._cv:
+                                self._owed -= owed
+                                self._cv.notify_all()
                 elif kind == "ring_hello":
                     _send_msg(conn, self._handle_ring_hello(
                         msg["rank"], msg.get("info") or {}), self.key)
                 elif kind == "ring_confirm":
                     _send_msg(conn, self._handle_ring_confirm(
                         msg["rank"], bool(msg["ok"])), self.key)
+                elif kind == "batch_ring_hello":
+                    _send_msg(conn, self._handle_batch_ring_hello(
+                        msg["items"]), self.key)
+                elif kind == "batch_ring_confirm":
+                    _send_msg(conn, self._handle_batch_ring_confirm(
+                        msg["items"]), self.key)
+                elif kind == "relay_hello":
+                    relay_for.update(int(r) for r in msg.get("ranks") or ())
+                    _send_msg(conn, {"ok": 1}, self.key)
+                elif kind == "peer_lost":
+                    # The relay reports a LOCAL rank's unclean drop. The
+                    # lost rank rides "lost", not "rank", so the envelope
+                    # attribution above never marks the relay itself dead.
+                    relay_for.discard(int(msg["lost"]))
+                    self._peer_lost(int(msg["lost"]))
+                    _send_msg(conn, {"ok": 1}, self.key)
                 elif kind == "plane_fault":
                     _send_msg(conn, self._handle_plane_fault(
                         msg["rank"], msg.get("names") or [],
@@ -2640,8 +2682,11 @@ class _Coordinator:
             # now so every surviving rank raises HorovodInternalError into
             # the elastic reset path instead of waiting out the stall
             # watchdog.
-            if rank is not None and not clean and not self._stop.is_set():
-                self._peer_lost(rank)
+            if not clean and not self._stop.is_set():
+                if rank is not None:
+                    self._peer_lost(rank)
+                for r in sorted(relay_for):
+                    self._peer_lost(r)
 
     # -- ring negotiation barriers
 
@@ -2655,60 +2700,103 @@ class _Coordinator:
         with self._cv:
             self._ring_endpoints[rank] = info if info.get("enabled") else None
             self._cv.notify_all()
-            deadline = time.monotonic() + 120.0
-            while (len(self._ring_endpoints) < self.world
-                   and not self._stop.is_set()
-                   and time.monotonic() < deadline):
-                self._cv.wait(1.0)
-            if (len(self._ring_endpoints) < self.world
-                    or any(v is None for v in self._ring_endpoints.values())):
-                return {"peers": None}
-            if self._ring_plane is None:
-                # Compute the verdict exactly once over the complete map;
-                # every waiter returns the same answer (an asymmetric
-                # verdict would deadlock establishment).
-                infos = self._ring_endpoints
-                plane = "flat"
-                self._grid = None
-                if all(i.get("hier") for i in infos.values()):
-                    coords = {r: (i.get("local_rank", 0),
-                                  i.get("local_size", 1),
-                                  i.get("cross_rank", r),
-                                  i.get("cross_size", self.world))
-                              for r, i in infos.items()}
-                    if (plan_grid(coords) is not None
-                            and all(i.get("local_port") and i.get("cross_port")
-                                    for i in infos.values())):
-                        plane = "hier"
-                        # Remembered for redo replays: a collective that the
-                        # two-level plane partially finished must be
-                        # re-reduced in the GRID canonical order, or the
-                        # replayed ranks would diverge bitwise from the
-                        # ranks that completed.
-                        info0 = infos[min(infos)]
-                        self._grid = (info0.get("local_size", 1),
-                                      info0.get("cross_size", 1))
-                self._ring_plane = plane
-            return {"peers": dict(self._ring_endpoints),
-                    "plane": self._ring_plane}
+            return self._ring_hello_barrier()
+
+    def _handle_batch_ring_hello(self, items: list) -> dict:
+        """Host-leader form of ring_hello: one message registers a whole
+        host's ranks, then waits the SAME world barrier. The verdict is
+        identical for every rank by construction (asymmetry would deadlock
+        establishment), so it rides once as ``shared`` and the relay fans
+        it out locally."""
+        with self._cv:
+            for it in items:
+                info = it.get("info") or {}
+                self._ring_endpoints[it["rank"]] = \
+                    info if info.get("enabled") else None
+            self._cv.notify_all()
+            shared = self._ring_hello_barrier()
+        if len(items) > 1:
+            self._m_hoisted.inc((len(items) - 1) * len(
+                pickle.dumps(shared, protocol=pickle.HIGHEST_PROTOCOL)))
+        return {"shared": shared}
+
+    def _ring_hello_barrier(self) -> dict:
+        """Wait for the full endpoint map and compute the plane verdict
+        (caller holds the lock)."""
+        deadline = time.monotonic() + 120.0
+        while (len(self._ring_endpoints) < self.world
+               and not self._stop.is_set()
+               and time.monotonic() < deadline):
+            self._cv.wait(1.0)
+        if (len(self._ring_endpoints) < self.world
+                or any(v is None for v in self._ring_endpoints.values())):
+            return {"peers": None}
+        if self._ring_plane is None:
+            # Compute the verdict exactly once over the complete map;
+            # every waiter returns the same answer (an asymmetric
+            # verdict would deadlock establishment).
+            infos = self._ring_endpoints
+            plane = "flat"
+            self._grid = None
+            if all(i.get("hier") for i in infos.values()):
+                coords = {r: (i.get("local_rank", 0),
+                              i.get("local_size", 1),
+                              i.get("cross_rank", r),
+                              i.get("cross_size", self.world))
+                          for r, i in infos.items()}
+                if (plan_grid(coords) is not None
+                        and all(i.get("local_port") and i.get("cross_port")
+                                for i in infos.values())):
+                    plane = "hier"
+                    # Remembered for redo replays: a collective that the
+                    # two-level plane partially finished must be
+                    # re-reduced in the GRID canonical order, or the
+                    # replayed ranks would diverge bitwise from the
+                    # ranks that completed.
+                    info0 = infos[min(infos)]
+                    self._grid = (info0.get("local_size", 1),
+                                  info0.get("cross_size", 1))
+            self._ring_plane = plane
+        return {"peers": dict(self._ring_endpoints),
+                "plane": self._ring_plane}
 
     def _handle_ring_confirm(self, rank: int, ok: bool) -> dict:
         with self._cv:
             self._ring_votes[rank] = ok
             self._cv.notify_all()
-            deadline = time.monotonic() + 120.0
-            while (len(self._ring_votes) < self.world
-                   and not self._stop.is_set()
-                   and time.monotonic() < deadline):
-                self._cv.wait(1.0)
-            self.ring_active = (len(self._ring_votes) == self.world
-                                and all(self._ring_votes.values()))
-            if not self.ring_active and self._demote_epoch > 0 \
-                    and self._repromote_s > 0:
-                # Failed re-promotion probe (some link still down): stay on
-                # the star and re-arm the cooldown for the next attempt.
-                self._repromote_at = time.monotonic() + self._repromote_s
-            return {"active": self.ring_active}
+            return self._ring_confirm_barrier()
+
+    def _handle_batch_ring_confirm(self, items: list) -> dict:
+        """Host-leader form of ring_confirm: all of one host's votes land
+        in a single message; the all-or-nothing activation verdict rides
+        back once as ``shared``."""
+        with self._cv:
+            for it in items:
+                self._ring_votes[it["rank"]] = bool(it["ok"])
+            self._cv.notify_all()
+            shared = self._ring_confirm_barrier()
+        if len(items) > 1:
+            self._m_hoisted.inc((len(items) - 1) * len(
+                pickle.dumps(shared, protocol=pickle.HIGHEST_PROTOCOL)))
+        return {"shared": shared}
+
+    def _ring_confirm_barrier(self) -> dict:
+        """Wait for every vote and settle ``ring_active`` (caller holds the
+        lock). The verdict is all-or-nothing: one missing or negative vote
+        keeps the whole world on the star relay."""
+        deadline = time.monotonic() + 120.0
+        while (len(self._ring_votes) < self.world
+               and not self._stop.is_set()
+               and time.monotonic() < deadline):
+            self._cv.wait(1.0)
+        self.ring_active = (len(self._ring_votes) == self.world
+                            and all(self._ring_votes.values()))
+        if not self.ring_active and self._demote_epoch > 0 \
+                and self._repromote_s > 0:
+            # Failed re-promotion probe (some link still down): stay on
+            # the star and re-arm the cooldown for the next attempt.
+            self._repromote_at = time.monotonic() + self._repromote_s
+        return {"active": self.ring_active}
 
     # -- escalation ladder (ISSUE 8) --
 
@@ -2963,196 +3051,263 @@ class _Coordinator:
     def _handle_exchange(self, rank: int, requests: list[dict], arrays: dict,
                          bits: int = 0,
                          redo_results: Optional[dict] = None) -> dict:
-        ready: list[str] = []
         with self._cv:
-            self._maybe_schedule_reprobe()
-            now = time.monotonic()
-            # Redo answers (ISSUE 8): a rank that finished a collective on
-            # the peer plane before the link died ships its retained result
-            # — the identical bits the failed ranks would have produced —
-            # and the redo negotiation closes without re-reducing anything.
-            # Seq-checked: only a copy of the RECALLED execution counts
-            # (names recur every step; a stale copy must never answer).
-            for nm, (seq, arr) in (redo_results or {}).items():
-                if (self._redo_wanted.get(nm) == int(seq)
-                        and nm not in self._results):
-                    self._results[nm] = (None, np.asarray(arr))
-                    # Pre-claim the finishers: only the redoing ranks still
-                    # owe a claim, so the result retires as soon as they
-                    # collect it instead of lingering into (and poisoning)
-                    # the next same-name collective.
-                    self._claimed[nm] = set(self._redo_claim.pop(nm, set()))
-                    self._pending.pop(nm, None)
-                    self._first_seen.pop(nm, None)
-                    self._redo_wanted.pop(nm, None)
-                    self._redo_grid.pop(nm, None)
-                    self._redo_done[nm] = (now, int(seq))
-            # Retained-result answers can never be claimed by the whole
-            # world (the ranks that finished never re-poll the name), so the
-            # world-claimed deletion cannot fire — purge them after a claim
-            # window instead.
-            for nm, (ts, _seq) in list(self._redo_done.items()):
-                if now - ts > 60.0:
-                    self._redo_done.pop(nm)
-                    self._results.pop(nm, None)
-                    self._claimed.pop(nm, None)
-            full_reqs = list(requests)
-            if full_reqs and self._cache.enabled:
-                for req in full_reqs:
-                    # Shape-change invalidation: a full request for a name
-                    # bound under a DIFFERENT signature evicts the stale bit
-                    # everywhere. (Same signature = a flushed mirror
-                    # re-learning; the assignment is re-announced with the
-                    # result delivery.)
-                    old = self._cache.bit_for_name(req["name"])
-                    if old is not None and self._cache.lookup_bit(old)[0] != \
-                            request_key(req):
-                        self._queue_evictions(
-                            self._cache.evict_name(req["name"]))
-                    if (req["name"] not in self._results
-                            and rank not in self._pending.get(req["name"], {})):
-                        self._cache.misses += 1
-            all_reqs = full_reqs + self._resolve_bits(bits)
-            reformat: list[str] = []
-            for req in all_reqs:
-                name = req["name"]
-                # Re-poll after a partial response: the result is already
-                # waiting for this rank — don't contribute again (a stale
-                # entry would poison the next same-name collective).
-                if name in self._results and rank not in self._claimed.get(name, set()):
-                    continue
-                if (req["op"] == "allreduce"
-                        and int(req.get("ke", 0)) != self._knob_epoch
-                        and self._redo_wanted.get(name, -1) == -1):
-                    # Knob-epoch safe switch (ISSUE 16): this contribution
-                    # was formatted under a stale knob table — bounce it for
-                    # re-formatting instead of ingesting (mixing tables
-                    # within one collective would trip the wire-mismatch
-                    # validation, or worse, silently fold mixed precision).
-                    # RING-directive redos (real seq) are EXEMPT: every rank
-                    # re-ships its old-format bytes consistently, which is
-                    # exactly how an interrupted collective replays bitwise.
-                    # Recalled star pendings (sentinel seq -1) are NOT: a
-                    # late rank may first learn of the recall on this very
-                    # response, so the fresh re-reduce collects only
-                    # new-table contributions.
-                    reformat.append(name)
-                    continue
-                entry = self._pending.setdefault(name, {})
-                self._first_seen.setdefault(name, time.monotonic())
-                if name in arrays:
-                    entry[rank] = (req, arrays[name])
-                elif (rank not in entry and self.ring_active
-                        and req["op"] == "allreduce"):
-                    # Ring-plane allreduce: metadata-only contribution —
-                    # the bytes never transit the coordinator.
-                    entry[rank] = (req, None)
-                # else: metadata-only re-poll — this rank's bytes are already
-                # stored from its first contribution; nothing to overwrite.
-                if len(entry) == self.world:
-                    ready.append(name)
-            for name in ready:
-                contribs = self._pending.pop(name)
-                self._results[name] = self._execute(name, contribs)
+            names, reformat = self._exchange_ingest(
+                rank, requests, arrays, bits, redo_results)
+            self._exchange_wait(names)
+            return self._exchange_build(rank, names, reformat)
+
+    def _handle_batch_exchange(self, items: list) -> dict:
+        """Host-leader form of exchange (ISSUE 18): a relay delivers one
+        tick carrying several ranks' envelopes. Ingest them all FIRST, then
+        run the bounded wait ONCE on the union of their names — co-hosted
+        ranks usually tick the same tensors, so a name that needs all of
+        them completes inside this very call instead of bouncing L serial
+        0.1 s empty-waits. Each rank then builds its own response (claims
+        are per-rank); response fields that are identical across the whole
+        batch (knob table, plane epochs) are hoisted into the envelope and
+        sent once, with the savings counted in
+        ``horovod_ctrl_bytes_total{dir="hoisted"}``."""
+        parts: list[tuple[int, list, list]] = []
+        with self._cv:
+            union: list[str] = []
+            seen: set = set()
+            for msg in items:
+                names, reformat = self._exchange_ingest(
+                    msg["rank"], msg["requests"], msg.get("arrays") or {},
+                    msg.get("bits", 0), msg.get("redo_results"))
+                parts.append((msg["rank"], names, reformat))
+                for n in names:
+                    if n not in seen:
+                        seen.add(n)
+                        union.append(n)
+            self._exchange_wait(union)
+            out_items = [self._exchange_build(rank, names, reformat)
+                         for rank, names, reformat in parts]
+        resp: dict = {"items": out_items}
+        if len(out_items) > 1:
+            saved = 0
+            for field in ("knob", "plane"):
+                vals = [it[field] for it in out_items if field in it]
+                if len(vals) == len(out_items) \
+                        and all(v == vals[0] for v in vals):
+                    for it in out_items:
+                        del it[field]
+                    resp[field] = vals[0]
+                    saved += (len(out_items) - 1) * len(pickle.dumps(
+                        vals[0], protocol=pickle.HIGHEST_PROTOCOL))
+            if saved:
+                self._m_hoisted.inc(saved)
+        return resp
+
+    def _exchange_ingest(self, rank: int, requests: list[dict], arrays: dict,
+                         bits: int = 0,
+                         redo_results: Optional[dict] = None
+                         ) -> tuple[list[str], list[str]]:
+        """Fold one rank's tick into coordinator state (caller holds the
+        lock): redo answers, cache-bit resolution, stale-knob-epoch
+        bounces, pending contributions, ready executions, dead-rank
+        backstop. Returns the names this rank awaits and the bounced
+        (reformat) names."""
+        ready: list[str] = []
+        self._maybe_schedule_reprobe()
+        now = time.monotonic()
+        # Redo answers (ISSUE 8): a rank that finished a collective on
+        # the peer plane before the link died ships its retained result
+        # — the identical bits the failed ranks would have produced —
+        # and the redo negotiation closes without re-reducing anything.
+        # Seq-checked: only a copy of the RECALLED execution counts
+        # (names recur every step; a stale copy must never answer).
+        for nm, (seq, arr) in (redo_results or {}).items():
+            if (self._redo_wanted.get(nm) == int(seq)
+                    and nm not in self._results):
+                self._results[nm] = (None, np.asarray(arr))
+                # Pre-claim the finishers: only the redoing ranks still
+                # owe a claim, so the result retires as soon as they
+                # collect it instead of lingering into (and poisoning)
+                # the next same-name collective.
+                self._claimed[nm] = set(self._redo_claim.pop(nm, set()))
+                self._pending.pop(nm, None)
+                self._first_seen.pop(nm, None)
+                self._redo_wanted.pop(nm, None)
+                self._redo_grid.pop(nm, None)
+                self._redo_done[nm] = (now, int(seq))
+        # Retained-result answers can never be claimed by the whole
+        # world (the ranks that finished never re-poll the name), so the
+        # world-claimed deletion cannot fire — purge them after a claim
+        # window instead.
+        for nm, (ts, _seq) in list(self._redo_done.items()):
+            if now - ts > 60.0:
+                self._redo_done.pop(nm)
+                self._results.pop(nm, None)
+                self._claimed.pop(nm, None)
+        full_reqs = list(requests)
+        if full_reqs and self._cache.enabled:
+            for req in full_reqs:
+                # Shape-change invalidation: a full request for a name
+                # bound under a DIFFERENT signature evicts the stale bit
+                # everywhere. (Same signature = a flushed mirror
+                # re-learning; the assignment is re-announced with the
+                # result delivery.)
+                old = self._cache.bit_for_name(req["name"])
+                if old is not None and self._cache.lookup_bit(old)[0] != \
+                        request_key(req):
+                    self._queue_evictions(
+                        self._cache.evict_name(req["name"]))
+                if (req["name"] not in self._results
+                        and rank not in self._pending.get(req["name"], {})):
+                    self._cache.misses += 1
+        all_reqs = full_reqs + self._resolve_bits(bits)
+        reformat: list[str] = []
+        for req in all_reqs:
+            name = req["name"]
+            # Re-poll after a partial response: the result is already
+            # waiting for this rank — don't contribute again (a stale
+            # entry would poison the next same-name collective).
+            if name in self._results and rank not in self._claimed.get(name, set()):
+                continue
+            if (req["op"] == "allreduce"
+                    and int(req.get("ke", 0)) != self._knob_epoch
+                    and self._redo_wanted.get(name, -1) == -1):
+                # Knob-epoch safe switch (ISSUE 16): this contribution
+                # was formatted under a stale knob table — bounce it for
+                # re-formatting instead of ingesting (mixing tables
+                # within one collective would trip the wire-mismatch
+                # validation, or worse, silently fold mixed precision).
+                # RING-directive redos (real seq) are EXEMPT: every rank
+                # re-ships its old-format bytes consistently, which is
+                # exactly how an interrupted collective replays bitwise.
+                # Recalled star pendings (sentinel seq -1) are NOT: a
+                # late rank may first learn of the recall on this very
+                # response, so the fresh re-reduce collects only
+                # new-table contributions.
+                reformat.append(name)
+                continue
+            entry = self._pending.setdefault(name, {})
+            self._first_seen.setdefault(name, time.monotonic())
+            if name in arrays:
+                entry[rank] = (req, arrays[name])
+            elif (rank not in entry and self.ring_active
+                    and req["op"] == "allreduce"):
+                # Ring-plane allreduce: metadata-only contribution —
+                # the bytes never transit the coordinator.
+                entry[rank] = (req, None)
+            # else: metadata-only re-poll — this rank's bytes are already
+            # stored from its first contribution; nothing to overwrite.
+            if len(entry) == self.world:
+                ready.append(name)
+        for name in ready:
+            contribs = self._pending.pop(name)
+            self._results[name] = self._execute(name, contribs)
+            self._first_seen.pop(name, None)
+            self._redo_wanted.pop(name, None)
+            self._redo_claim.pop(name, None)
+            self._claimed[name] = set()
+            if self._results[name][0] is None:
+                self._maybe_assign(name, contribs)
+        if self._dead:
+            # Rung 3 backstop: anything still (or newly) pending misses
+            # at least one dead rank forever — fail it now with the
+            # reset-worthy error instead of letting re-polls spin until
+            # the stall watchdog.
+            dmsg = (_FATAL + f"rank(s) {sorted(self._dead)} lost their "
+                    "control connection (worker dead or partitioned); "
+                    "collective cannot complete")
+            for name in list(self._pending):
+                self._pending.pop(name)
                 self._first_seen.pop(name, None)
                 self._redo_wanted.pop(name, None)
+                self._redo_grid.pop(name, None)
                 self._redo_claim.pop(name, None)
-                self._claimed[name] = set()
-                if self._results[name][0] is None:
-                    self._maybe_assign(name, contribs)
-            if self._dead:
-                # Rung 3 backstop: anything still (or newly) pending misses
-                # at least one dead rank forever — fail it now with the
-                # reset-worthy error instead of letting re-polls spin until
-                # the stall watchdog.
-                dmsg = (_FATAL + f"rank(s) {sorted(self._dead)} lost their "
-                        "control connection (worker dead or partitioned); "
-                        "collective cannot complete")
-                for name in list(self._pending):
-                    self._pending.pop(name)
-                    self._first_seen.pop(name, None)
-                    self._redo_wanted.pop(name, None)
-                    self._redo_grid.pop(name, None)
-                    self._redo_claim.pop(name, None)
-                    if name not in self._results:
-                        self._results[name] = (dmsg, None)
-                        self._claimed[name] = set()
-            self._cv.notify_all()
-            # Collective semantics: a tensor completes only when every rank
-            # contributed. But an exchange never blocks on a straggler (the
-            # round-3 divergence: every tensor shared the fate of the
-            # batch's slowest name for up to 30 s, and because the engine
-            # loop is single-threaded, tensors enqueued in LATER cycles
-            # queued behind it too). The response returns when ALL requested
-            # names are ready; once ANY is, after a short grace for the
-            # rest; and when NONE is, empty after one short tick. Unready
-            # names are simply absent from the response; the rank re-polls
-            # them metadata-only on its next cycle (no tensor re-shipping,
-            # and newly enqueued tensors join that next exchange instead of
-            # waiting behind this one) and the stall checker warns on the
-            # original enqueue age (reference CheckForStalledTensors,
-            # operations.cc:1625-1672).
-            out: dict[str, tuple[Optional[str], Any]] = {}
-            # Bounced (stale knob epoch) names re-submit next cycle — the
-            # grace loop must not stall waiting for contributions this very
-            # response is rejecting.
-            names = [r["name"] for r in all_reqs if r["name"] not in reformat]
-            empty_deadline = time.monotonic() + 0.1
-            grace: Optional[float] = None
-            while True:
-                unready = [n for n in names if n not in self._results]
-                if not unready:
+                if name not in self._results:
+                    self._results[name] = (dmsg, None)
+                    self._claimed[name] = set()
+        self._cv.notify_all()
+        # Bounced (stale knob epoch) names re-submit next cycle — the
+        # wait's grace loop must not stall waiting for contributions this
+        # very response is rejecting.
+        return ([r["name"] for r in all_reqs if r["name"] not in reformat],
+                reformat)
+
+    def _exchange_wait(self, names: list[str]) -> None:
+        """Bounded readiness wait (caller holds the lock).
+
+        Collective semantics: a tensor completes only when every rank
+        contributed. But an exchange never blocks on a straggler (the
+        round-3 divergence: every tensor shared the fate of the
+        batch's slowest name for up to 30 s, and because the engine
+        loop is single-threaded, tensors enqueued in LATER cycles
+        queued behind it too). The response returns when ALL requested
+        names are ready; once ANY is, after a short grace for the
+        rest; and when NONE is, empty after one short tick. Unready
+        names are simply absent from the response; the rank re-polls
+        them metadata-only on its next cycle (no tensor re-shipping,
+        and newly enqueued tensors join that next exchange instead of
+        waiting behind this one) and the stall checker warns on the
+        original enqueue age (reference CheckForStalledTensors,
+        operations.cc:1625-1672)."""
+        empty_deadline = time.monotonic() + 0.1
+        grace: Optional[float] = None
+        while True:
+            unready = [n for n in names if n not in self._results]
+            if not unready:
+                break
+            if len(unready) < len(names):
+                # something is ready: linger briefly for the rest, then
+                # return the partials
+                if grace is None:
+                    grace = time.monotonic() + 0.05
+                if time.monotonic() >= grace:
                     break
-                if len(unready) < len(names):
-                    # something is ready: linger briefly for the rest, then
-                    # return the partials
-                    if grace is None:
-                        grace = time.monotonic() + 0.05
-                    if time.monotonic() >= grace:
-                        break
-                    self._cv.wait(timeout=0.01)
-                else:
-                    if time.monotonic() >= empty_deadline:
-                        break  # nothing ready: hand control back to the rank
-                    self._cv.wait(timeout=0.02)
-            assign: list[tuple[int, tuple]] = []
-            for n in names:
-                if n in self._results and rank not in self._claimed[n]:
-                    out[n] = self._results[n]
-                    if n in self._assigned:
-                        assign.append(self._assigned[n])
-                    self._claimed[n].add(rank)
-                    if len(self._claimed[n]) == self.world:
-                        del self._results[n]
-                        del self._claimed[n]
-            if out:
-                # Owed until _serve's send completes — stop()'s drain must
-                # not declare victory between the claim and the write.
-                self._owed += 1
-            resp = {"results": out, "assign": assign,
-                    "evict": self._drain_evictions(rank)}
-            if self._demote_epoch or self._reprobe_epoch:
-                # Ladder signals (ISSUE 8): epochs ride every response once
-                # a demotion happened (two small ints; ranks apply them with
-                # one compare each). Absent in the steady state, so the
-                # healthy-path response stays byte-identical to before.
-                resp["plane"] = {"demote": self._demote_epoch,
-                                 "reprobe": self._reprobe_epoch}
-            if self._redo_wanted:
-                # Ask every rank for its retained copy of the recalled
-                # (name, seq) executions — whichever survivor answers first
-                # closes the redo without re-reducing anything.
-                resp["redo"] = [[nm, seq]
-                                for nm, seq in self._redo_wanted.items()]
-            if self._knob_epoch:
-                # Knob-table commit (ISSUE 16): the cumulative table rides
-                # every response once a knob changed; ranks apply it with
-                # one epoch compare. Absent in the steady state.
-                resp["knob"] = {"epoch": self._knob_epoch,
-                                "table": dict(self._knob_table)}
-            if reformat:
-                resp["reformat"] = reformat
-            return resp
+                self._cv.wait(timeout=0.01)
+            else:
+                if time.monotonic() >= empty_deadline:
+                    break  # nothing ready: hand control back to the rank
+                self._cv.wait(timeout=0.02)
+
+    def _exchange_build(self, rank: int, names: list[str],
+                        reformat: list[str]) -> dict:
+        """Claim whatever is ready for ``rank`` and assemble its response
+        (caller holds the lock)."""
+        out: dict[str, tuple[Optional[str], Any]] = {}
+        assign: list[tuple[int, tuple]] = []
+        for n in names:
+            if n in self._results and rank not in self._claimed[n]:
+                out[n] = self._results[n]
+                if n in self._assigned:
+                    assign.append(self._assigned[n])
+                self._claimed[n].add(rank)
+                if len(self._claimed[n]) == self.world:
+                    del self._results[n]
+                    del self._claimed[n]
+        if out:
+            # Owed until _serve's send completes — stop()'s drain must
+            # not declare victory between the claim and the write.
+            self._owed += 1
+        resp = {"results": out, "assign": assign,
+                "evict": self._drain_evictions(rank)}
+        if self._demote_epoch or self._reprobe_epoch:
+            # Ladder signals (ISSUE 8): epochs ride every response once
+            # a demotion happened (two small ints; ranks apply them with
+            # one compare each). Absent in the steady state, so the
+            # healthy-path response stays byte-identical to before.
+            resp["plane"] = {"demote": self._demote_epoch,
+                             "reprobe": self._reprobe_epoch}
+        if self._redo_wanted:
+            # Ask every rank for its retained copy of the recalled
+            # (name, seq) executions — whichever survivor answers first
+            # closes the redo without re-reducing anything.
+            resp["redo"] = [[nm, seq]
+                            for nm, seq in self._redo_wanted.items()]
+        if self._knob_epoch:
+            # Knob-table commit (ISSUE 16): the cumulative table rides
+            # every response once a knob changed; ranks apply it with
+            # one epoch compare. Absent in the steady state.
+            resp["knob"] = {"epoch": self._knob_epoch,
+                            "table": dict(self._knob_table)}
+        if reformat:
+            resp["reformat"] = reformat
+        return resp
 
     def stall_candidates(self) -> list:
         """Watchdog source (reference CheckForStalledTensors with
@@ -3316,25 +3471,47 @@ class _Coordinator:
 
 class _Client:
     def __init__(self, host: str, port: int, rank: int,
-                 key: bytes = b"") -> None:
+                 key: bytes = b"", local: int = 1) -> None:
         self.rank = rank
         self.key = key or _secret_from_env()
         if not self.key:
             raise HorovodInternalError(
                 "client requires a shared HOROVOD_SECRET key")
+        # Control tree (ISSUE 18): when the launcher exported a per-host
+        # relay address, the control socket goes THERE (loopback) instead of
+        # to the rank-0 coordinator; the relay coalesces this host's ticks
+        # so the root pays O(hosts) connections. The wire protocol is
+        # unchanged — only the first hop moves.
+        relay = os.environ.get("HOROVOD_CTRL_RELAY", "")
+        dial_host, dial_port = host, port
+        if relay:
+            rhost, rport = relay.rsplit(":", 1)
+            dial_host, dial_port = rhost, int(rport)
         deadline = time.monotonic() + 60.0
         last: Optional[Exception] = None
         while time.monotonic() < deadline:
             try:
-                self.sock = socket.create_connection((host, port), timeout=60)
+                self.sock = socket.create_connection(
+                    (dial_host, dial_port), timeout=60)
                 break
             except OSError as e:
                 last = e
                 time.sleep(0.1)
         else:
-            raise HorovodInternalError(f"cannot reach coordinator at {host}:{port}: {last}")
+            raise HorovodInternalError(
+                f"cannot reach coordinator at {dial_host}:{dial_port}: {last}")
         self.sock.settimeout(120)
         self._lock = threading.Lock()
+        self._via_relay = bool(relay)
+        self._coord_host = host
+        if relay:
+            # Tell the relay who this is, the host's full complement (its
+            # ring-barrier batch size), and where the coordinator of THIS
+            # generation lives (elastic resets move it).
+            _send_msg(self.sock, {"kind": "relay_hello", "rank": rank,
+                                  "local": int(local),
+                                  "coord": [host, int(port)]}, self.key)
+            _recv_msg(self.sock, self.key)
         self.last_sent_bytes = 0
         # (assign, evict) announcements from the latest exchange response;
         # the engine applies them to its CacheMirror.
@@ -3354,6 +3531,19 @@ class _Client:
         """Local address of the control connection — the interface that
         routes to the coordinator, advertised for this rank's ring
         listener (native Client::local_host analog)."""
+        if self._via_relay:
+            # The control socket points at the loopback relay; the ring
+            # listener must advertise the interface that routes to the REAL
+            # coordinator. A connected UDP socket resolves that route
+            # without sending a packet.
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                probe.connect((self._coord_host, 9))
+                return probe.getsockname()[0]
+            except OSError:
+                return self.sock.getsockname()[0]
+            finally:
+                probe.close()
         return self.sock.getsockname()[0]
 
     def ring_hello(self, info: dict) -> dict:

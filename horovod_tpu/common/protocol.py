@@ -91,6 +91,17 @@ PY_REQUEST_KEY_FIELDS = ["name", "op", "dtype", "root", "shape", "average",
 PY_REQUEST_FIELDS = ["name", "op", "shape", "dtype", "root", "average"]
 PY_REQUEST_OPTIONAL_FIELDS = ["wire", "trace", "ke"]
 
+# The coordinator's control-socket dispatch alphabet, in the source order
+# of _Coordinator._serve (ISSUE 18). The per-host relay (ctrl/relay.py)
+# special-cases a subset of these and forwards the rest verbatim; it
+# asserts its subset against this list at import, so a kind added or
+# renamed in the coordinator cannot silently bypass the tree's batching.
+# The analyzer machine-extracts the dispatch and fails on drift.
+COORD_WIRE_KINDS = ["exchange", "batch_exchange", "ring_hello",
+                    "ring_confirm", "batch_ring_hello",
+                    "batch_ring_confirm", "relay_hello", "peer_lost",
+                    "plane_fault", "knob_change", "clock_probe", "bye"]
+
 SPEC_REL = os.path.join("docs", "protocol_spec.json")
 
 

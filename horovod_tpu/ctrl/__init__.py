@@ -1,0 +1,30 @@
+"""Pod-scale control tree (ISSUE 18).
+
+Rank-0's control plane — rendezvous registration, engine negotiation
+ticks, knob-epoch acks, elastic polls, clock probes — is a star: every
+rank holds a socket to the root, so root connections and control bytes
+are O(world). This package fans that traffic through per-host leaders,
+the same shape the telemetry tree (ISSUE 17) gave the metrics plane:
+
+- :mod:`~horovod_tpu.ctrl.tree` — the host-grouping plan and the knobs
+  (``HOROVOD_CTRL_TREE``, batching/poll intervals), with a LOUD flat
+  fallback when no host grouping exists.
+- :mod:`~horovod_tpu.ctrl.agent` — :class:`ControlAgent`, the per-host
+  runner-plane leader: batches its ranks' register/wait/poll traffic
+  into one upstream connection to the driver, passes everything else
+  through verbatim, and serves checkpoint streaming to cold-starting
+  joiners (ckpt_async/stream.py).
+- :mod:`~horovod_tpu.ctrl.relay` — :class:`CoordRelay`, the per-host
+  engine-plane leader: speaks the coordinator's raw HMAC wire protocol
+  on both sides, batching exchange ticks and ring barriers so the
+  rank-0 coordinator sees one connection per host.
+"""
+
+from .tree import (  # noqa: F401
+    TreePlan,
+    ctrl_batch_s,
+    ctrl_poll_s,
+    plan_tree,
+    tree_enabled,
+    use_tree,
+)

@@ -123,6 +123,38 @@ class AgentSlotPool:
         self._clients: dict[str, Any] = {}      # host -> BasicClient | None
         self._host_indices: dict[str, set] = {}  # host -> task indices
         self._last_codes: dict[int, Optional[int]] = {}
+        # Control tree (ISSUE 18): set via enable_control() before spawns;
+        # each host's leader starts lazily with its first slot, so hosts
+        # joining mid-run (discovery) get one too.
+        self._ctrl_root: Optional[list] = None
+        self._ctrl_ckpt_dir = ""
+        self._ctrl_started: set[str] = set()
+
+    def enable_control(self, root_addrs, ckpt_dir: str = "") -> None:
+        """Route every host's rank traffic through a ControlAgent leader
+        (started on first spawn per host) instead of rank-to-root."""
+        self._ctrl_root = [list(a) for a in root_addrs]
+        self._ctrl_ckpt_dir = ckpt_dir
+
+    def _start_control(self, host: str, client) -> None:
+        if self._ctrl_root is None or host in self._ctrl_started:
+            return
+        try:
+            resp = client.request({
+                "kind": "ctrl", "cmd": "start", "job_id": self.job_id,
+                "root": self._ctrl_root, "relay": True,
+                "ckpt_dir": self._ctrl_ckpt_dir})
+        except (ConnectionError, OSError) as e:
+            resp = {"ok": False, "error": str(e)}
+        if resp.get("ok"):
+            self._ctrl_started.add(host)
+        else:
+            from ..utils.logging import log
+
+            log("warning",
+                f"[ctrl] control leader failed to start on {host}: "
+                f"{resp.get('error')} — that host's workers use the flat "
+                "control plane")
 
     def job_secret(self) -> bytes:
         from ..runner.network import derive_key
@@ -156,7 +188,9 @@ class AgentSlotPool:
 
         env = _worker_env(slot.index, self._driver.addresses(), None,
                           self._env)
-        resp = self._client(slot.host).request({
+        client = self._client(slot.host)
+        self._start_control(slot.host, client)
+        resp = client.request({
             "kind": "spawn", "job_id": self.job_id, "extend": True,
             "workers": [{"index": slot.index,
                          "argv": [self._python, "-m",
@@ -290,6 +324,16 @@ def launch_elastic(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None
         # crosses the wire) — re-key the driver service before any worker
         # can connect (spawns happen strictly later).
         driver.key = pool.job_secret()
+        # Control tree (ISSUE 18): per-host leaders fold rendezvous and
+        # elastic-poll traffic into one upstream connection per host, and
+        # serve checkpoint streaming to cold-starting joiners.
+        from ..ctrl.tree import use_tree
+
+        world = sum(int(s.slots) for s in specs)
+        if use_tree(len(specs), world):
+            pool.enable_control(
+                driver.addresses(),
+                ckpt_dir=knob("HOROVOD_CKPT_STREAM_DIR", ""))
     else:
         num_proc = num_proc or os.cpu_count() or 1
         if num_proc < 1:

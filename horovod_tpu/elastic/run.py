@@ -35,7 +35,6 @@ not for exceptions resets cannot fix.
 from __future__ import annotations
 
 import functools
-import json
 import os
 import time
 from typing import Any, Callable, Optional
@@ -76,13 +75,14 @@ class _WorkerContext:
     def from_env(cls) -> Optional["_WorkerContext"]:
         if os.environ.get("HOROVOD_ELASTIC") != "1":
             return None
-        addrs = os.environ.get("HOROVOD_DRIVER_ADDRS")
+        from ..runner.service import worker_addresses
+
+        addrs = worker_addresses()  # host ControlAgent or driver (ISSUE 18)
         secret = os.environ.get("HOROVOD_SECRET")
         index = os.environ.get("HOROVOD_TASK_INDEX")
         if not addrs or not secret or index is None:
             return None
-        return cls(int(index), [tuple(a) for a in json.loads(addrs)],
-                   bytes.fromhex(secret))
+        return cls(int(index), addrs, bytes.fromhex(secret))
 
     @property
     def generation(self) -> int:

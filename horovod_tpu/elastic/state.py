@@ -73,6 +73,10 @@ class ElasticState:
         object.__setattr__(self, "_values", dict(values))
         object.__setattr__(self, "_committed", None)
         object.__setattr__(self, "_commits", 0)
+        # Async checkpoint writer (ISSUE 18), created on first checkpointing
+        # commit when HOROVOD_CKPT_ASYNC is on. Rank-gated there, not here:
+        # rank is unknown until the world initializes.
+        object.__setattr__(self, "_async_writer", None)
         # The construction-time values are the first commit: restore() and
         # sync() are well-defined before the loop's first explicit commit.
         self.commit(checkpoint=False, check_host_updates=False)
@@ -116,8 +120,30 @@ class ElasticState:
                           and self._commits % self._checkpoint_every == 0)
         if checkpoint and self._checkpoint_dir:
             from .. import checkpoint as ckpt
+            from ..ckpt_async import async_enabled
 
-            ckpt.save(self._checkpoint_dir, self._committed)
+            if async_enabled():
+                # Off-step-path commit (ISSUE 18): rank 0 hands the writer
+                # thread the snapshot BY REFERENCE — safe because commit()
+                # binds a fresh _copy_tree every time, never mutating the
+                # tree the writer holds. No completion barrier: the commit
+                # pipeline keeps the on-disk state crash-consistent at
+                # every instant, so non-zero ranks need not wait (they
+                # never read the directory outside cold start).
+                from ..common import basics
+
+                if not basics.is_initialized() or basics.rank() == 0:
+                    writer = self._async_writer
+                    if writer is None or writer.path != self._checkpoint_dir:
+                        from ..ckpt_async import AsyncCheckpointer
+
+                        if writer is not None:
+                            writer.close()
+                        writer = AsyncCheckpointer(self._checkpoint_dir)
+                        object.__setattr__(self, "_async_writer", writer)
+                    writer.submit(self._committed)
+            else:
+                ckpt.save(self._checkpoint_dir, self._committed)
         from . import fault
 
         if fault.armed():
@@ -130,6 +156,12 @@ class ElasticState:
             if poll_host_updates():
                 raise HostsUpdatedInterrupt(
                     "elastic membership changed; re-rendezvous requested")
+
+    def checkpoint_wait(self, timeout: float = 120.0) -> bool:
+        """Block until any in-flight background checkpoint commit lands
+        (True), or ``timeout`` passes (False). No-op without a writer."""
+        writer = self._async_writer
+        return True if writer is None else writer.wait(timeout)
 
     def restore(self) -> None:
         """Roll the live values back to the last commit (uncommitted steps
@@ -146,6 +178,13 @@ class ElasticState:
         the in-memory reset path). Returns False when no checkpoint exists.
         Single-rank read (``verify=False``): callers sync() afterwards, and
         the broadcast is the consistency guarantee."""
+        from ..ckpt_async import writer as _async_writer
+
+        # A cold start in the same process (full-restart tests, notebook
+        # reuse) must see every commit already submitted to a background
+        # writer — flush before looking at the filesystem.
+        if self._checkpoint_dir:
+            _async_writer.drain(self._checkpoint_dir)
         if not self._checkpoint_dir or not os.path.isdir(self._checkpoint_dir):
             return False
         from .. import checkpoint as ckpt

@@ -104,6 +104,22 @@ def _exit_code(rc: Optional[int]) -> int:
     return 128 - rc if rc < 0 else rc
 
 
+def _maybe_start_control(spawner: RemoteSpawner, driver: DriverService,
+                         world: int, env: Optional[dict]) -> None:
+    """Start per-host control leaders when the tree pays for itself
+    (ctrl.tree.use_tree — multi-host, world >= 3, not knobbed off), so
+    rendezvous/poll traffic reaches the driver via O(hosts) connections.
+    The exported checkpoint directory (streaming cold-start source) is the
+    job's HOROVOD_CKPT_STREAM_DIR, from the call's env or the launcher's."""
+    from ..ctrl.tree import use_tree
+
+    if not use_tree(len(spawner.specs), world):
+        return
+    ckpt_dir = (env or {}).get("HOROVOD_CKPT_STREAM_DIR") \
+        or os.environ.get("HOROVOD_CKPT_STREAM_DIR", "")
+    spawner.start_control(driver.addresses(), relay=True, ckpt_dir=ckpt_dir)
+
+
 def _remote_spawner(hosts, agent_port, agent_secret) -> RemoteSpawner:
     if agent_secret is None:
         hex_secret = os.environ.get("HOROVOD_AGENT_SECRET")
@@ -152,6 +168,7 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         driver = DriverService(num_proc, secret, fn=fn, args=args, kwargs=kwargs)
         argv = [python or sys.executable, "-m", "horovod_tpu.runner.task_main"]
         try:
+            _maybe_start_control(spawner, driver, num_proc, env)
             spawner.spawn(
                 make_argv=lambda i: argv,
                 make_env=lambda i: _worker_env(i, driver.addresses(), None, env))
@@ -255,6 +272,7 @@ def run_command(command: Sequence[str], num_proc: Optional[int] = None,
         argv = ([python or sys.executable, "-m", "horovod_tpu.runner.task_exec"]
                 + list(command))
         try:
+            _maybe_start_control(spawner, driver, spawner.num_proc, env)
             spawner.spawn(
                 make_argv=lambda i: argv,
                 make_env=lambda i: {

@@ -67,6 +67,13 @@ class HostAgent(BasicService):
       metric deltas and probe the host clock without extra key exchange.
       The telemetry agent's lifetime is the job's: ``kill`` and driver
       disconnect stop it with the workers.
+    - ``ctrl`` ``{cmd: start|stop, job_id, root?, ckpt_dir?}`` →
+      ``{ok, port, host}`` — host a control-tree leader (ctrl/agent.py
+      ControlAgent) for the job, keyed with the same derived job secret.
+      ``root`` is the driver service's address list; the leader batches
+      its ranks' rendezvous/poll traffic into one upstream connection
+      and serves checkpoint streaming from ``ckpt_dir``. Same lifetime
+      discipline as the telemetry agent.
     """
 
     def __init__(self, key: bytes, host: str = "0.0.0.0", port: int = 0) -> None:
@@ -76,6 +83,8 @@ class HostAgent(BasicService):
         self._jobs: dict[str, dict] = {}
         # job_id -> TelemetryAgent (hosted for that job's ranks)
         self._telemetry: dict[str, Any] = {}
+        # job_id -> ControlAgent (control-tree host leader, ISSUE 18)
+        self._ctrl: dict[str, Any] = {}
         self._spawned_total = 0
         self._exited_nonzero_total = 0
         self._exit_counted: set[int] = set()  # pids already tallied
@@ -117,6 +126,8 @@ class HostAgent(BasicService):
             return {"ok": True}
         if kind == "telemetry":
             return self._telemetry_cmd(req, client_addr)
+        if kind == "ctrl":
+            return self._ctrl_cmd(req, client_addr)
         return {"ok": False, "error": f"unknown request {kind}"}
 
     def _telemetry_cmd(self, req: Any, client_addr) -> Any:
@@ -161,6 +172,64 @@ class HostAgent(BasicService):
             except Exception:
                 pass
 
+    def _ctrl_cmd(self, req: Any, client_addr) -> Any:
+        # Same idempotent/race-safe hosting discipline as _telemetry_cmd:
+        # re-start returns the live leader, a construction race keeps the
+        # first instance, and job kill / driver disconnect stop it.
+        job_id = str(req.get("job_id", ""))
+        cmd = req.get("cmd", "start")
+        if cmd == "stop":
+            self._stop_ctrl(job_id)
+            return {"ok": True}
+        if cmd != "start":
+            return {"ok": False, "error": f"unknown ctrl cmd {cmd!r}"}
+        with self._jobs_lock:
+            ca = self._ctrl.get(job_id)
+            if ca is not None:
+                out = {"ok": True, "port": ca.port, "host": ca.host_name}
+                if req.get("relay"):
+                    out["relay_port"] = ca.relay_port()
+                return out
+        from ..ctrl.agent import ControlAgent
+
+        job_secret = derive_key(self.key, b"hvd-job:" + job_id.encode())
+        try:
+            ca = ControlAgent(job_secret,
+                              ckpt_dir=req.get("ckpt_dir") or None)
+            if req.get("root"):
+                ca.attach_root([(h, int(p)) for h, p in req["root"]])
+            if req.get("relay"):
+                ca.relay_port()
+        except Exception as e:
+            try:
+                ca.stop()
+            except Exception:
+                pass
+            return {"ok": False,
+                    "error": f"control agent failed on {host_hash()}: {e}"}
+        with self._jobs_lock:
+            live = self._ctrl.get(job_id)
+            if live is not None:   # lost the race; keep the first
+                ca.stop()
+                out = {"ok": True, "port": live.port, "host": live.host_name}
+                if req.get("relay"):
+                    out["relay_port"] = live.relay_port()
+                return out
+            self._ctrl[job_id] = ca
+        out = {"ok": True, "port": ca.port, "host": ca.host_name}
+        if req.get("relay"):
+            out["relay_port"] = ca.relay_port()
+        return out
+
+    def _stop_ctrl(self, job_id: str) -> None:
+        with self._jobs_lock:
+            ca = self._ctrl.pop(job_id, None)
+        if ca is not None:
+            try:
+                ca.stop()
+            except Exception:
+                pass
+
     def _spawn(self, req: Any, client_addr) -> Any:
         job_id = req["job_id"]
         cwd = req.get("cwd") or None
@@ -170,11 +239,28 @@ class HostAgent(BasicService):
         # (RemoteSpawner.job_secret), so it never crosses the unencrypted
         # channel in worker env.
         job_secret = derive_key(self.key, b"hvd-job:" + str(job_id).encode())
+        # Control tree (ISSUE 18): if this job has a local control agent,
+        # point the workers' runner-plane traffic at it (loopback, only
+        # when it actually has a root to forward to) and — when its engine
+        # relay is running — their coordinator hop too, unless the driver
+        # pinned something else.
+        with self._jobs_lock:
+            ca = self._ctrl.get(job_id)
+        relay_addr = ctrl_addr = ""
+        if ca is not None:
+            if ca.has_root():
+                ctrl_addr = json.dumps([["127.0.0.1", ca.port]])
+            if getattr(ca, "_relay", None) is not None:
+                relay_addr = f"127.0.0.1:{ca.relay_port()}"
         try:
             for w in req["workers"]:
                 env = dict(os.environ)
                 env.update(w.get("env") or {})
                 env["HOROVOD_SECRET"] = job_secret.hex()
+                if ctrl_addr:
+                    env.setdefault("HOROVOD_CTRL_ADDRS", ctrl_addr)
+                if relay_addr:
+                    env.setdefault("HOROVOD_CTRL_RELAY", relay_addr)
                 # Lets the worker's watchdog detect a parent that died
                 # before its first ppid snapshot (task_main.watch_parent).
                 env["HVD_PARENT_PID"] = str(os.getpid())
@@ -213,6 +299,7 @@ class HostAgent(BasicService):
         with self._jobs_lock:
             job = self._jobs.pop(job_id, None)
         self._stop_telemetry(job_id)
+        self._stop_ctrl(job_id)
         if job is not None:
             terminate_trees(list(job["procs"].values()))
 
@@ -229,10 +316,13 @@ class HostAgent(BasicService):
         with self._jobs_lock:
             jobs = list(self._jobs)
             tele = list(self._telemetry)
+            ctrl = list(self._ctrl)
         for jid in jobs:
             self._kill_job(jid)
         for jid in tele:
             self._stop_telemetry(jid)
+        for jid in ctrl:
+            self._stop_ctrl(jid)
         super().stop()
 
 

@@ -404,6 +404,11 @@ class BasicClient:
     def __init__(self, addresses, key: bytes, timeout: float = 60.0,
                  connect_retry_s: float = 0.0) -> None:
         self.key = key
+        # One request = one send + one recv on the session channel, so a
+        # client shared across threads (the control-tree host leader fans
+        # many rank handlers into ONE upstream connection) must serialize
+        # whole requests — interleaved sends would desequence the MAC.
+        self._lock = threading.Lock()
         deadline = time.monotonic() + max(connect_retry_s, 0.0)
         backoff = resilience.Backoff(base_s=0.05)
         last: Optional[Exception] = None
@@ -432,8 +437,22 @@ class BasicClient:
         raise ConnectionError(f"cannot reach service at {addresses}: {last}")
 
     def request(self, obj: Any) -> Any:
-        self._ch.send(obj)
-        return self._ch.recv()
+        with self._lock:
+            self._ch.send(obj)
+            return self._ch.recv()
+
+    def request_counted(self, obj: Any) -> tuple[Any, int, int]:
+        """``request`` plus this exchange's on-the-wire byte counts
+        ``(response, bytes_out, bytes_in)`` — the control tree's
+        ``horovod_ctrl_bytes_total`` accounting reads them per upstream
+        call instead of re-estimating frame overhead."""
+        with self._lock:
+            sent0 = self._ch.bytes_sent
+            recv0 = self._ch.bytes_received
+            self._ch.send(obj)
+            resp = self._ch.recv()
+            return (resp, self._ch.bytes_sent - sent0,
+                    self._ch.bytes_received - recv0)
 
     def close(self) -> None:
         try:

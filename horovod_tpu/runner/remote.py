@@ -138,6 +138,33 @@ class RemoteSpawner:
         channel learns neither the agent secret nor the job secret."""
         return derive_key(self.agent_secret, b"hvd-job:" + self.job_id.encode())
 
+    def start_control(self, root_addrs, relay: bool = True,
+                      ckpt_dir: str = "") -> None:
+        """Start each host's control-tree leader (ctrl.ControlAgent) BEFORE
+        :meth:`spawn`, so the agents can point worker env at it (ISSUE 18).
+        ``root_addrs`` is the driver service's address list; ``relay`` also
+        hosts the engine-coordinator relay; ``ckpt_dir`` exports that
+        directory for checkpoint streaming. A leader that fails to start
+        only costs that host the tree (its workers keep the flat path) —
+        logged loudly, never fatal."""
+        from ..utils.logging import log
+
+        for spec, client in zip(self.specs, self._clients):
+            if client is None:
+                continue
+            try:
+                resp = client.request({
+                    "kind": "ctrl", "cmd": "start", "job_id": self.job_id,
+                    "root": [list(a) for a in root_addrs],
+                    "relay": bool(relay), "ckpt_dir": ckpt_dir})
+            except (ConnectionError, OSError) as e:
+                resp = {"ok": False, "error": str(e)}
+            if not resp.get("ok"):
+                log("warning",
+                    f"[ctrl] control leader failed to start on {spec.host}: "
+                    f"{resp.get('error')} — that host's workers use the "
+                    "flat control plane")
+
     def spawn(self, make_argv: Callable[[int], list],
               make_env: Callable[[int], dict]) -> None:
         """Spawn the world: host i gets task indices

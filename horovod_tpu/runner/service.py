@@ -13,13 +13,27 @@ collects per-rank results.
 
 from __future__ import annotations
 
+import json
 import os
+import pickle
 import socket
 import threading
 import time
+import zlib
 from typing import Any, Callable, Optional
 
 from .network import BasicClient, BasicService
+
+
+def worker_addresses() -> list:
+    """The control-plane address list a spawned worker should dial:
+    ``HOROVOD_CTRL_ADDRS`` — the host's ControlAgent leader, injected by
+    HostAgent._spawn when the job runs a control tree (ISSUE 18) — when
+    present, else ``HOROVOD_DRIVER_ADDRS`` (the driver directly, the flat
+    star). Empty list when neither is set (not a launched worker)."""
+    raw = os.environ.get("HOROVOD_CTRL_ADDRS") \
+        or os.environ.get("HOROVOD_DRIVER_ADDRS")
+    return [tuple(a) for a in json.loads(raw)] if raw else []
 
 
 class WorkerRemovedError(RuntimeError):
@@ -133,6 +147,50 @@ class DriverService(BasicService):
             # its own monotonic readings and estimates its offset to the
             # driver clock. Stateless, so it needs no lock.
             return {"ok": True, "t": time.monotonic_ns()}
+        # Control-tree leader requests (ISSUE 18, ctrl/agent.py): one host
+        # leader carries its ranks' registrations and assignment waits in a
+        # single request, so root connections and control bytes stay
+        # O(hosts). Each entry routes through the SAME per-rank handlers
+        # (subclass dispatch included), so the tree path cannot drift from
+        # the flat protocol's semantics.
+        if kind == "host_register":
+            entries = req.get("entries") or []
+            if req.get("entries_z") is not None:
+                # Compressed batch (ctrl/agent.py _pack_register). Nested
+                # pickle adds no new trust surface: the outer frame is
+                # already pickle under the same HMAC key.
+                entries = pickle.loads(zlib.decompress(req["entries_z"]))
+            for e in entries:
+                self.handle(dict(e, kind=e.get("kind", "register")),
+                            client_addr)
+            return {"ok": True, "count": len(entries)}
+        if kind == "host_wait_assignment":
+            out: dict[int, Any] = {}
+            sub_base: dict[str, Any] = {"kind": "wait_assignment"}
+            if req.get("min_generation") is not None:
+                sub_base["min_generation"] = req["min_generation"]
+            # Sequential per-index waits share one formation event AND one
+            # deadline: the first blocks until ranks are assigned, the rest
+            # return immediately (removed indices answer without waiting at
+            # all). The shared deadline bounds the WHOLE request to the
+            # leader's timeout — per-index budgets would stack when the
+            # world hasn't formed, holding the leader's serialized upstream
+            # connection for indices × timeout.
+            deadline = time.monotonic() + float(req.get("timeout", 120.0))
+            for index in req.get("indices") or []:
+                out[int(index)] = self.handle(
+                    dict(sub_base, index=index,
+                         timeout=max(0.0, deadline - time.monotonic())),
+                    client_addr)
+            if req.get("z"):
+                # The host's assignments repeat topology fields and
+                # coordinator addresses — deflate the batch when it wins
+                # (the leader re-inflates and counts the saving).
+                raw = pickle.dumps(out, protocol=pickle.HIGHEST_PROTOCOL)
+                z = zlib.compress(raw, 6)
+                if len(z) < len(raw):
+                    return {"ok": True, "assignments_z": z}
+            return {"ok": True, "assignments": out}
         return {"ok": False, "error": f"unknown request {kind}"}
 
     # -- rank assignment (reference spark/__init__.py:143-152)
@@ -366,6 +424,18 @@ class ElasticDriverService(DriverService):
                          or req.get("generation", 0) != self.generation
                          or req["index"] in self._removed)
             return {"ok": True, "reset_required": reset}
+        if kind == "host_elastic_poll":
+            # Control-tree batched poll (ISSUE 18): one request answers a
+            # whole host's commit-time membership checks. The leader caches
+            # this verdict for HOROVOD_CTRL_POLL_S, so the root sees one
+            # poll per host per interval instead of one per rank.
+            with self._cv:
+                gen = self.generation
+                reset = self._forming or req.get("generation", 0) != gen
+                removed = sorted(i for i in (req.get("indices") or [])
+                                 if i in self._removed)
+            return {"ok": True, "reset_required": bool(reset),
+                    "generation": gen, "removed": removed}
         return super().handle(req, client_addr)
 
     # -- membership (launcher side)

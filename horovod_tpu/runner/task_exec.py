@@ -9,17 +9,16 @@ agent dies (agent.py orphan policy, layer 2)."""
 
 from __future__ import annotations
 
-import json
 import os
 import subprocess
 import sys
 
 
 def main() -> int:
-    from .service import TaskAgent
+    from .service import TaskAgent, worker_addresses
 
     index = int(os.environ["HOROVOD_TASK_INDEX"])
-    addrs = [tuple(a) for a in json.loads(os.environ["HOROVOD_DRIVER_ADDRS"])]
+    addrs = worker_addresses()  # host ControlAgent if a tree runs, else driver
     secret = bytes.fromhex(os.environ["HOROVOD_SECRET"])
     agent = TaskAgent(index, addrs, secret)
     agent.register()  # exports HOROVOD_RANK/.../HOROVOD_COORD_ADDR

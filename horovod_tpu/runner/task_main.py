@@ -3,7 +3,6 @@ mpirun_exec_fn.py): register with the driver, run the shipped fn, report."""
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 import threading
@@ -80,11 +79,11 @@ def watch_parent(on_death=None) -> int:
 
 
 def main() -> int:
-    from .service import TaskAgent
+    from .service import TaskAgent, worker_addresses
 
     watch_parent()
     index = int(os.environ["HOROVOD_TASK_INDEX"])
-    addrs = [tuple(a) for a in json.loads(os.environ["HOROVOD_DRIVER_ADDRS"])]
+    addrs = worker_addresses()  # host ControlAgent if a tree runs, else driver
     secret = bytes.fromhex(os.environ["HOROVOD_SECRET"])
     TaskAgent(index, addrs, secret).run()
     return 0

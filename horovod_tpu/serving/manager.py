@@ -161,12 +161,38 @@ class ReplicaManager:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "ReplicaManager":
+        self._prefetch_checkpoint()
         for _ in range(self.cfg.min_replicas):
             self._spawn()
         self._supervisor = threading.Thread(
             target=self._supervise, name="hvd_serve_supervisor", daemon=True)
         self._supervisor.start()
         return self
+
+    def _prefetch_checkpoint(self) -> None:
+        """Streaming cold start (ISSUE 18): a fresh serving host whose
+        checkpoint path does not exist locally fetches the latest committed
+        copy from a peer host leader (``HOROVOD_CKPT_STREAM_FROM``,
+        authenticated by ``HOROVOD_SECRET``) BEFORE the first replica
+        spawns — otherwise every replica would fail bring-up against a
+        missing path and burn the startup-failure budget. Best-effort: with
+        no sources configured or the path already present, this is a
+        no-op; a failed fetch degrades to the old behavior (spawn fails
+        loudly against the missing path)."""
+        if not self.checkpoint or os.path.exists(self.checkpoint):
+            return
+        from ..ckpt_async.stream import fetch_from_peer, stream_sources_from_env
+
+        sources = stream_sources_from_env()
+        key_hex = os.environ.get("HOROVOD_SECRET", "")
+        if not sources or not key_hex:
+            return
+        try:
+            fetch_from_peer(sources, bytes.fromhex(key_hex), self.checkpoint)
+        except Exception as e:  # noqa: BLE001 - spawn reports the real miss
+            log("warning", f"serving: checkpoint streaming from "
+                           f"{sources} failed ({e}); replicas will try the "
+                           f"local path {self.checkpoint!r} as-is")
 
     def stop(self) -> None:
         self._closed.set()

@@ -1,0 +1,284 @@
+"""Async checkpoint commits + streaming cold-start (ISSUE 18).
+
+The contract under test: moving the commit off the step path changes
+NOTHING about crash consistency — a SIGKILL mid-stage leaves the old
+checkpoint (the torn stage is discarded), a SIGKILL mid-rename leaves an
+adoptable complete stage (healed on the next restore), and a checkpoint
+streamed from a peer is bitwise identical to one restored from the
+filesystem."""
+
+import hashlib
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import checkpoint
+from horovod_tpu.ckpt_async import (
+    AsyncCheckpointer,
+    fetch_from_peer,
+    serve_chunk,
+    serve_manifest,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tree_hash(root):
+    """Order-stable digest over every file's relative path + bytes."""
+    h = hashlib.sha256()
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames.sort()
+        for name in sorted(files):
+            p = os.path.join(dirpath, name)
+            h.update(os.path.relpath(p, root).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+# -- background writer --------------------------------------------------------
+
+
+def test_async_writer_commits_latest(tmp_path):
+    path = str(tmp_path / "ck")
+    w = AsyncCheckpointer(path)
+    try:
+        w.submit({"w": np.arange(4.0)})
+        assert w.wait(60)
+        w.submit({"w": np.arange(4.0) * 3})
+    finally:
+        w.close()
+    assert w.commits == 2
+    out = checkpoint.restore(path, template={"w": np.zeros(4)})
+    np.testing.assert_array_equal(out["w"], np.arange(4.0) * 3)
+    # commit discipline left no stage/trash debris
+    assert sorted(os.listdir(tmp_path)) == ["ck"]
+
+
+def test_async_writer_error_reraised_on_step_thread(tmp_path):
+    def boom(path, state, step=None):
+        raise RuntimeError("disk on fire")
+
+    w = AsyncCheckpointer(str(tmp_path / "ck"), save_fn=boom)
+    w.submit({"w": np.ones(2)})
+    with pytest.raises(RuntimeError, match="background checkpoint commit"):
+        # surfaces on the NEXT training-thread interaction
+        for _ in range(200):
+            time.sleep(0.01)
+            w.submit({"w": np.ones(2)})
+    # the raise consumed the error; a fresh failed commit re-arms it and
+    # close() refuses to swallow it
+    w.submit({"w": np.ones(2)})
+    with pytest.raises(RuntimeError, match="background checkpoint commit"):
+        w.close()
+
+
+def test_elastic_commit_drains_to_same_process_reader(tmp_path, monkeypatch):
+    """ElasticState.commit goes through the async writer (default ON) and a
+    cold load_checkpoint in the same process flushes it first."""
+    from horovod_tpu.elastic.state import ElasticState
+
+    monkeypatch.delenv("HOROVOD_CKPT_ASYNC", raising=False)
+    ckdir = str(tmp_path / "ck")
+    state = ElasticState(checkpoint_dir=ckdir, step=0,
+                         params=np.arange(6.0))
+    state.step = 7
+    state.params = np.arange(6.0) * 2
+    state.commit(check_host_updates=False)
+    assert state._async_writer is not None
+    assert state.checkpoint_wait(60)
+    cold = ElasticState(checkpoint_dir=ckdir, step=0, params=np.zeros(6))
+    assert cold.load_checkpoint() is True
+    assert int(cold.step) == 7
+    np.testing.assert_array_equal(np.asarray(cold.params), np.arange(6.0) * 2)
+    state._async_writer.close()
+
+
+def test_elastic_commit_sync_when_knobbed_off(tmp_path, monkeypatch):
+    from horovod_tpu.elastic.state import ElasticState
+
+    monkeypatch.setenv("HOROVOD_CKPT_ASYNC", "0")
+    ckdir = str(tmp_path / "ck")
+    state = ElasticState(checkpoint_dir=ckdir, step=3, params=np.ones(2))
+    state.commit(check_host_updates=False)
+    assert state._async_writer is None          # sync path took it
+    assert os.path.isdir(ckdir)
+
+
+# -- SIGKILL crash windows ----------------------------------------------------
+
+_KILL_SCRIPT = """
+import os, sys
+sys.path.insert(0, os.environ["HVD_REPO"])
+import numpy as np
+from horovod_tpu.ckpt_async import AsyncCheckpointer
+
+w = AsyncCheckpointer(os.environ["CK_PATH"])
+w.submit({"w": np.arange(4.0) * 5, "step": np.int64(2)})
+w.wait(120)
+print("COMMITTED", flush=True)
+"""
+
+
+def _spawn_killed_commit(path, stall_point, marker_fn, timeout=60.0):
+    """Run the async-writer script with the commit stalled at
+    ``stall_point``, SIGKILL it the moment ``marker_fn()`` sees the stall
+    window's filesystem state, and assert the kill landed mid-commit."""
+    env = dict(os.environ,
+               HVD_REPO=REPO, CK_PATH=path, JAX_PLATFORMS="cpu",
+               HOROVOD_CKPT_TEST_STALL=stall_point,
+               HOROVOD_CKPT_TEST_STALL_S="45")
+    proc = subprocess.Popen([sys.executable, "-c", _KILL_SCRIPT], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if marker_fn():
+                break
+            if proc.poll() is not None:
+                out, err = proc.communicate()
+                raise AssertionError(
+                    f"writer exited before the {stall_point} window:\n"
+                    f"{err.decode()[-2000:]}")
+            time.sleep(0.02)
+        else:
+            raise AssertionError(f"{stall_point} window never appeared")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert proc.returncode == -signal.SIGKILL
+
+
+def _siblings(path, infix):
+    parent, base = os.path.split(path)
+    try:
+        return [n for n in os.listdir(parent)
+                if n.startswith(base + infix)]
+    except OSError:
+        return []
+
+
+def test_sigkill_mid_stage_discards_and_keeps_old(tmp_path):
+    """Kill while the stage exists but carries no .ok: heal discards the
+    torn stage; the previous commit restores bitwise intact."""
+    path = str(tmp_path / "ck")
+    checkpoint.save_local(path, {"w": np.arange(4.0), "step": np.int64(1)})
+    before = _tree_hash(path)
+
+    def in_stage_window():
+        stages = [n for n in _siblings(path, ".tmp.")
+                  if not n.endswith(".ok")]
+        return bool(stages) and not any(
+            n.endswith(".ok") for n in _siblings(path, ".tmp."))
+
+    _spawn_killed_commit(path, "stage", in_stage_window)
+    checkpoint._heal_interrupted(path)
+    assert _siblings(path, ".tmp.") == [] and _siblings(path, ".trash.") == []
+    assert _tree_hash(path) == before       # old checkpoint bitwise intact
+    out = checkpoint.restore(path, template={"w": np.zeros(4),
+                                             "step": np.array(0, np.int64)})
+    np.testing.assert_array_equal(out["w"], np.arange(4.0))
+
+
+def test_sigkill_mid_rename_adopts_complete_stage(tmp_path):
+    """Kill between the swap's two renames (old moved aside, new not yet
+    in): the complete .ok stage is adopted and the NEW commit restores."""
+    path = str(tmp_path / "ck")
+    checkpoint.save_local(path, {"w": np.arange(4.0), "step": np.int64(1)})
+
+    def in_rename_window():
+        return bool(_siblings(path, ".trash.")) and not os.path.exists(path)
+
+    _spawn_killed_commit(path, "rename", in_rename_window)
+    assert not os.path.exists(path)          # died inside the window
+    # restore() heals: adopts the complete stage, discards the trash
+    out = checkpoint.restore(path, template={"w": np.zeros(4),
+                                             "step": np.array(0, np.int64)})
+    np.testing.assert_array_equal(out["w"], np.arange(4.0) * 5)
+    assert int(out["step"]) == 2
+    checkpoint._heal_interrupted(path)
+    assert _siblings(path, ".tmp.") == [] and _siblings(path, ".trash.") == []
+
+
+# -- checkpoint streaming -----------------------------------------------------
+
+
+def test_stream_fetch_bitwise_matches_filesystem(tmp_path):
+    """A joiner's streamed checkpoint is bitwise identical to the peer's,
+    and restores to the same values as a filesystem restore."""
+    from horovod_tpu.ctrl.agent import ControlAgent
+
+    src = str(tmp_path / "ck")
+    checkpoint.save_local(src, {"w": np.arange(8.0), "step": np.int64(4)})
+    agent = ControlAgent(b"stream-secret", ckpt_dir=src)
+    dest = str(tmp_path / "fetched")
+    try:
+        man = fetch_from_peer([("127.0.0.1", agent.port)], b"stream-secret",
+                              dest)
+    finally:
+        agent.stop()
+    assert man["ok"] and man["total_bytes"] > 0
+    assert _tree_hash(src) == _tree_hash(dest)
+    got = checkpoint.load_for_inference(dest)
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.arange(8.0))
+    # publish used the commit discipline: no stage/marker debris
+    assert sorted(os.listdir(tmp_path)) == ["ck", "fetched"]
+
+
+def test_stream_manifest_skips_uncommitted(tmp_path):
+    src = str(tmp_path / "ck")
+    checkpoint.save_local(src, {"w": np.ones(2)})
+    os.makedirs(os.path.join(src, "x.tmp.99"))
+    with open(os.path.join(src, "x.tmp.99", "torn"), "w") as f:
+        f.write("torn")
+    with open(src + ".ok", "w") as f:
+        f.write("marker")
+    man = serve_manifest(src)
+    assert man["ok"]
+    assert all(".tmp." not in e["path"] and not e["path"].endswith(".ok")
+               for e in man["files"])
+
+
+def test_stream_chunk_rejects_traversal(tmp_path):
+    src = str(tmp_path / "ck")
+    checkpoint.save_local(src, {"w": np.ones(2)})
+    bad = serve_chunk(src, {"path": "../../etc/passwd"})
+    assert bad["ok"] is False and "escapes" in bad["error"]
+
+
+def test_stream_corrupt_peer_never_published(tmp_path, monkeypatch):
+    """A digest mismatch aborts BEFORE publish: no destination directory,
+    no adoptable .ok stage."""
+    from horovod_tpu.ckpt_async import stream as stream_mod
+    from horovod_tpu.ctrl.agent import ControlAgent
+
+    src = str(tmp_path / "ck")
+    checkpoint.save_local(src, {"w": np.arange(4.0)})
+    agent = ControlAgent(b"stream-secret", ckpt_dir=src)
+    dest = str(tmp_path / "fetched")
+    monkeypatch.setattr(stream_mod, "_sha256_file", lambda p: "0" * 64)
+    try:
+        with pytest.raises(RuntimeError, match="refusing to publish"):
+            fetch_from_peer([("127.0.0.1", agent.port)], b"stream-secret",
+                            dest)
+    finally:
+        agent.stop()
+    assert not os.path.exists(dest)
+    assert not os.path.exists(dest + ".ok")
+
+
+def test_stream_sources_env_parse(monkeypatch):
+    from horovod_tpu.ckpt_async.stream import stream_sources_from_env
+
+    monkeypatch.setenv("HOROVOD_CKPT_STREAM_FROM",
+                       "10.0.0.1:9100, host-b:9101")
+    assert stream_sources_from_env() == [("10.0.0.1", 9100),
+                                        ("host-b", 9101)]

@@ -1,0 +1,454 @@
+"""Control-tree tests (ISSUE 18): per-host ControlAgent aggregation on the
+runner plane, CoordRelay batching/barriers on the engine plane, and the
+liveness semantics (peer_lost, host-drop) the tree must preserve."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.ctrl.agent import ControlAgent
+from horovod_tpu.ctrl.relay import CoordRelay
+from horovod_tpu.ctrl.tree import use_tree
+from horovod_tpu.runner.network import BasicClient, BasicService
+from horovod_tpu.runner.service import (
+    DriverService,
+    ElasticDriverService,
+    TaskAgent,
+)
+
+KEY = b"ctrl-test-secret"
+
+
+# -- tree gate ----------------------------------------------------------------
+
+
+def test_use_tree_gates(monkeypatch):
+    monkeypatch.delenv("HOROVOD_CTRL_TREE", raising=False)
+    assert use_tree(2, 8) is True
+    assert use_tree(1, 8) is False      # single host: nothing to fan through
+    assert use_tree(2, 2) is False      # degenerate grouping
+    monkeypatch.setenv("HOROVOD_CTRL_TREE", "0")
+    assert use_tree(2, 8) is False      # knobbed off
+
+
+# -- runner plane: ControlAgent ----------------------------------------------
+
+
+def test_control_agent_batches_registrations():
+    """A host's ranks registering through the leader get the same ranks the
+    flat path assigns, with far fewer upstream requests than ranks."""
+    # TaskAgent.register() exports the assignment (HOROVOD_RANK/SIZE/
+    # COORD_ADDR...) into os.environ — correct in a worker process, a leak
+    # when run in-process: restore the environment afterwards or later
+    # tests see a phantom 4-rank world.
+    env_before = dict(os.environ)
+    driver = DriverService(4, KEY, fn=None)
+    ca = ControlAgent(KEY, batch_s=0.05)
+    ca.attach_root(driver.addresses())
+    results: dict[int, dict] = {}
+    errors: list = []
+
+    def worker(index):
+        try:
+            agent = TaskAgent(index, [("127.0.0.1", ca.port)], KEY)
+            try:
+                results[index] = agent.register()
+            finally:
+                agent.client.close()
+        except Exception as e:  # noqa: BLE001 - surfaced via assert below
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert sorted(r["rank"] for r in results.values()) == [0, 1, 2, 3]
+        for r in results.values():
+            assert r["topology"]["size"] == 4
+        # 4 registrations + 4 assignment waits flat = 8 root requests;
+        # batched they ride ~2 (one host_register + one
+        # host_wait_assignment, modulo a latecomer follow-up).
+        assert ca.upstream_requests() < 8
+    finally:
+        ca.stop()
+        driver.stop()
+        os.environ.clear()
+        os.environ.update(env_before)
+
+
+def test_control_agent_straggler_register_not_starved():
+    """Head-of-line regression: the leader's grouped assignment wait must
+    not hold the one upstream connection while a straggler's register
+    batch queues behind it — the driver needs that registration before
+    the wait can resolve. Short upstream polls bound the stall; an
+    unbounded long-poll deadlocks here until the 120 s window expires."""
+    driver = ElasticDriverService(KEY, fn=None)
+    driver.begin_reset({0, 1})
+    ca = ControlAgent(KEY, batch_s=0.01)
+    ca.attach_root(driver.addresses())
+    results: dict[int, dict] = {}
+    errors: list = []
+
+    def worker(index, delay):
+        try:
+            time.sleep(delay)
+            client = BasicClient([("127.0.0.1", ca.port)], KEY, timeout=60.0)
+            try:
+                client.request({
+                    "kind": "register", "index": index,
+                    "host_hash": "straggler-host",
+                    "addresses": [("127.0.0.1", 40000 + index)],
+                    "coord_port": 40000 + index,
+                    "jax_coord_port": 41000 + index})
+                results[index] = client.request(
+                    {"kind": "wait_assignment", "index": index,
+                     "min_generation": 1, "timeout": 30.0})
+            finally:
+                client.close()
+        except Exception as e:  # noqa: BLE001 - surfaced via assert below
+            errors.append(e)
+
+    try:
+        t0 = time.monotonic()
+        # rank 0 registers and waits immediately; rank 1 straggles in long
+        # after rank 0's batch closed and its wait poll went upstream
+        threads = [threading.Thread(target=worker, args=(0, 0.0)),
+                   threading.Thread(target=worker, args=(1, 0.5))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        took = time.monotonic() - t0
+        assert not errors, errors
+        assert sorted(results) == [0, 1]
+        for r in results.values():
+            assert r["ok"], r
+        # bounded by one short poll round, nowhere near the 120 s window
+        assert took < ca.WAIT_POLL_S + 10.0, took
+    finally:
+        ca.stop()
+        driver.stop()
+
+
+def test_control_agent_elastic_poll_cached():
+    """Commit-time polls within HOROVOD_CTRL_POLL_S are answered from the
+    leader's cache: many rank polls, one upstream host_elastic_poll."""
+    driver = ElasticDriverService(KEY, fn=None)
+    ca = ControlAgent(KEY, poll_s=5.0, batch_s=0.01)
+    ca.attach_root(driver.addresses())
+    client = BasicClient([("127.0.0.1", ca.port)], KEY, timeout=30.0)
+    try:
+        before = ca.upstream_requests()
+        for index in range(6):
+            resp = client.request({"kind": "elastic_poll", "index": index,
+                                   "generation": 0})
+            assert resp["ok"] and resp["reset_required"] is False
+        assert ca.upstream_requests() == before + 1
+    finally:
+        client.close()
+        ca.stop()
+        driver.stop()
+
+
+def test_control_agent_poll_reports_removed_rank():
+    """The cached verdict must not blur per-rank removal: a removed index
+    polls reset_required=True while its host-mates poll False."""
+    driver = ElasticDriverService(KEY, fn=None)
+    ca = ControlAgent(KEY, poll_s=5.0, batch_s=0.01)
+    ca.attach_root(driver.addresses())
+    client = BasicClient([("127.0.0.1", ca.port)], KEY, timeout=30.0)
+    try:
+        # Teach the leader its index set first (cache keys on it).
+        for index in (0, 1):
+            client.request({"kind": "ctrl_hello", "index": index})
+        with driver._cv:
+            driver._removed.add(1)
+        assert client.request({"kind": "elastic_poll", "index": 0,
+                               "generation": 0})["reset_required"] is False
+        assert client.request({"kind": "elastic_poll", "index": 1,
+                               "generation": 0})["reset_required"] is True
+    finally:
+        client.close()
+        ca.stop()
+        driver.stop()
+
+
+def test_control_agent_passthrough_verbatim():
+    """Kinds the leader does not aggregate reach the root untouched — a
+    worker pointed at the tree never needs a second address."""
+    class Echo(BasicService):
+        def handle(self, req, client_addr):
+            return {"ok": True, "echo": req}
+
+    root = Echo(KEY)
+    ca = ControlAgent(KEY)
+    ca.attach_root([("127.0.0.1", root.port)])
+    client = BasicClient([("127.0.0.1", ca.port)], KEY, timeout=30.0)
+    try:
+        resp = client.request({"kind": "result", "rank": 3, "value": 42})
+        assert resp["echo"] == {"kind": "result", "rank": 3, "value": 42}
+    finally:
+        client.close()
+        ca.stop()
+        root.stop()
+
+
+def test_control_agent_without_root_errors_loudly():
+    ca = ControlAgent(KEY)
+    client = BasicClient([("127.0.0.1", ca.port)], KEY, timeout=30.0)
+    try:
+        resp = client.request({"kind": "result", "rank": 0, "value": 1})
+        assert resp["ok"] is False and "no root" in resp["error"]
+        assert ca.has_root() is False
+    finally:
+        client.close()
+        ca.stop()
+
+
+def test_host_agent_ctrl_cmd_idempotent():
+    """HostAgent `ctrl` hosting: start is idempotent (same leader/port),
+    relay starts on request, and job kill stops both."""
+    from horovod_tpu.runner.agent import HostAgent
+    from horovod_tpu.runner.network import derive_key
+
+    agent_secret = b"agent-secret-ctrl"
+    agent = HostAgent(agent_secret, host="127.0.0.1", port=0)
+    client = BasicClient([("127.0.0.1", agent.port)], agent_secret,
+                         timeout=30.0)
+    try:
+        a = client.request({"kind": "ctrl", "cmd": "start", "job_id": "j1",
+                            "relay": True})
+        assert a["ok"] and a["port"] > 0 and a["relay_port"] > 0
+        b = client.request({"kind": "ctrl", "cmd": "start", "job_id": "j1",
+                            "relay": True})
+        assert (b["port"], b["relay_port"]) == (a["port"], a["relay_port"])
+        # the leader is keyed with the derived job secret
+        job_secret = derive_key(agent_secret, b"hvd-job:j1")
+        cc = BasicClient([("127.0.0.1", a["port"])], job_secret, timeout=30.0)
+        hello = cc.request({"kind": "ctrl_hello", "index": 0})
+        cc.close()
+        assert hello["ok"]
+        client.request({"kind": "kill", "job_id": "j1"})
+        assert agent._ctrl == {}
+    finally:
+        client.close()
+        agent.stop()
+
+
+# -- engine plane: CoordRelay -------------------------------------------------
+
+
+@pytest.fixture()
+def engine_coord():
+    from horovod_tpu.common.engine import _Coordinator
+
+    coord = _Coordinator(4, "127.0.0.1", 0, key=KEY)
+    port = coord.server.getsockname()[1]
+    coord.start()
+    yield coord, port
+    coord.stop()
+
+
+def test_relay_exchange_barrier_probe(engine_coord, monkeypatch):
+    """4 ranks through one relay: coalesced exchanges produce the same
+    allreduce result, ring_hello resolves the shared world verdict, and
+    clock probes pass through."""
+    import numpy as np
+
+    from horovod_tpu.common.engine import _Client
+
+    coord, port = engine_coord
+    relay = CoordRelay(KEY, window_s=0.02)
+    monkeypatch.setenv("HOROVOD_CTRL_RELAY", f"127.0.0.1:{relay.port}")
+    results: dict = {}
+    errors: list = []
+
+    def worker(rank):
+        try:
+            client = _Client("127.0.0.1", port, rank, key=KEY, local=4)
+            try:
+                req = [{"name": "g", "op": "allreduce", "shape": (3,),
+                        "dtype": "float64", "root": 0, "average": True}]
+                arr = np.full((3,), float(rank))
+                out: dict = {}
+                for _ in range(40):
+                    out.update(client.exchange(
+                        req, {"g": arr} if not out else {}))
+                    if "g" in out:
+                        break
+                hello = client.ring_hello({"enabled": False})
+                probe = client.clock_probe()
+                results[rank] = (out["g"], hello, probe)
+            finally:
+                client.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        expect = np.full((3,), 1.5)
+        for r in range(4):
+            err, val = results[r][0]
+            assert err is None, err
+            np.testing.assert_allclose(val, expect)
+            assert results[r][1] == {"peers": None}
+            assert isinstance(results[r][2], int)
+    finally:
+        relay.stop()
+
+
+class _FakeCoord:
+    """Raw engine-wire coordinator stub: records every message, answers
+    {'ok': 1} — for testing what the relay SENDS upstream."""
+
+    def __init__(self, key):
+        from horovod_tpu.common.engine import _recv_msg, _send_msg
+
+        self.key = key
+        self.messages: list = []
+        self._recv, self._send = _recv_msg, _send_msg
+        self._conns: list = []
+        self._listener = socket.create_server(("127.0.0.1", 0))
+        self.port = self._listener.getsockname()[1]
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = self._recv(conn, self.key)
+                self.messages.append(msg)
+                if msg.get("kind") == "bye":
+                    return
+                self._send(conn, {"ok": 1}, self.key)
+        except (ConnectionError, EOFError, OSError):
+            return
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        self._listener.close()
+        for conn in self._conns:   # die like a killed process: conns too
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def test_relay_reports_peer_lost_on_unclean_drop():
+    """An unclean LOCAL drop becomes a targeted upstream peer_lost; a clean
+    bye does not — the flat path's rung-3 liveness, one rank wide."""
+    from horovod_tpu.common.engine import _recv_msg, _send_msg
+
+    fake = _FakeCoord(KEY)
+    relay = CoordRelay(KEY)
+    try:
+        def dial(rank):
+            s = socket.create_connection(("127.0.0.1", relay.port), timeout=10)
+            _send_msg(s, {"kind": "relay_hello", "rank": rank, "local": 2,
+                          "coord": ["127.0.0.1", fake.port]}, KEY)
+            _recv_msg(s, KEY)
+            return s
+
+        s5, s6 = dial(5), dial(6)
+        s5.close()                       # unclean: no bye
+        _send_msg(s6, {"kind": "bye"}, KEY)   # clean shutdown
+        s6.close()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if any(m.get("kind") == "peer_lost" for m in fake.messages):
+                break
+            time.sleep(0.05)
+        lost = [m for m in fake.messages if m.get("kind") == "peer_lost"]
+        assert [m["lost"] for m in lost] == [5]
+        hellos = [m for m in fake.messages if m.get("kind") == "relay_hello"]
+        assert hellos and set(hellos[-1]["ranks"]) <= {5, 6}
+    finally:
+        relay.stop()
+        fake.stop()
+
+
+def test_coordinator_fails_relayed_ranks_on_relay_drop():
+    """Coordinator side of the failure domain: when a connection that
+    declared relay_for ranks drops uncleanly, every declared rank is
+    failed — a dead host leader reads as that whole host dying."""
+    from horovod_tpu.common.engine import _Coordinator, _recv_msg, _send_msg
+
+    coord = _Coordinator(4, "127.0.0.1", 0, key=KEY)
+    port = coord.server.getsockname()[1]
+    coord.start()
+    try:
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        _send_msg(s, {"kind": "relay_hello", "ranks": [2, 3]}, KEY)
+        assert _recv_msg(s, KEY)["ok"] == 1
+        s.close()                        # unclean relay death
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with coord._cv:
+                if coord._dead >= {2, 3}:
+                    break
+            time.sleep(0.05)
+        with coord._cv:
+            assert coord._dead >= {2, 3}
+    finally:
+        coord.stop()
+
+
+def test_relay_closes_locals_when_upstream_dies():
+    """Relay-side escalation: coordinator death closes every local
+    connection so ranks fall into the elastic reset instead of hanging."""
+    from horovod_tpu.common.engine import _recv_msg, _send_msg
+
+    fake = _FakeCoord(KEY)
+    relay = CoordRelay(KEY)
+    try:
+        s = socket.create_connection(("127.0.0.1", relay.port), timeout=10)
+        _send_msg(s, {"kind": "relay_hello", "rank": 0, "local": 1,
+                      "coord": ["127.0.0.1", fake.port]}, KEY)
+        _recv_msg(s, KEY)
+        fake.stop()                      # coordinator gone
+        # next pass-through forces the relay to notice the dead upstream
+        _send_msg(s, {"kind": "clock_probe"}, KEY)
+        s.settimeout(10)
+        with pytest.raises((ConnectionError, EOFError, OSError)):
+            while True:
+                _recv_msg(s, KEY)
+    finally:
+        relay.stop()
+
+
+def test_worker_addresses_prefers_ctrl(monkeypatch):
+    from horovod_tpu.runner.service import worker_addresses
+
+    monkeypatch.setenv("HOROVOD_DRIVER_ADDRS",
+                       json.dumps([["10.0.0.1", 9000]]))
+    monkeypatch.delenv("HOROVOD_CTRL_ADDRS", raising=False)
+    assert worker_addresses() == [("10.0.0.1", 9000)]
+    monkeypatch.setenv("HOROVOD_CTRL_ADDRS",
+                       json.dumps([["127.0.0.1", 7777]]))
+    assert worker_addresses() == [("127.0.0.1", 7777)]

@@ -215,6 +215,8 @@ def extract(root: str) -> dict:
                 exchange_shape.all_keys() if exchange_shape else [],
             "exchange_response_fields": response_keys,
             "request_key_fields": request_key_fields,
+            "coord_wire_kinds": pysrc.find_string_compares(
+                engine_mod, "kind", "_serve", class_name="_Coordinator"),
             "ops": pysrc.module_constant(native_mod, "OPS") or {},
             "dtypes": pysrc.module_constant(native_mod, "DTYPES") or [],
             "status_names": {
@@ -277,6 +279,7 @@ def check(root: str, spec: Optional[dict] = None) -> list[Finding]:
             ("python exchange response keys",
              py["exchange_response_fields"]),
             ("python request_key signature", py["request_key_fields"]),
+            ("python coordinator wire kinds", py["coord_wire_kinds"]),
             ("native wire.h structs", native["messages"]),
             ("native enums", native["enums"]),
             ("native cache_key fields", native["cache_key_fields"])):
@@ -365,6 +368,11 @@ def check(root: str, spec: Optional[dict] = None) -> list[Finding]:
         "PY_REQUEST_FIELDS": py["request_fields"],
         "PY_REQUEST_OPTIONAL_FIELDS": py["request_optional_fields"],
         "STATUS_NAMES": {int(k): v for k, v in py["status_names"].items()},
+        # ISSUE 18: the coordinator's dispatch alphabet, machine-extracted
+        # from _Coordinator._serve in source order — the control-tree
+        # relay (ctrl/relay.py) special-cases a subset and must notice
+        # when a kind is added or renamed.
+        "COORD_WIRE_KINDS": py["coord_wire_kinds"],
     }
     for const, want in core_tables.items():
         got = pysrc.module_constant(core, const)

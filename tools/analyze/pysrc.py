@@ -304,6 +304,35 @@ def find_subscript_reads(module: ast.Module, func_name: str,
     return keys
 
 
+def find_string_compares(module: ast.Module, var_name: str, func_name: str,
+                         class_name: Optional[str] = None) -> list[str]:
+    """Ordered unique string literals a function compares ``var_name``
+    against (``var == "lit"`` or ``var in ("a", "b")``) — the dispatch
+    alphabet of a wire-kind switch, in source order."""
+    target = _find_function(module, func_name, class_name)
+    if target is None:
+        return []
+    kinds: list[str] = []
+
+    def add(v) -> None:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str) \
+                and v.value not in kinds:
+            kinds.append(v.value)
+
+    for node in ast.walk(target):
+        if (isinstance(node, ast.Compare)
+                and isinstance(node.left, ast.Name)
+                and node.left.id == var_name):
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, ast.Eq):
+                    add(comp)
+                elif isinstance(op, ast.In) \
+                        and isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                    for elt in comp.elts:
+                        add(elt)
+    return kinds
+
+
 def _find_function(module: ast.Module, func_name: str,
                    class_name: Optional[str]) -> Optional[ast.AST]:
     for node in ast.walk(module):

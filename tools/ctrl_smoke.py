@@ -1,0 +1,361 @@
+#!/usr/bin/env python
+"""CI smoke for the pod-scale control tree + async checkpoints (ISSUE 18).
+
+Simulated 8-host x 8-rank grid (world 64): per-host ControlAgents (the
+leaders a runner HostAgent would host) in front of one ElasticDriverService,
+one REAL subprocess rank that registers and polls through its leader, the
+remaining ranks in-process. Proves the pod-scale control contract:
+
+1.  rendezvous leg: 64 ranks register and wait for assignments THROUGH 8
+    leaders — batched host_register / grouped host_wait_assignment — and
+    get exactly the ranks the flat path assigns, with O(hosts) root
+    connections.
+2.  steady-state leg: every rank's commit-time elastic_poll + clock probe
+    rides the leader cache / on-host responder; rank 0 commits an
+    ElasticState checkpoint EVERY step through the background async
+    writer (crash-consistent stage -> fsync -> .ok -> rename pipeline).
+3.  failure leg: the subprocess rank is SIGKILL'd and one host's leader
+    dies abruptly MID-RUN; the supervisor folds both into EXACTLY ONE
+    elastic reset (generation 1 -> 2, never 3) that also admits a joiner
+    host.
+4.  resume leg: survivors re-rendezvous through their leaders; the new
+    world's state restores from the last async commit (step intact).
+5.  streaming leg: the joiner host's leader cold-starts by fetching the
+    committed checkpoint from a surviving leader (ckpt_manifest /
+    ckpt_fetch) — bitwise identical tree, bounded wall clock.
+6.  gate leg: root control bytes, tree vs the same phases replayed flat
+    (every rank -> root) — emitted as ``ctrl_smoke_root_byte_reduction``
+    and gated >= 6x in ci.sh.
+
+Exits non-zero with a reason on any violation. Wall-clock budget ~30 s.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+HOSTS = 8
+PER_HOST = 8
+WORLD = HOSTS * PER_HOST
+DEAD_RANK = 2 * PER_HOST       # the subprocess rank, SIGKILL'd mid-run
+DEAD_LEADER_HOST = 5           # its leader dies abruptly mid-run
+COMMITS = 5
+POLL_ROUNDS = 4
+
+
+def fail(msg: str) -> None:
+    print(f"ctrl smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def check(ok: bool, msg: str) -> None:
+    if not ok:
+        fail(msg)
+    print(f"  ok: {msg}")
+
+
+def tree_hash(root: str) -> str:
+    h = hashlib.sha256()
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames.sort()
+        for name in sorted(files):
+            p = os.path.join(dirpath, name)
+            h.update(os.path.relpath(p, root).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def reg_req(index: int, host: int) -> dict:
+    return {"kind": "register", "index": index,
+            "host_hash": f"ctrl-smoke-host-{host:02d}",
+            "addresses": [("127.0.0.1", 40000 + index)],
+            "coord_port": 40000 + index, "jax_coord_port": 42000 + index}
+
+
+def worker_main() -> int:
+    """One real rank: register + wait through the leader, then poll
+    membership every 100 ms until SIGKILL'd."""
+    from horovod_tpu.runner.network import BasicClient
+
+    index = int(os.environ["HVD_CTRL_SMOKE_INDEX"])
+    port = int(os.environ["HVD_CTRL_SMOKE_LEADER_PORT"])
+    key = bytes.fromhex(os.environ["HVD_CTRL_SMOKE_KEY"])
+    client = BasicClient([("127.0.0.1", port)], key, timeout=60.0)
+    client.request(reg_req(index, index // PER_HOST))
+    a = client.request({"kind": "wait_assignment", "index": index,
+                        "min_generation": 1, "timeout": 60.0})
+    print(json.dumps({"worker": "ready", "index": index,
+                      "rank": a.get("rank"), "pid": os.getpid()}),
+          flush=True)
+    while True:
+        client.request({"kind": "elastic_poll", "index": index,
+                        "generation": a.get("generation", 1)})
+        time.sleep(0.1)
+    return 0
+
+
+def rendezvous(pairs, min_gen: int) -> dict:
+    """(index, host, client) triples register + wait; returns
+    index -> assignment."""
+    results: dict[int, dict] = {}
+    errors: list = []
+
+    def one(index, host, client):
+        try:
+            client.request(reg_req(index, host))
+            r = client.request({"kind": "wait_assignment", "index": index,
+                                "min_generation": min_gen, "timeout": 60.0})
+            if not (isinstance(r, dict) and r.get("ok")):
+                raise RuntimeError(f"assignment failed for {index}: {r}")
+            results[index] = r
+        except Exception as e:  # noqa: BLE001 - surfaced by caller
+            errors.append((index, e))
+
+    threads = [threading.Thread(target=one, args=p, daemon=True)
+               for p in pairs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    if errors:
+        fail(f"rendezvous errors: {errors[:3]}")
+    return results
+
+
+def poll_round(pairs, generation: int) -> None:
+    for index, _host, client in pairs:
+        r = client.request({"kind": "elastic_poll", "index": index,
+                            "generation": generation})
+        if not r.get("ok") or r.get("reset_required"):
+            fail(f"unexpected poll verdict for {index}: {r}")
+        p = client.request({"kind": "clock_probe"})
+        if not p.get("ok"):
+            fail(f"clock probe failed for {index}: {p}")
+
+
+def measure_flat_arm(key: bytes) -> int:
+    """Replay the same control phases flat (every rank -> root): gen-1
+    rendezvous at world 64, POLL_ROUNDS of poll+probe, gen-2 re-rendezvous
+    of the post-reset world. Returns root control bytes."""
+    from horovod_tpu.runner.network import BasicClient
+    from horovod_tpu.runner.service import ElasticDriverService
+
+    root = ElasticDriverService(key)
+    clients = [BasicClient([("127.0.0.1", root.port)], key, timeout=90.0)
+               for _ in range(WORLD + PER_HOST)]
+    try:
+        pairs = [(i, i // PER_HOST, clients[i]) for i in range(WORLD)]
+        root.begin_reset(set(range(WORLD)))
+        rendezvous(pairs, 1)
+        for _ in range(POLL_ROUNDS):
+            poll_round(pairs, 1)
+        new_world = [p for p in pairs
+                     if p[0] != DEAD_RANK
+                     and p[0] // PER_HOST != DEAD_LEADER_HOST]
+        new_world += [(WORLD + j, HOSTS, clients[WORLD + j])
+                      for j in range(PER_HOST)]
+        root.begin_reset({p[0] for p in new_world})
+        rendezvous(new_world, 2)
+        time.sleep(0.1)
+        st = root.stats()
+        return st["bytes_in"] + st["bytes_out"]
+    finally:
+        for c in clients:
+            c.close()
+        root.stop()
+
+
+def main() -> int:
+    if "--worker" in sys.argv:
+        return worker_main()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import secrets
+
+    import numpy as np
+
+    from horovod_tpu import checkpoint
+    from horovod_tpu.ckpt_async import fetch_from_peer
+    from horovod_tpu.ctrl.agent import ControlAgent
+    from horovod_tpu.elastic.state import ElasticState
+    from horovod_tpu.runner.network import BasicClient
+    from horovod_tpu.runner.service import ElasticDriverService
+
+    t_start = time.monotonic()
+    key = secrets.token_bytes(32)
+    tmp = tempfile.mkdtemp(prefix="hvd-ctrl-smoke-")
+    ckpt_dir = os.path.join(tmp, "host-00", "ckpt")
+
+    print(f"== ctrl smoke: {HOSTS} hosts x {PER_HOST} ranks through "
+          f"per-host control leaders ==")
+    root = ElasticDriverService(key)
+    conn_base = root.stats()["connections_total"]
+    agents: list = []
+    clients: list = []
+    worker = None
+    try:
+        for h in range(HOSTS):
+            ag = ControlAgent(key, host_name=f"ctrl-smoke-host-{h:02d}",
+                              ckpt_dir=ckpt_dir, batch_s=0.01, poll_s=30.0)
+            ag.attach_root([("127.0.0.1", root.port)])
+            agents.append(ag)
+
+        # -- rendezvous leg --------------------------------------------------
+        root.begin_reset(set(range(WORLD)))
+        worker = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env=dict(os.environ,
+                     HVD_CTRL_SMOKE_INDEX=str(DEAD_RANK),
+                     HVD_CTRL_SMOKE_LEADER_PORT=str(
+                         agents[DEAD_RANK // PER_HOST].port),
+                     HVD_CTRL_SMOKE_KEY=key.hex()),
+            stdout=subprocess.PIPE, text=True)
+        pairs = []
+        for i in range(WORLD):
+            if i == DEAD_RANK:
+                continue
+            c = BasicClient([("127.0.0.1", agents[i // PER_HOST].port)],
+                            key, timeout=90.0)
+            clients.append(c)
+            pairs.append((i, i // PER_HOST, c))
+        results = rendezvous(pairs, 1)
+        ready = json.loads(worker.stdout.readline())
+        check(ready["rank"] is not None,
+              f"subprocess rank registered through its leader "
+              f"(index {ready['index']} -> rank {ready['rank']})")
+        got = sorted(r["rank"] for r in results.values()) + [ready["rank"]]
+        check(sorted(got) == list(range(WORLD)),
+              f"all {WORLD} ranks assigned through {HOSTS} leaders, "
+              f"flat-identical rank set")
+        conns = root.stats()["connections_total"] - conn_base
+        check(conns <= 2 * HOSTS,
+              f"root connections are O(hosts): {conns} <= {2 * HOSTS} "
+              f"for world {WORLD}")
+
+        # -- steady state: polls + async checkpoint commits ------------------
+        state = ElasticState(checkpoint_dir=ckpt_dir, step=0,
+                             params=np.zeros(64))
+        for s in range(1, COMMITS + 1):
+            state.step = s
+            state.params = np.full(64, float(s))
+            state.commit(check_host_updates=False)
+        for _ in range(POLL_ROUNDS):
+            poll_round(pairs, 1)
+        check(state._async_writer is not None
+              and state.checkpoint_wait(60.0),
+              f"{COMMITS} per-step commits rode the background writer "
+              f"({state._async_writer.commits} landed)")
+        up_before = sum(ag.upstream_requests() for ag in agents)
+
+        # -- failure leg: SIGKILL one rank AND one leader mid-run ------------
+        os.kill(ready["pid"], signal.SIGKILL)
+        worker.wait(timeout=10)
+        agents[DEAD_LEADER_HOST].stop()   # dies with no goodbye
+        gen_before = root.generation
+
+        # -- streaming leg: joiner host cold-starts BEFORE it is admitted ----
+        dest = os.path.join(tmp, "joiner", "ckpt")
+        joiner = ControlAgent(key, host_name="ctrl-smoke-joiner",
+                              ckpt_dir=dest, batch_s=0.01, poll_s=30.0)
+        joiner.attach_root([("127.0.0.1", root.port)])
+        agents.append(joiner)
+        t0 = time.monotonic()
+        man = fetch_from_peer([("127.0.0.1", agents[0].port)], key, dest,
+                              timeout=60.0)
+        stream_s = time.monotonic() - t0
+        check(man["ok"] and tree_hash(ckpt_dir) == tree_hash(dest),
+              f"joiner streamed {len(man['files'])} file(s), "
+              f"{man['total_bytes']} bytes from a surviving leader — "
+              f"bitwise identical tree")
+        check(stream_s < 10.0,
+              f"streaming cold-start bounded ({stream_s:.2f}s < 10s)")
+        restored = checkpoint.restore(
+            dest, template={"step": np.array(0, np.int64),
+                            "params": np.zeros(64)}, verify=False)
+        check(int(restored["step"]) == COMMITS,
+              "streamed checkpoint restores to the committed step")
+
+        # supervisor folds BOTH failures + the join into ONE membership change
+        survivors = [p for p in pairs
+                     if p[0] // PER_HOST != DEAD_LEADER_HOST]
+        joiner_pairs = []
+        for j in range(PER_HOST):
+            c = BasicClient([("127.0.0.1", joiner.port)], key, timeout=90.0)
+            clients.append(c)
+            joiner_pairs.append((WORLD + j, HOSTS, c))
+        new_world = survivors + joiner_pairs
+        root.begin_reset({p[0] for p in new_world})
+        new_results = rendezvous(new_world, 2)
+        check(root.generation == gen_before + 1 == 2,
+              f"exactly one elastic reset (generation {gen_before} -> "
+              f"{root.generation}) absorbs both failures and the join")
+        sizes = {r["topology"]["size"] for r in new_results.values()}
+        check(sizes == {len(new_world)},
+              f"post-reset world is the {len(survivors)} survivors + "
+              f"{PER_HOST} joiner ranks")
+        check(all(new_results[p[0]]["rank"] >= len(survivors)
+                  for p in joiner_pairs),
+              "oldest-first ordering: joiner ranks sort after survivors "
+              "(rank 0 still holds the committed state)")
+
+        # -- resume leg: the new world restores the async commit -------------
+        cold = ElasticState(checkpoint_dir=ckpt_dir, step=0,
+                            params=np.zeros(64))
+        check(cold.load_checkpoint() is True and int(cold.step) == COMMITS
+              and float(np.asarray(cold.params)[0]) == float(COMMITS),
+              f"survivors resume from the last async commit "
+              f"(step {int(cold.step)} == {COMMITS})")
+
+        # -- gate leg ---------------------------------------------------------
+        time.sleep(0.1)
+        st = root.stats()
+        tree_bytes = st["bytes_in"] + st["bytes_out"]
+        up_after = sum(ag.upstream_requests()
+                       for ag in agents if ag is not agents[DEAD_LEADER_HOST])
+        check(up_after >= up_before,
+              "surviving leaders kept aggregating after the reset")
+        flat_bytes = measure_flat_arm(key)
+        reduction = flat_bytes / max(tree_bytes, 1)
+        check(reduction >= 6.0,
+              f"root control bytes: flat {flat_bytes} vs tree {tree_bytes} "
+              f"-> {reduction:.1f}x reduction")
+        print(json.dumps({
+            "metric": "ctrl_smoke_root_byte_reduction",
+            "value": round(reduction, 2), "unit": "x",
+            "world": WORLD, "hosts": HOSTS,
+            "flat_root_bytes": flat_bytes, "tree_root_bytes": tree_bytes,
+            "root_connections": conns,
+            "streaming_cold_start_s": round(stream_s, 2),
+            "elapsed_s": round(time.monotonic() - t_start, 1),
+        }), flush=True)
+        print("ctrl smoke PASSED")
+        return 0
+    finally:
+        if worker is not None and worker.poll() is None:
+            worker.kill()
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for ag in agents:
+            try:
+                ag.stop()
+            except Exception:
+                pass
+        root.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
