@@ -1384,6 +1384,227 @@ def fsdp_ab_main() -> None:
     budget.emit(out)
 
 
+def _build_tp_ab(batch_sz: int, shard_sz: int, model_sz: int,
+                 fusion_threshold=None, num_buckets=None):
+    """Tensor-parallel train step for the model=1-vs-model=2 A/B
+    (ISSUE 19): the same two-pair column/row-parallel block, data, and
+    init on the 3-D ('batch','shard','model') mesh. model=1 compiles to
+    exactly the 2-D ZeRO plan (the bitwise proof lives in
+    tests/test_tensor_parallel.py); model>1 slices each pair's hidden
+    dimension per model rank with one psum('model') per pair per
+    direction. Returns (run, sync, info) with per-CHIP persistent
+    parameter+optimizer-state bytes — the headline the gate floors."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from horovod_tpu.compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.parallel import sharded as hvd_sharded
+    from horovod_tpu.parallel import tensor as tp
+    from horovod_tpu.parallel.mesh import sharded_mesh
+
+    n_dev = batch_sz * shard_sz * model_sz
+    devs = jax.devices()[:n_dev]
+    mesh = sharded_mesh(batch=batch_sz, shard=shard_sz, model=model_sz,
+                        devices=devs)
+    per_dev_batch = int(os.environ.get("HVD_BENCH_BATCH", 8))
+    # The model axis replicates data; batch rides ('batch','shard'). The
+    # GLOBAL batch is pinned to the device count so the model=1 and
+    # model=2 legs walk identical data (the loss-parity probe).
+    batch = per_dev_batch * n_dev
+    dim = 64
+    hidden = int(os.environ.get("HVD_TP_AB_HIDDEN", 512))
+    rng = np.random.default_rng(0)
+
+    def mk_pair(d_in, h, d_out):
+        return {
+            "w_col": jnp.asarray(rng.normal(0, 0.05, (d_in, h)),
+                                 jnp.float32),
+            "b_col": jnp.zeros((h,), jnp.float32),
+            "w_row": jnp.asarray(rng.normal(0, 0.05, (h, d_out)),
+                                 jnp.float32),
+            "b_row": jnp.zeros((d_out,), jnp.float32),
+        }
+
+    pairs = [mk_pair(dim, hidden, dim), mk_pair(dim, hidden, dim)]
+    x = jnp.asarray(rng.normal(0, 1, (batch, dim)), jnp.float32)
+    y = jnp.asarray(rng.normal(0, 1, (batch, dim)), jnp.float32)
+
+    local = tp.tp_local_pairs(pairs, model_sz)
+    plan = hvd_sharded.build_shard_plan(
+        local[0], shard_sz, threshold=fusion_threshold,
+        num_buckets=num_buckets, model_size=model_sz)
+    sp = hvd_sharded.shard_params_model(local, plan)
+    opt = hvd.jax.DistributedOptimizer(
+        optax.adam(1e-3), sharded=True, shard_plan=plan,
+        fusion_threshold=fusion_threshold, num_buckets=num_buckets)
+    opt_state = opt.init(sp)
+    specs = hvd_sharded.shard_specs(opt_state, model_axis="model")
+    sp_spec = hvd_sharded.shard_specs(sp, model_axis="model")
+    # Per-chip persistent state: the model-stacked (model*shard, chunk)
+    # buffers spread over BOTH non-batch mesh axes.
+    state_bytes = hvd_sharded.state_bytes(
+        {"params": sp, "opt": opt_state}) // (model_sz * shard_sz)
+
+    def loss_fn(p, x, y):
+        return jnp.mean((tp.tp_apply(p, x) - y) ** 2)
+
+    def train_step(sp, o, x, y):
+        full = hvd_sharded.gather_params(sp, plan)
+        loss, grads = jax.value_and_grad(loss_fn)(full, x, y)
+        upd, o = opt.update(grads, o, sp)
+        return (optax.apply_updates(sp, upd), o,
+                jax.lax.pmean(loss, ("batch", "shard")))
+
+    step = jax.jit(shard_map(
+        train_step, mesh=mesh,
+        in_specs=(sp_spec, specs, P(("batch", "shard")),
+                  P(("batch", "shard"))),
+        out_specs=(sp_spec, specs, P()),
+        check_vma=False), donate_argnums=(0, 1))
+
+    losses: list = []
+    state = [sp, opt_state]
+    loss_box = [None]
+
+    def run():
+        p, o, loss_box[0] = step(*state, x, y)
+        state[:] = (p, o)
+        losses.append(loss_box[0])
+
+    info = {"state_bytes_per_chip": int(state_bytes), "batch": batch,
+            "losses": losses, "dim": dim, "hidden": hidden,
+            "param_count": sum(int(l.size) for l in
+                               jax.tree_util.tree_leaves(pairs))}
+    return run, (lambda: float(loss_box[0])), info
+
+
+def tp_ab_main() -> None:
+    """bench.py --tp-ab: tensor-parallel A/B on the simulated 3-D
+    ('batch','shard','model') mesh (ISSUE 19). The same two-pair TP
+    block, data, and init twice — model=1 (which compiles to the proven
+    2-D plan) against model=2 (hidden dimension sliced per model rank,
+    one psum('model') per pair per direction) — reporting the headline
+    per-chip parameter+optimizer-state reduction (the gated metric, floor
+    1.8x), TP step throughput, loss-trajectory parity, the analytic
+    per-step TP wire bytes, and a mini joint autotune exercising the
+    3-axis mesh string as the SIXTH dimension. One JSON line, always
+    (budget watchdog; the bounded backend probe ran in main())."""
+    import jax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import metrics as hvd_metrics
+    from horovod_tpu.jax.autotune import measure_steps_per_s, tune
+
+    budget = _Budget.install("tp_ab_memory_reduction", "x")
+    budget.stage("devices")
+    import re as _re
+
+    want = int(os.environ.get("HVD_TP_AB_DEVICES", "8"))
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = _re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
+    promised = int(m.group(1)) if m else 0
+    if (os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+            and promised < want):
+        try:
+            from horovod_tpu.compat import set_num_cpu_devices
+
+            set_num_cpu_devices(want)
+        except RuntimeError:
+            pass
+    n_dev = len(jax.devices())
+    out = {"metric": "tp_ab_memory_reduction", "value": 0.0, "unit": "x",
+           "smoke": _smoke_on(), "devices": n_dev}
+    if n_dev < 8 or n_dev % 4:
+        out.update({"partial": True,
+                    "reason": f"need a device count divisible by 4 and "
+                              f">= 8, have {n_dev}"})
+        budget.emit(out)
+        return
+    hvd.init()
+    smoke = _smoke_on()
+    steps = 6 if smoke else 12
+    warmup, iters, reps = (2, 3, 2) if smoke else (3, 8, 3)
+    model, shard = 2, 2
+    batch_ref, batch_tp = n_dev // shard, n_dev // (shard * model)
+
+    budget.stage("ref-leg")
+    run_ref, sync_ref, info_ref = _build_tp_ab(batch_ref, shard, 1)
+    rate_ref = measure_steps_per_s(run_ref, warmup, iters, reps,
+                                   sync=sync_ref)
+    info_ref["losses"].clear()
+
+    budget.stage("tp-leg")
+    run_tp, sync_tp, info_tp = _build_tp_ab(batch_tp, shard, model)
+    rate_tp = measure_steps_per_s(run_tp, warmup, iters, reps, sync=sync_tp)
+    tp_plan = hvd_metrics.last_shard_plan()
+    info_tp["losses"].clear()
+
+    budget.stage("parity")
+    # Fresh states walked side by side: the TP trajectory must track the
+    # model=1 trajectory within dtype tolerance (the bitwise proofs live
+    # in tests/test_tensor_parallel.py; this is the cross-shape check).
+    run_a, _, info_a = _build_tp_ab(batch_ref, shard, 1)
+    run_b, _, info_b = _build_tp_ab(batch_tp, shard, model)
+    for _ in range(steps):
+        run_a()
+        run_b()
+    parity = max(abs(float(a) - float(b))
+                 for a, b in zip(info_a["losses"], info_b["losses"]))
+
+    ref_bytes = info_ref["state_bytes_per_chip"]
+    tp_bytes = info_tp["state_bytes_per_chip"]
+    hvd_metrics.record_sharded_state_bytes(
+        tp_bytes * shard * model, shard, model_size=model)
+    # Analytic TP wire volume per step: one psum('model') per pair per
+    # direction over the [local_batch, dim] activation block.
+    from horovod_tpu.parallel import tensor as _tp
+
+    local_batch = info_tp["batch"] // (batch_tp * shard)
+    pairs_n = 2
+    tp_wire = 2 * pairs_n * _tp.tp_wire_bytes_per_pair(
+        local_batch, info_tp["dim"])
+    out.update({
+        "value": round(ref_bytes / max(tp_bytes, 1), 3),
+        "model": model,
+        "shard": shard,
+        "ref_state_bytes_per_chip": int(ref_bytes),
+        "tp_state_bytes_per_chip": int(tp_bytes),
+        "param_count": info_ref["param_count"],
+        "ref_img_s": round(rate_ref * info_ref["batch"], 2),
+        "tp_img_s": round(rate_tp * info_tp["batch"], 2),
+        "tp_vs_ref_step_time": round(rate_ref / max(rate_tp, 1e-9), 3),
+        "loss_parity_max_abs_err": round(parity, 8),
+        "tp_wire_bytes_per_step": int(tp_wire),
+        "plan_model_size": (tp_plan or {}).get("model", model),
+    })
+    # Mesh shape — now three axes — as the SIXTH joint-autotune dimension
+    # (jax/autotune.tune): the tuner measures the same step over candidate
+    # '<batch>x<shard>x<model>' strings beside (threshold, buckets).
+    if not budget.skip_if_low("mesh-autotune", 40):
+        budget.stage("mesh-autotune")
+
+        def step_factory(fusion_threshold, mesh_shape):
+            b, s, mdl = (int(v) for v in mesh_shape.split("x"))
+            run, sync, _ = _build_tp_ab(b, s, mdl,
+                                        fusion_threshold=fusion_threshold)
+            return run, sync
+
+        report = tune(step_factory, thresholds=(1 << 20,),
+                      mesh_shapes=(f"{n_dev // 2}x2x1",
+                                   f"{n_dev // 4}x2x2"),
+                      warmup=1 if smoke else 2, iters=3, reps=2,
+                      gp_rounds=0, verbose=True)
+        print(report.knob_curve(), file=sys.stderr)
+        out["autotuned_mesh"] = report.best.config.get(
+            "mesh", f"{n_dev // 2}x2x1")
+    budget.emit(out)
+
+
 def serve_bench_main() -> None:
     """bench.py --serve: offered-load sweep over the serving vertical
     (ISSUE 10). Exports a tiny-MLP serving checkpoint, starts a 2-replica
@@ -2059,6 +2280,7 @@ def main() -> None:
         "--controller-ab": ("controller_convergence_ratio", "x"),
         "--buckets-ab": ("buckets_ab_images_per_sec", "img/s"),
         "--fsdp-ab": ("fsdp_ab_memory_reduction", "x"),
+        "--tp-ab": ("tp_ab_memory_reduction", "x"),
         "--roofline": ("resnet50_roofline", "GB/s"),
         "--serve-llm": ("serve_llm_bench_decode_tokens_per_s", "tok/s"),
         "--serve": ("serve_bench_throughput_rps", "req/s"),
@@ -2095,6 +2317,8 @@ def main() -> None:
         return controller_ab_main()
     if "--fsdp-ab" in sys.argv:
         return fsdp_ab_main()
+    if "--tp-ab" in sys.argv:
+        return tp_ab_main()
     if "--buckets-ab" in sys.argv:
         return buckets_ab_main()
     if "--roofline" in sys.argv:

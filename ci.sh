@@ -96,6 +96,21 @@ python tools/perf_gate.py --current /tmp/hvd_fsdp_ab.log \
   --require-metric fsdp_ab_memory_reduction \
   --min-abs fsdp_ab_memory_reduction=1.8 --allow-missing-baseline
 
+echo "== tp A/B bench + gate (ISSUE 19 third mesh axis: model=1 vs model=2 tensor parallelism on the simulated ('batch','shard','model') mesh — the per-chip parameter+optimizer-state reduction metric must exist and clear the 1.8x absolute floor, loss parity riding along) =="
+HVD_BENCH_SMOKE=1 timeout -k 10 240 env JAX_PLATFORMS=cpu \
+  python bench.py --tp-ab | tee /tmp/hvd_tp_ab.log
+python tools/perf_gate.py --current /tmp/hvd_tp_ab.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric tp_ab_memory_reduction \
+  --min-abs tp_ab_memory_reduction=1.8 --allow-missing-baseline
+
+echo "== tp smoke (ISSUE 19 sharded serving: model_shards=2 mesh replica group serves a model whose per-chip footprint exceeds the framed chip budget — the unsharded pool provably refuses to start, generations stay token-for-token oracle-exact under mixed load, and a SIGKILL'd sharded decode replica recovers with zero failed/diverged requests) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/tp_smoke.py | tee /tmp/hvd_tp_smoke.log
+python tools/perf_gate.py --current /tmp/hvd_tp_smoke.log \
+  --baseline BASELINE.json --history 'BENCH_r0*.json' \
+  --require-metric tp_smoke_memory_reduction \
+  --min-abs tp_smoke_memory_reduction=1.8 --allow-missing-baseline
+
 echo "== metrics smoke (2-proc train, stall check + exposition; snapshot vs docs/metrics_schema.json, timeline JSON shape) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_smoke.py
 

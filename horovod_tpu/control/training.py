@@ -71,9 +71,13 @@ KNOBS = {
     "plane": Knob("plane", "choice", choices=("auto", "ring", "star")),
 }
 
-#: which actuator lands each knob
+#: which actuator lands each knob. "mesh" (the 3-D ('batch','shard',
+#: 'model') cube, ISSUE 19) only registers when the controller is built
+#: with mesh_choices= — reshaping the mesh re-partitions parameters, so
+#: it is strictly a rejit-class change.
 ENGINE_KNOBS = frozenset({"compression", "topk_ratio", "plane"})
-REJIT_KNOBS = frozenset({"fusion_threshold", "num_buckets", "hierarchical"})
+REJIT_KNOBS = frozenset({"fusion_threshold", "num_buckets", "hierarchical",
+                         "mesh"})
 
 
 def _tier(gauges: dict, name: str, t: str) -> float:
@@ -93,7 +97,8 @@ class TrainingController:
                  tolerance: Optional[float] = None,
                  warm_start=None,
                  anomaly=None,
-                 reg=None) -> None:
+                 reg=None,
+                 mesh_choices=None) -> None:
         self.engine = engine
         self.rejit = rejit
         if reg is None:
@@ -101,7 +106,22 @@ class TrainingController:
 
             reg = _registry()
         self.reg = reg
-        self.loop = ControlLoop(KNOBS, self._apply, plane="training",
+        knobs = dict(KNOBS)
+        # The 3-D mesh cube as a controller-visible knob (ISSUE 19): the
+        # legal shapes are job-specific (device count, divisibility of the
+        # TP hidden dims), so the caller enumerates them; each is a
+        # HOROVOD_MESH spec string validated by parse_mesh_spec.
+        self.mesh_choices = tuple(mesh_choices) if mesh_choices else ()
+        if self.mesh_choices:
+            import jax as _jax
+
+            from ..parallel.mesh import parse_mesh_spec
+
+            for spec in self.mesh_choices:
+                parse_mesh_spec(spec, _jax.device_count())
+            knobs["mesh"] = Knob("mesh", "choice",
+                                 choices=self.mesh_choices)
+        self.loop = ControlLoop(knobs, self._apply, plane="training",
                                 canary_steps=canary_steps,
                                 cooldown_s=cooldown_s,
                                 tolerance=tolerance, reg=reg)
@@ -112,6 +132,11 @@ class TrainingController:
         self.loop.set_current("num_buckets", 1)
         self.loop.set_current("hierarchical", False)
         self.loop.set_current("plane", "auto")
+        if self.mesh_choices:
+            cur = os.environ.get("HOROVOD_MESH", "").strip()
+            if cur not in self.mesh_choices:
+                cur = self.mesh_choices[0]
+            self.loop.set_current("mesh", cur)
         if engine is not None:
             knobs = getattr(engine, "_knobs", None) or {}
             if knobs.get("compression") in WIRE_LADDER:

@@ -251,9 +251,19 @@ def DistributedOptimizer(
     and the zero-pad tail is masked so it never trains. The parameter
     refresh is the caller's :func:`gather_params` in the forward pass —
     one bucketed allgather per step. On a degenerate ``shard=1`` mesh the
-    exchange compiles bitwise-identically to the DP path. The mesh shape
-    joins the autotune as the FIFTH dimension
-    (``jax.autotune.tune(mesh_shapes=...)``; ``HOROVOD_MESH``).
+    exchange compiles bitwise-identically to the DP path.
+
+    On a 3-D ``('batch','shard','model')`` mesh (ISSUE 19) the same wrapper
+    drives tensor-parallel training: ``grads`` is one model rank's LOCAL
+    gradient tree (parallel/tensor.py's column/row pairs compute it with
+    the conjugate copy/reduce collectives), the ``('batch','shard')``
+    exchange runs unchanged per model group, and the model-stacked
+    ``shard_params_model`` layout keeps every device on the identical
+    ``(1, chunk)`` code path — ``model=1`` compiles bitwise-identically to
+    the 2-D plan. The mesh shape — now including the third axis — joins
+    the autotune as the SIXTH dimension
+    (``jax.autotune.tune(mesh_shapes=...)``; ``HOROVOD_MESH`` accepts
+    ``"<batch>x<shard>x<model>"``).
     """
     sharded = _resolved_sharded(sharded)
     if sharded and backward_passes_per_step > 1:

@@ -281,15 +281,18 @@ def tune(step_factory: Callable[..., Callable[[], None]],
     ``hierarchical=`` kwarg (bool).
 
     ``mesh_shapes``: a grid of HOROVOD_MESH shapes (``"<batch>x<shard>"``
-    strings, e.g. ``("8x1", "4x2", "2x4")``) joins as the FIFTH joint
-    dimension (ISSUE 14) — categorical like the ladder, explored
+    2-axis strings, e.g. ``("8x1", "4x2", "2x4")``, or 3-axis
+    ``"<batch>x<shard>x<model>"`` strings, e.g. ``"2x2x2"`` — ISSUE 19's
+    SIXTH joint dimension) — categorical like the ladder, explored
     exhaustively, with the continuous (threshold, buckets) GP/EI
     refinement run per (compression, hierarchical, mesh) branch. The
     factory is then called with an extra ``mesh_shape=`` kwarg (the spec
     string) and is expected to rebuild its step over
     ``horovod_tpu.sharded_mesh()`` at that shape — the tuner decides per
     PLATFORM AND MODEL whether the ZeRO reduce-scatter/allgather pattern
-    pays against the replicated allreduce (docs/sharded.md).
+    pays against the replicated allreduce, and whether spending devices on
+    the model axis (tensor parallelism's per-chip state fold,
+    docs/sharded.md) beats spending them on batch or shard.
     """
     branches = list(branches) if branches is not None else [{}]
     tune_buckets = num_buckets is not None

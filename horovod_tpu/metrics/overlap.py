@@ -188,15 +188,16 @@ def last_tier_plan() -> Optional[dict]:
     return _last_tier_plan
 
 
-# Latest sharded (ZeRO) plan of the compiled path (ISSUE 14):
-# {"batch": int, "shard": int, "buckets": int,
+# Latest sharded (ZeRO) plan of the compiled path (ISSUEs 14/19):
+# {"batch": int, "shard": int, "model": int, "buckets": int,
 #  "scatter_bytes": [...], "gather_bytes": [...],
 #  "bytes_per_step": {"scatter": n, "gather": n}}.
 _last_shard_plan: Optional[dict] = None
 
 
 def record_shard_plan(batch_size: int, shard_size: int,
-                      scatter_bytes: list, gather_bytes: list) -> dict:
+                      scatter_bytes: list, gather_bytes: list,
+                      model_size: int = 1) -> dict:
     """Record the latest sharded gradient exchange's plan (trace time, once
     per compile — same reasoning as record_wire_plan).
 
@@ -205,20 +206,28 @@ def record_shard_plan(batch_size: int, shard_size: int,
     ``gather_bytes``: per-bucket bytes of the parameter-refresh allgather
     (at the storage dtype). On a degenerate shard=1 mesh the gauges still
     record (scatter == the DP allreduce operand, gather == 0 collectives
-    but the refresh bytes are reported for comparability)."""
+    but the refresh bytes are reported for comparability).
+
+    ``model_size`` is the third ('model') mesh axis (ISSUE 19): the byte
+    lists are one model rank's exchange over its local slice tree, and
+    the gauge is how the controller and dashboards see which 3-D shape
+    the step compiled (1 = the 2-D plan)."""
     global _last_shard_plan
     reg = registry()
     plan = {"batch": int(batch_size), "shard": int(shard_size),
+            "model": int(model_size),
             "buckets": len(scatter_bytes),
             "scatter_bytes": [int(n) for n in scatter_bytes],
             "gather_bytes": [int(n) for n in gather_bytes],
             "bytes_per_step": {"scatter": int(sum(scatter_bytes)),
                                "gather": int(sum(gather_bytes))}}
-    for axis, size in (("batch", batch_size), ("shard", shard_size)):
+    for axis, size in (("batch", batch_size), ("shard", shard_size),
+                       ("model", model_size)):
         reg.gauge(
             "horovod_compiled_shard_plan",
             help="axis sizes of the latest compiled sharded "
-                 "(reduce-scatter/allgather) plan's ('batch','shard') mesh",
+                 "(reduce-scatter/allgather) plan's "
+                 "('batch','shard','model') mesh (model=1 = the 2-D plan)",
             axis=axis).set(int(size))
     for stage, total in plan["bytes_per_step"].items():
         reg.gauge(
@@ -237,12 +246,14 @@ def last_shard_plan() -> Optional[dict]:
     return _last_shard_plan
 
 
-def record_sharded_state_bytes(total_bytes: int, shard_size: int) -> float:
+def record_sharded_state_bytes(total_bytes: int, shard_size: int,
+                               model_size: int = 1) -> float:
     """Publish the per-rank parameter+optimizer-state footprint of a sharded
     training state (the headline ISSUE 14 measurement: ~shard-fold smaller
     than DP's fully-replicated state). ``total_bytes`` is the global state
-    size; each rank persists 1/shard_size of it."""
-    per_rank = total_bytes / max(1, shard_size)
+    size; each rank persists 1/(shard_size*model_size) of it — the model
+    axis (ISSUE 19) slices the state again on top of the ZeRO partition."""
+    per_rank = total_bytes / max(1, shard_size * model_size)
     registry().gauge(
         "horovod_sharded_state_bytes_per_rank",
         help="bytes of parameters + optimizer state each rank persists "

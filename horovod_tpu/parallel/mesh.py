@@ -33,11 +33,16 @@ from ..compat import axis_size
 HVD_AXIS = "hvd"
 DCN_AXIS = "dcn"
 ICI_AXIS = "ici"
-# The 2-D sharded-data-parallel mesh (ISSUE 14, docs/sharded.md): gradients
-# average over 'batch' (plain DP replicas) and parameters/grads/optimizer
-# state shard 1/shard_size over 'shard' (the ZeRO wire pattern).
+# The sharded-data-parallel mesh (ISSUEs 14/19, docs/sharded.md): gradients
+# average over 'batch' (plain DP replicas), parameters/grads/optimizer
+# state shard 1/shard_size over 'shard' (the ZeRO wire pattern), and the
+# third 'model' axis partitions the model itself — tensor-parallel
+# column/row matmul pairs and expert-parallel MoE dispatch (parallel/
+# tensor.py). A spec that never names the model axis gets model=1 and the
+# 2-D mesh, bit-for-bit as before ISSUE 19.
 BATCH_AXIS = "batch"
 SHARD_AXIS = "shard"
+MODEL_AXIS = "model"
 
 
 def _devices(devices=None):
@@ -108,77 +113,113 @@ def training_mesh(
     return Mesh(arr, tuple(axis_names))
 
 
-def parse_mesh_spec(spec: str, n_devices: int) -> tuple[int, int]:
-    """Parse a ``HOROVOD_MESH`` value — ``"<batch>x<shard>"`` (e.g. ``"4x2"``)
-    — into concrete ``(batch, shard)`` sizes for ``n_devices`` chips.
+def parse_mesh_spec(spec: str, n_devices: int) -> tuple[int, int, int]:
+    """Parse a ``HOROVOD_MESH`` value into concrete ``(batch, shard, model)``
+    sizes for ``n_devices`` chips.
 
-    Either side may be ``-1`` ("use all remaining devices"); an empty spec
-    resolves to the degenerate pure-DP mesh ``(n_devices, 1)``. Raises on a
-    malformed spec or a shape that does not tile the device count — the
-    mesh is a value-affecting knob, and a silently-misparsed shape would
-    train a different model layout than the operator asked for."""
+    Accepted spellings, newest last:
+
+    - ``"<batch>"`` — pure DP (shard=1, model=1);
+    - ``"<batch>x<shard>"`` — the ISSUE 14 2-D mesh (model=1);
+    - ``"<batch>x<shard>x<model>"`` — the full 3-D mesh (ISSUE 19).
+
+    Exactly one size may be ``-1`` ("use all remaining devices"); an empty
+    spec resolves to the degenerate pure-DP mesh ``(n_devices, 1, 1)``.
+    Raises on a malformed spec or a shape that does not tile the device
+    count — the mesh is a value-affecting knob, and a silently-misparsed
+    shape would train a different model layout than the operator asked
+    for."""
     s = (spec or "").strip().lower().replace("×", "x")
     if not s:
-        return n_devices, 1
+        return n_devices, 1, 1
     parts = s.split("x")
-    if len(parts) != 2:
+    if not 1 <= len(parts) <= 3:
         raise ValueError(
-            f"HOROVOD_MESH={spec!r}: expected '<batch>x<shard>' (e.g. '4x2')")
+            f"HOROVOD_MESH={spec!r}: expected '<batch>', '<batch>x<shard>' "
+            f"or '<batch>x<shard>x<model>' (e.g. '4x2x1')")
     try:
-        batch, shard = int(parts[0]), int(parts[1])
+        sizes = [int(p) for p in parts]
     except ValueError:
         raise ValueError(
             f"HOROVOD_MESH={spec!r}: sizes must be integers (or -1)") from None
-    if batch == -1 and shard == -1:
-        raise ValueError(f"HOROVOD_MESH={spec!r}: at most one side may be -1")
-    if shard == -1:
-        if batch <= 0 or n_devices % batch:
+    sizes += [1] * (3 - len(sizes))
+    if sizes.count(-1) > 1:
+        raise ValueError(f"HOROVOD_MESH={spec!r}: at most one size may be -1")
+    if -1 in sizes:
+        known = math.prod(v for v in sizes if v != -1)
+        if known <= 0 or n_devices % known:
             raise ValueError(
                 f"HOROVOD_MESH={spec!r}: {n_devices} devices not divisible "
-                f"by batch={batch}")
-        shard = n_devices // batch
-    elif batch == -1:
-        if shard <= 0 or n_devices % shard:
-            raise ValueError(
-                f"HOROVOD_MESH={spec!r}: {n_devices} devices not divisible "
-                f"by shard={shard}")
-        batch = n_devices // shard
-    if batch <= 0 or shard <= 0 or batch * shard != n_devices:
+                f"by the fixed sizes' product {known}")
+        sizes[sizes.index(-1)] = n_devices // known
+    batch, shard, model = sizes
+    if batch <= 0 or shard <= 0 or model <= 0 or \
+            batch * shard * model != n_devices:
         raise ValueError(
-            f"HOROVOD_MESH={spec!r} needs {batch}x{shard}="
-            f"{batch * shard} devices, have {n_devices}")
-    return batch, shard
+            f"HOROVOD_MESH={spec!r} needs {batch}x{shard}x{model}="
+            f"{batch * shard * model} devices, have {n_devices}")
+    return batch, shard, model
+
+
+def _spec_names_model(spec: str) -> bool:
+    """Whether a ``HOROVOD_MESH`` spelling explicitly names the third
+    (model) axis — ``"4x2x1"`` builds the 3-D mesh even at model=1 (the
+    bitwise-identity shape), ``"4x2"`` keeps the 2-D mesh."""
+    return (spec or "").strip().lower().replace("×", "x").count("x") >= 2
 
 
 def sharded_mesh(batch: int | None = None, shard: int | None = None,
-                 devices=None) -> Mesh:
-    """2-D ``('batch', 'shard')`` mesh for sharded data parallelism
-    (docs/sharded.md). With both sizes ``None`` the shape comes from
-    ``HOROVOD_MESH`` (``"<batch>x<shard>"``; unset = pure DP, shard=1).
+                 model: int | None = None, devices=None) -> Mesh:
+    """``('batch', 'shard')`` or ``('batch', 'shard', 'model')`` mesh for
+    sharded data parallelism (docs/sharded.md). With all sizes ``None``
+    the shape comes from ``HOROVOD_MESH`` (``"<batch>x<shard>[x<model>]"``;
+    unset = pure DP, shard=model=1).
 
-    The shard axis is laid out as the MINOR (fast-varying) dimension so the
-    every-step reduce-scatter/allgather rides adjacent chips, mirroring how
-    ``hierarchical_mesh`` keeps the ICI axis minor; the once-per-step batch
-    psum crosses the slower boundaries."""
+    The mesh is 3-D exactly when the model axis is NAMED — ``model=`` passed
+    (any value, including 1) or a 3-axis env spec — so every pre-ISSUE-19
+    caller keeps the bit-identical 2-D mesh, while ``model=1`` callers get
+    the degenerate 3-D shape the bitwise-identity test compiles.
+
+    The model axis is laid out as the MOST minor (fast-varying) dimension:
+    the per-matmul-pair ``psum('model')`` is the hottest collective, then
+    the every-step reduce-scatter/allgather over 'shard', then the
+    once-per-step batch psum across the slowest boundaries — the same
+    reasoning that keeps the ICI axis minor in ``hierarchical_mesh``."""
     devs = _devices(devices)
     n = len(devs)
-    if batch is None and shard is None:
+    want_model_axis = model is not None
+    if batch is None and shard is None and model is None:
         import os
 
-        batch, shard = parse_mesh_spec(os.environ.get("HOROVOD_MESH", ""), n)
+        spec = os.environ.get("HOROVOD_MESH", "")
+        batch, shard, model = parse_mesh_spec(spec, n)
+        want_model_axis = _spec_names_model(spec)
+    elif batch is None and shard is None:
+        # Only the model size given: the remainder is pure DP (the same
+        # default an empty spec picks for the other two axes).
+        batch, shard, model = parse_mesh_spec(f"-1x1x{model}", n)
     elif batch is None:
-        batch, shard = parse_mesh_spec(f"-1x{shard}", n)
+        batch, shard, model = parse_mesh_spec(
+            f"-1x{shard}x{1 if model is None else model}", n)
     elif shard is None:
-        batch, shard = parse_mesh_spec(f"{batch}x-1", n)
+        batch, shard, model = parse_mesh_spec(
+            f"{batch}x-1x{1 if model is None else model}", n)
+    elif model is None:
+        # Both data axes pinned, no model axis named: exact 2-D tiling
+        # required, exactly as before the third axis existed.
+        batch, shard, model = parse_mesh_spec(f"{batch}x{shard}x1", n)
     else:
-        batch, shard = parse_mesh_spec(f"{batch}x{shard}", n)
+        batch, shard, model = parse_mesh_spec(f"{batch}x{shard}x{model}", n)
+    three_d = want_model_axis or model != 1
+    shape = (batch, shard, model) if three_d else (batch, shard)
+    names = (BATCH_AXIS, SHARD_AXIS, MODEL_AXIS)[:len(shape)]
     try:
         from jax.experimental import mesh_utils
 
-        arr = mesh_utils.create_device_mesh((batch, shard), devices=devs)
+        arr = mesh_utils.create_device_mesh(shape, devices=devs)
     except Exception:
-        arr = np.asarray(devs).reshape(batch, shard)
-    return Mesh(arr, (BATCH_AXIS, SHARD_AXIS))
+        arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, names)
 
 
 def mesh_rank(axis_name: str = HVD_AXIS):

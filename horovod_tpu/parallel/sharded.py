@@ -16,6 +16,13 @@ carries the ZeRO partition. The degenerate ``shard=1`` mesh compiles to
 BITWISE the DP plan — same buckets, same wire casts, same psum — so the
 sharded path is a strict superset, not a fork.
 
+ISSUE 19 adds the third ``'model'`` axis (parallel/tensor.py): each model
+rank plans and exchanges its LOCAL tensor-parallel slice tree through the
+very same machinery — the model axis needs no gradient collective here
+because the ``psum('model')`` inside each column/row matmul pair already
+broadcasts its cotangent under AD. ``model_size=1`` plans and exchanges
+are bitwise the 2-D ones (no new HLO enters the step).
+
 The bucket layout IS the shard layout (the fsdp.py ``(axis_size, chunk)``
 prototype promoted to the planner's substrate): fusion.build_plan packs
 leaves into same-dtype buckets padded to a multiple of the shard axis size,
@@ -53,7 +60,7 @@ from jax import lax
 
 from . import collectives, fusion
 from .collectives import ReduceOp
-from .mesh import BATCH_AXIS, SHARD_AXIS
+from .mesh import BATCH_AXIS, MODEL_AXIS, SHARD_AXIS
 from ..common.config import Config
 from ..compression import compression_name
 
@@ -108,21 +115,31 @@ class ShardPlan:
     padded_sizes: tuple       # per-bucket elements after padding
     chunk_sizes: tuple        # per-rank elements: padded // shard_size
     bucket_dtypes: tuple
+    # Size of the third ('model') mesh axis this plan coexists with
+    # (ISSUE 19). The planned TREE is one model rank's LOCAL tree (its
+    # tensor-parallel slices), so bucketing/padding/chunks are untouched by
+    # this field — it rides along for the trace-time gauges and so
+    # consumers (checkpoints, benches) know the full-model multiplier.
+    # model_size=1 plans are field-for-field the PR 14 plans.
+    model_size: int = 1
 
     @property
     def num_buckets(self) -> int:
         return self.base.num_buckets
 
     def state_bytes_per_rank(self) -> int:
-        """Bytes of ONE sharded copy of the tree per rank (params; multiply
-        by the optimizer's state factor for moments)."""
+        """Bytes of ONE sharded copy of the planned tree per rank (params;
+        multiply by the optimizer's state factor for moments). The planned
+        tree is already a single model rank's local slice tree, so no
+        further division by model_size applies."""
         return sum(c * jnp.dtype(d).itemsize
                    for c, d in zip(self.chunk_sizes, self.bucket_dtypes))
 
 
 def build_shard_plan(tree, shard_size: int, threshold: Optional[int] = None,
                      num_buckets: Optional[int] = None,
-                     dcn_threshold: Optional[int] = None) -> ShardPlan:
+                     dcn_threshold: Optional[int] = None,
+                     model_size: int = 1) -> ShardPlan:
     """Plan the sharded bucketing of ``tree``'s leaves.
 
     Same knobs as the DP planner — ``threshold`` None reads
@@ -131,9 +148,18 @@ def build_shard_plan(tree, shard_size: int, threshold: Optional[int] = None,
     ships 1/shard of its bytes per rank, so HOROVOD_DCN_FUSION_THRESHOLD
     bounds bucket bytes at D*shard_size exactly as it does for the
     hierarchical ladder (fusion.dcn_capped_threshold). On ``shard_size=1``
-    the plan is identical to the DP plan (pad_to=1, no padding)."""
+    the plan is identical to the DP plan (pad_to=1, no padding).
+
+    ``model_size`` records the 3-D mesh's third axis (ISSUE 19): pass the
+    LOCAL tree — one model rank's tensor-parallel slices — and the bucket
+    layout is computed over it exactly as over a full tree (every model
+    rank derives the identical plan because the slice trees are
+    structure- and shape-uniform). ``model_size=1`` yields a plan
+    field-for-field identical to the 2-D planner's."""
     if shard_size < 1:
         raise ValueError(f"shard_size must be >= 1, got {shard_size}")
+    if model_size < 1:
+        raise ValueError(f"model_size must be >= 1, got {model_size}")
     cfg = None
     if threshold is None:
         cfg = Config.from_env()
@@ -155,7 +181,8 @@ def build_shard_plan(tree, shard_size: int, threshold: Optional[int] = None,
         chunks.append(p // shard_size)
         dtypes.append(bucket[0].dtype)
     return ShardPlan(plan, int(shard_size), int(threshold), tuple(raw),
-                     tuple(padded), tuple(chunks), tuple(dtypes))
+                     tuple(padded), tuple(chunks), tuple(dtypes),
+                     int(model_size))
 
 
 def shard_params(params, plan: ShardPlan) -> ShardedBuckets:
@@ -200,6 +227,7 @@ def reduce_scatter_gradients(
     *,
     batch_axis: str = BATCH_AXIS,
     shard_axis: str = SHARD_AXIS,
+    model_axis: str = MODEL_AXIS,
     op: ReduceOp = ReduceOp.AVERAGE,
     compression=None,
     compression_min_bytes: Optional[int] = None,
@@ -221,7 +249,19 @@ def reduce_scatter_gradients(
     On a degenerate ``shard=1`` mesh the exchange is literally
     ``collectives.bucketed_allreduce`` over ``batch_axis`` — the same call,
     cast sequence, and plan the DP path compiles — so sharded==DP holds
-    bitwise there."""
+    bitwise there.
+
+    On a 3-D ``('batch','shard','model')`` mesh (ISSUE 19) NOTHING extra
+    goes on the wire here: ``grads`` is one model rank's LOCAL gradient
+    tree. Tensor-parallel slice gradients are already per-rank values, and
+    replicated-parameter gradients are already identical across model
+    ranks — the conjugate ``copy_to_model``/``reduce_from_model`` pair
+    inside each column/row matmul block (parallel/tensor.py) completes the
+    model-axis cotangents during the backward itself, so the batch average
+    over ``(batch, shard)`` finishes the data-parallel sum with zero
+    model-axis collectives here. ``model_axis`` only names the axis for
+    the trace-time gauges, so an operator can see the 3-D shape a step
+    compiled."""
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError(
             f"sharded gradient exchange supports SUM/AVERAGE only (got "
@@ -233,8 +273,13 @@ def reduce_scatter_gradients(
                 f"reduce_scatter_gradients needs the size of axis "
                 f"{shard_axis!r}: call inside shard_map over a "
                 f"('{batch_axis}', '{shard_axis}') mesh or pass plan=")
-        plan = build_shard_plan(grads, shard_size, threshold, num_buckets)
+        model_in_scope = fusion._axis_size(model_axis)
+        plan = build_shard_plan(grads, shard_size, threshold, num_buckets,
+                                model_size=model_in_scope or 1)
     shard_size = plan.shard_size
+    model_size = plan.model_size
+    if model_size == 1:
+        model_size = fusion._axis_size(model_axis) or 1
     batch_size = fusion._axis_size(batch_axis)
     if batch_size is None:
         if shard_size > 1:
@@ -265,7 +310,8 @@ def reduce_scatter_gradients(
         scatter_bytes=[int(b.size) * int(jnp.dtype(w).itemsize
                                          if w is not None else b.dtype.itemsize)
                        for b, w in zip(buffers, wire)],
-        gather_bytes=[int(b.nbytes) for b in buffers])
+        gather_bytes=[int(b.nbytes) for b in buffers],
+        model_size=model_size)
     from ..tracing import record_compiled_plan
 
     record_compiled_plan(
@@ -321,6 +367,13 @@ def mask_pad_updates(updates, plan: ShardPlan, shard_axis: str = SHARD_AXIS):
             # Host-side (shard_size, chunk) view: global positions.
             pos = jnp.arange(plan.padded_sizes[b]).reshape(plan.shard_size,
                                                            chunk)
+        elif buf.shape[0] == plan.shard_size * plan.model_size:
+            # Host-side model-stacked (model*shard, chunk) view
+            # (shard_params_model): the pad layout repeats per model rank.
+            pos = jnp.tile(
+                jnp.arange(plan.padded_sizes[b]).reshape(plan.shard_size,
+                                                         chunk),
+                (plan.model_size, 1))
         else:
             row = lax.axis_index(shard_axis)
             pos = (row * chunk + jnp.arange(chunk))[None, :]
@@ -355,16 +408,60 @@ def reshard_tree(full, template, plan: ShardPlan):
         template, full, is_leaf=_is_sharded)
 
 
-def shard_specs(tree, shard_axis: str = SHARD_AXIS):
+def shard_specs(tree, shard_axis: str = SHARD_AXIS,
+                model_axis: Optional[str] = None):
     """shard_map in/out specs for a (possibly nested) sharded state:
     ``P(shard_axis)`` at every :class:`ShardedBuckets` position (a prefix
     spec — it applies to each buffer row-wise), ``P()`` (replicated) for
-    everything else (step counters, scalars)."""
+    everything else (step counters, scalars).
+
+    With ``model_axis`` the buckets are the model-stacked
+    ``(model*shard, chunk)`` buffers of :func:`shard_params_model`, and
+    the spec becomes ``P((model_axis, shard_axis))`` — row 0 jointly
+    partitioned over both axes, model-major, so each device again sees its
+    own ``(1, chunk)`` row and the in-shard_map code path is byte-for-byte
+    the 2-D one."""
     from jax.sharding import PartitionSpec as P
 
+    spec = P(shard_axis) if model_axis is None else \
+        P((model_axis, shard_axis))
     return jax.tree_util.tree_map(
-        lambda x: P(shard_axis) if _is_sharded(x) else P(),
+        lambda x: spec if _is_sharded(x) else P(),
         tree, is_leaf=_is_sharded)
+
+
+def shard_params_model(local_trees: Sequence, plan: ShardPlan) -> ShardedBuckets:
+    """Partition PER-MODEL-RANK local trees (tensor-parallel slice trees,
+    one per model rank, structure- and shape-uniform) into one stacked
+    buffer per bucket: ``(model_size * shard_size, chunk)``, model-major.
+    Pass into shard_map over the 3-D mesh with
+    ``in_specs=P(('model', 'shard'))`` (see :func:`shard_specs`) so each
+    device receives exactly its model rank's shard row — from there
+    :func:`gather_params` / :func:`reduce_scatter_gradients` /
+    :func:`mask_pad_updates` run unchanged within the device's model
+    group."""
+    if len(local_trees) != plan.model_size:
+        raise ValueError(
+            f"need one local tree per model rank: got {len(local_trees)} "
+            f"trees for model_size={plan.model_size}")
+    per_rank = [fusion.fuse(t, plan.base) for t in local_trees]
+    return ShardedBuckets(
+        jnp.concatenate(
+            [bufs[b].reshape(plan.shard_size, -1) for bufs in per_rank],
+            axis=0)
+        for b in range(plan.num_buckets))
+
+
+def unshard_params_model(sharded: ShardedBuckets, plan: ShardPlan) -> list:
+    """Host-side inverse of :func:`shard_params_model`: the per-model-rank
+    local trees, in model-rank order."""
+    out = []
+    for r in range(plan.model_size):
+        rows = ShardedBuckets(
+            b[r * plan.shard_size:(r + 1) * plan.shard_size]
+            for b in sharded)
+        out.append(unshard_params(rows, plan))
+    return out
 
 
 def state_bytes(tree) -> int:

@@ -156,6 +156,18 @@ class LLMConfig:
     dim: int = 16              # HOROVOD_SERVE_LLM_DIM
     max_context: int = 512     # HOROVOD_SERVE_LLM_MAX_CONTEXT
     seed: int = 0              # HOROVOD_SERVE_LLM_SEED
+    # -- multi-chip mesh replicas (ISSUE 19) ----------------------------------
+    model_shards: int = 1      # HOROVOD_SERVE_LLM_MODEL_SHARDS: chips per
+    #                            replica group; every weight and KV page
+    #                            is dim-sliced 1/s per chip, reassembled
+    #                            on access (token-for-token exact)
+    chip_budget: int = 0       # HOROVOD_SERVE_LLM_CHIP_BUDGET_BYTES:
+    #                            per-chip persistent byte ceiling (params
+    #                            slice + KV slice); 0 = unenforced. A
+    #                            replica whose per-chip footprint exceeds
+    #                            it refuses to start — the gate the
+    #                            oversized-model smoke frames so the 2-D
+    #                            plane provably cannot serve the model
 
     _ENV = {
         "block_size": "HOROVOD_SERVE_LLM_BLOCK_SIZE",
@@ -174,6 +186,8 @@ class LLMConfig:
         "dim": "HOROVOD_SERVE_LLM_DIM",
         "max_context": "HOROVOD_SERVE_LLM_MAX_CONTEXT",
         "seed": "HOROVOD_SERVE_LLM_SEED",
+        "model_shards": "HOROVOD_SERVE_LLM_MODEL_SHARDS",
+        "chip_budget": "HOROVOD_SERVE_LLM_CHIP_BUDGET_BYTES",
     }
 
     @classmethod
@@ -233,3 +247,15 @@ class LLMConfig:
             raise ValueError(
                 f"SLOs must be > 0, got slo_ms={self.slo_ms} "
                 f"ttft_slo_ms={self.ttft_slo_ms}")
+        if self.model_shards < 1:
+            raise ValueError(
+                f"model_shards must be >= 1, got {self.model_shards}")
+        if self.dim % self.model_shards:
+            raise ValueError(
+                f"model_shards ({self.model_shards}) must divide dim "
+                f"({self.dim}): KV pages and weights are sliced "
+                f"uniformly per chip")
+        if self.chip_budget < 0:
+            raise ValueError(
+                f"chip_budget must be >= 0 (0 = unenforced), got "
+                f"{self.chip_budget}")

@@ -38,9 +38,54 @@ def pack_kv(tokens, k_arr: np.ndarray, v_arr: np.ndarray,
             "first_token": int(first_token)}
 
 
+def pack_kv_sharded(tokens, k_shards, v_shards, first_token: int) -> dict:
+    """The wire payload for one prefilled sequence on a MULTI-CHIP mesh
+    replica (ISSUE 19): the pages cross the authenticated channel as
+    per-model-shard dim-slices (``k_shards``/``v_shards`` lists of
+    ``[prompt_len, dim/s]``), so the decode group's chips each land their
+    own slice without ever materializing the full page on one chip."""
+    ks = [np.ascontiguousarray(p, dtype=np.float32) for p in k_shards]
+    vs = [np.ascontiguousarray(p, dtype=np.float32) for p in v_shards]
+    if (not ks or len(ks) != len(vs)
+            or any(p.ndim != 2 or p.shape != ks[0].shape for p in ks + vs)
+            or len(ks[0]) != len(tokens)):
+        raise ValueError(
+            f"malformed sharded KV payload: "
+            f"k{[getattr(p, 'shape', None) for p in ks]} "
+            f"v{[getattr(p, 'shape', None) for p in vs]} for "
+            f"{len(tokens)} tokens")
+    return {"tokens": [int(t) for t in tokens], "k_shards": ks,
+            "v_shards": vs, "first_token": int(first_token)}
+
+
+def unpack_kv_sharded(payload: dict) -> tuple:
+    """-> (tokens, k_shards, v_shards, first_token); same loud-failure
+    validation as :func:`unpack_kv`, per slice."""
+    ks = [np.asarray(p, dtype=np.float32) for p in payload["k_shards"]]
+    vs = [np.asarray(p, dtype=np.float32) for p in payload["v_shards"]]
+    tokens = [int(t) for t in payload["tokens"]]
+    if (not ks or len(ks) != len(vs)
+            or any(p.ndim != 2 or p.shape != ks[0].shape for p in ks + vs)
+            or len(ks[0]) != len(tokens)):
+        raise ValueError(
+            f"malformed sharded KV payload: "
+            f"k{[getattr(p, 'shape', None) for p in ks]} "
+            f"v{[getattr(p, 'shape', None) for p in vs]} for "
+            f"{len(tokens)} tokens")
+    return tokens, ks, vs, int(payload["first_token"])
+
+
+def is_sharded_payload(payload: dict) -> bool:
+    return "k_shards" in payload
+
+
 def handoff_nbytes(payload: dict) -> int:
     """Tensor bytes this handoff moves (the metric the smoke reports;
-    token ids and framing are noise next to the pages)."""
+    token ids and framing are noise next to the pages). Sharded payloads
+    count every slice — same total bytes as the dense format."""
+    if is_sharded_payload(payload):
+        return int(sum(p.nbytes for p in payload["k_shards"])
+                   + sum(p.nbytes for p in payload["v_shards"]))
     return int(payload["k"].nbytes + payload["v"].nbytes)
 
 

@@ -182,42 +182,113 @@ class PagedKVCache:
     contiguous-context view is the concatenation of its table's blocks
     truncated to its token count — :meth:`gather` materializes exactly
     that, which is what makes paged decode bitwise identical to decode
-    over a contiguous cache (same values, same order, same reduction)."""
+    over a contiguous cache (same values, same order, same reduction).
+
+    With ``model_shards > 1`` the replica is a multi-chip mesh process
+    group (ISSUE 19) and each chip persistently holds a *dim-slice* of
+    every page: storage becomes ``model_shards`` arrays of
+    ``[num_blocks, block_size, dim // model_shards]``, the block TABLE is
+    shared (one admission decision for the group — chips never disagree
+    on paging), and :meth:`gather` reassembles the full ``[length, dim]``
+    view by concatenating the per-shard slices in shard order, which is
+    bitwise the unsharded array. ``model_shards=1`` keeps the exact
+    single-array layout (``self.k``/``self.v``) and code path."""
 
     def __init__(self, num_blocks: int, block_size: int, dim: int,
-                 watermark: float = 0.05, dtype=np.float32) -> None:
+                 watermark: float = 0.05, dtype=np.float32,
+                 model_shards: int = 1) -> None:
+        if model_shards < 1 or dim % model_shards:
+            raise ValueError(
+                f"model_shards must be >= 1 and divide dim, got "
+                f"model_shards={model_shards} dim={dim}")
         self.alloc = BlockAllocator(num_blocks, block_size, watermark)
         self.block_size = block_size
-        self.k = np.zeros((num_blocks, block_size, dim), dtype)
-        self.v = np.zeros((num_blocks, block_size, dim), dtype)
+        self.dim = dim
+        self.model_shards = model_shards
+        d = dim // model_shards
+        self.k_shards = [np.zeros((num_blocks, block_size, d), dtype)
+                         for _ in range(model_shards)]
+        self.v_shards = [np.zeros((num_blocks, block_size, d), dtype)
+                         for _ in range(model_shards)]
+        if model_shards == 1:
+            # Unsharded view: the historical attributes ARE the storage.
+            self.k = self.k_shards[0]
+            self.v = self.v_shards[0]
 
-    def write(self, seq_id, pos: int, k_vec: np.ndarray,
-              v_vec: np.ndarray) -> None:
+    def per_chip_nbytes(self) -> int:
+        """Persistent KV bytes ONE chip of the group holds (the whole
+        cache when unsharded) — counted by the chip-budget gate alongside
+        ``ShardedLMParams.per_chip_nbytes``."""
+        return int(self.k_shards[0].nbytes + self.v_shards[0].nbytes)
+
+    def _vec_shards(self, vec) -> list:
+        """One token's K (or V) as per-shard dim-slices; accepts either a
+        full ``[dim]`` vector or a pre-sliced list of ``model_shards``
+        pieces (a sharded handoff page arrives pre-sliced)."""
+        if isinstance(vec, (list, tuple)):
+            if len(vec) == self.model_shards:
+                return [np.asarray(p) for p in vec]
+            vec = np.concatenate([np.asarray(p) for p in vec], axis=-1)
+        vec = np.asarray(vec)
+        if self.model_shards == 1:
+            return [vec]
+        return np.split(vec, self.model_shards, axis=-1)
+
+    def write(self, seq_id, pos: int, k_vec, v_vec) -> None:
         """Scatter one token's K/V into the sequence's block for position
-        ``pos`` (the table must already cover it — ensure/extend first)."""
+        ``pos`` (the table must already cover it — ensure/extend first).
+        Under sharding each chip scatters its own dim-slice."""
         table = self.alloc._tables[seq_id]
         b = table[pos // self.block_size]
         s = pos % self.block_size
-        self.k[b, s] = k_vec
-        self.v[b, s] = v_vec
+        for r, (kp, vp) in enumerate(zip(self._vec_shards(k_vec),
+                                         self._vec_shards(v_vec))):
+            self.k_shards[r][b, s] = kp
+            self.v_shards[r][b, s] = vp
+
+    def gather_sharded(self, seq_id, length: int) -> tuple:
+        """The first ``length`` context positions as per-model-shard page
+        slices: two lists of ``model_shards`` arrays, each
+        ``[length, dim // model_shards]``, in token order."""
+        table = self.alloc._tables[seq_id]
+        need = blocks_for(length, self.block_size)
+        d = self.k_shards[0].shape[-1]
+        ks = [a[table[:need]].reshape(-1, d)[:length] for a in self.k_shards]
+        vs = [a[table[:need]].reshape(-1, d)[:length] for a in self.v_shards]
+        return ks, vs
 
     def gather(self, seq_id, length: int) -> tuple:
         """The first ``length`` context positions as contiguous
-        ``[length, dim]`` K and V arrays, in token order."""
-        table = self.alloc._tables[seq_id]
-        need = blocks_for(length, self.block_size)
-        ks = self.k[table[:need]].reshape(-1, self.k.shape[-1])[:length]
-        vs = self.v[table[:need]].reshape(-1, self.v.shape[-1])[:length]
-        return ks, vs
+        ``[length, dim]`` K and V arrays, in token order (the per-shard
+        slices concatenated back — bitwise the unsharded gather)."""
+        ks, vs = self.gather_sharded(seq_id, length)
+        if self.model_shards == 1:
+            return ks[0], vs[0]
+        return (np.concatenate(ks, axis=-1), np.concatenate(vs, axis=-1))
 
-    def load(self, seq_id, k_arr: np.ndarray, v_arr: np.ndarray) -> bool:
-        """Handoff restore: admission-allocate a table for ``len(k_arr)``
-        tokens and scatter the prefilled K/V into it. False when the
-        allocation would dip under the watermark (caller keeps the
-        sequence queued)."""
-        n = len(k_arr)
+    @staticmethod
+    def handoff_tokens(k_arr) -> int:
+        """Token count of a handoff K (or V) payload — a full
+        ``[n, dim]`` array or a list of per-shard ``[n, dim/s]`` slices."""
+        if isinstance(k_arr, (list, tuple)):
+            return len(k_arr[0])
+        return len(k_arr)
+
+    def load(self, seq_id, k_arr, v_arr) -> bool:
+        """Handoff restore: admission-allocate a table for the payload's
+        token count and scatter the prefilled K/V into it — full arrays
+        or per-model-shard page-slice lists both work, whatever this
+        cache's own sharding. False when the allocation would dip under
+        the watermark (caller keeps the sequence queued)."""
+        n = self.handoff_tokens(k_arr)
         if self.alloc.alloc(seq_id, n) is None:
             return False
+        if isinstance(k_arr, (list, tuple)):
+            k_rows = [[np.asarray(p)[pos] for p in k_arr] for pos in range(n)]
+            v_rows = [[np.asarray(p)[pos] for p in v_arr] for pos in range(n)]
+        else:
+            k_rows = [np.asarray(k_arr)[pos] for pos in range(n)]
+            v_rows = [np.asarray(v_arr)[pos] for pos in range(n)]
         for pos in range(n):
-            self.write(seq_id, pos, k_arr[pos], v_arr[pos])
+            self.write(seq_id, pos, k_rows[pos], v_rows[pos])
         return True

@@ -35,7 +35,7 @@ import time
 
 from ...tracing.serve import get_serve_tracer
 from ..manager import ReplicaManager, _Replica
-from .handoff import handoff_nbytes, pack_kv
+from .handoff import handoff_nbytes, pack_kv, pack_kv_sharded
 
 _FEED_BATCH = 16          # sequences fed to a decode replica per cycle
 _POLL_IDLE_SLEEP_S = 0.02
@@ -164,8 +164,16 @@ class PoolManager(ReplicaManager):
                 tracer.span(req.tid, "prefill", int(t0 * 1e9),
                             tracer.now_ns(), rid=req.rid, replica=rep.rid,
                             n_tokens=len(req.prompt))
-            self.server.on_prefilled(req, pack_kv(
-                req.prompt, resp["k"], resp["v"], resp["next_token"]))
+            if "k_shards" in resp:
+                # Multi-chip prefill group: the pages arrive and travel
+                # onward as per-model-shard slices (ISSUE 19).
+                payload = pack_kv_sharded(req.prompt, resp["k_shards"],
+                                          resp["v_shards"],
+                                          resp["next_token"])
+            else:
+                payload = pack_kv(req.prompt, resp["k"], resp["v"],
+                                  resp["next_token"])
+            self.server.on_prefilled(req, payload)
 
     def _decode_worker(self, rep: _Replica) -> None:
         last_poll_t = time.monotonic()

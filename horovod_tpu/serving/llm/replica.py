@@ -39,6 +39,8 @@ import threading
 import time
 import traceback
 
+import numpy as np
+
 from ...elastic import fault
 from ...runner.network import BasicService
 from ...tracing import flight as _flight
@@ -46,9 +48,45 @@ from ...tracing.serve import get_serve_tracer, init_serve_tracer
 from ...utils.logging import log
 from ..config import LLMConfig
 from .generator import DecodeEngine
-from .handoff import unpack_kv
+from .handoff import is_sharded_payload, unpack_kv, unpack_kv_sharded
 from .kv_cache import PagedKVCache
 from .scheduler import IterationScheduler
+
+
+def per_chip_persistent_nbytes(llm_cfg: LLMConfig, params,
+                               with_cache: bool = True) -> int:
+    """Persistent bytes ONE chip of this replica group must hold: its
+    parameter slice plus (decode/both roles) its KV page slice. This is
+    the figure the HOROVOD_SERVE_LLM_CHIP_BUDGET_BYTES gate compares —
+    access-time gathers are transient and do not count, exactly like the
+    training plane's ZeRO accounting."""
+    from ..model import ShardedLMParams, lm_params_nbytes
+
+    if isinstance(params, ShardedLMParams):
+        p = params.per_chip_nbytes()
+    else:
+        p = lm_params_nbytes(params)
+    if not with_cache:
+        return p
+    d = int(params["dim"]) // llm_cfg.model_shards
+    kv = llm_cfg.num_blocks * llm_cfg.block_size * d * 4 * 2  # f32 K+V
+    return p + kv
+
+
+def check_chip_budget(llm_cfg: LLMConfig, params,
+                      with_cache: bool = True) -> int:
+    """Refuse to start a replica whose per-chip footprint exceeds the
+    chip budget — the loud failure that makes the oversized-model smoke
+    meaningful (an unsharded replica of the same model must die here)."""
+    need = per_chip_persistent_nbytes(llm_cfg, params, with_cache)
+    if llm_cfg.chip_budget and need > llm_cfg.chip_budget:
+        raise MemoryError(
+            f"per-chip persistent footprint {need} B exceeds chip budget "
+            f"{llm_cfg.chip_budget} B at model_shards="
+            f"{llm_cfg.model_shards}; shard the model across more chips "
+            f"(HOROVOD_SERVE_LLM_MODEL_SHARDS) or raise "
+            f"HOROVOD_SERVE_LLM_CHIP_BUDGET_BYTES")
+    return need
 
 
 class LLMReplicaService(BasicService):
@@ -62,6 +100,7 @@ class LLMReplicaService(BasicService):
         self.replica_id = replica_id
         self._requests = 0
         self._prefills = 0
+        self.per_chip_bytes = 0   # set by main() after the budget check
         super().__init__(key, host=host, port=0)
 
     def handle(self, request, client_addr):
@@ -74,6 +113,8 @@ class LLMReplicaService(BasicService):
                 stats = self.engine.stats() if self.engine else {}
                 return {"ok": True, "replica": self.replica_id,
                         "role": self.role, "prefills": self._prefills,
+                        "model_shards": self.llm.model_shards,
+                        "per_chip_bytes": self.per_chip_bytes,
                         "stats": stats}
             if kind == "prefill":
                 return self._prefill(request)
@@ -119,6 +160,15 @@ class LLMReplicaService(BasicService):
         if tracer and request.get("trace"):
             tracer.span(request["trace"], "prefill", t0, tracer.now_ns(),
                         side="replica", n_tokens=len(tokens))
+        if self.llm.model_shards > 1:
+            # Multi-chip group: the pages leave this replica as
+            # per-model-shard dim-slices so the decode group's chips each
+            # land their own slice (handoff.pack_kv_sharded downstream).
+            s = self.llm.model_shards
+            return {"ok": True,
+                    "k_shards": np.split(np.asarray(k), s, axis=1),
+                    "v_shards": np.split(np.asarray(v), s, axis=1),
+                    "next_token": nxt, "n_tokens": len(tokens)}
         return {"ok": True, "k": k, "v": v, "next_token": nxt,
                 "n_tokens": len(tokens)}
 
@@ -127,7 +177,10 @@ class LLMReplicaService(BasicService):
             return {"ok": False, "error":
                     f"submit_seq on a {self.role} replica"}
         self._chaos_tick()
-        tokens, k, v, first = unpack_kv(request["payload"])
+        if is_sharded_payload(request["payload"]):
+            tokens, k, v, first = unpack_kv_sharded(request["payload"])
+        else:
+            tokens, k, v, first = unpack_kv(request["payload"])
         self.engine.submit(
             int(request["rid"]), tokens,
             int(request["max_new_tokens"]), self.llm.eos_id,
@@ -169,18 +222,27 @@ def main() -> int:
         or "horovod_tpu.serving.model:lm_builder"
     llm_cfg = LLMConfig.from_env()
 
-    from ..model import load_for_serving, resolve_builder
+    from ..model import load_for_serving, resolve_builder, shard_lm_params
 
     builder = resolve_builder(builder_spec)
     state = load_for_serving(ckpt) if ckpt else None
     params = builder(state)
+    if llm_cfg.model_shards > 1:
+        # This replica process IS a multi-chip mesh group: every weight
+        # is dim-0-sliced 1/s per chip and reassembled on access, so the
+        # scheduler/decode math below runs unchanged and token-for-token
+        # exact against the unsharded model (ISSUE 19).
+        params = shard_lm_params(params, llm_cfg.model_shards)
+    per_chip = check_chip_budget(llm_cfg, params,
+                                 with_cache=role in ("decode", "both"))
 
     tracer = init_serve_tracer(f"llm-{role}-{replica_id}")
     engine = None
     if role in ("decode", "both"):
         cache = PagedKVCache(llm_cfg.num_blocks, llm_cfg.block_size,
                              int(params["dim"]),
-                             watermark=llm_cfg.watermark)
+                             watermark=llm_cfg.watermark,
+                             model_shards=llm_cfg.model_shards)
         engine = DecodeEngine(IterationScheduler(
             cache, params, max_active=llm_cfg.max_active,
             admission_window=llm_cfg.admission_window,
@@ -204,6 +266,7 @@ def main() -> int:
 
     svc = LLMReplicaService(secret, role, params, engine, llm_cfg,
                             replica_id)
+    svc.per_chip_bytes = per_chip
     ppid = os.getppid()
     threading.Thread(target=_watch_parent, args=(ppid,), daemon=True).start()
 
@@ -213,7 +276,9 @@ def main() -> int:
     os.rename(tmp, ready_file)
     log("info", f"llm replica {replica_id} ({role}) ready on port "
         f"{svc.port} (blocks={llm_cfg.num_blocks}x{llm_cfg.block_size}, "
-        f"max_active={llm_cfg.max_active})")
+        f"max_active={llm_cfg.max_active}, "
+        f"model_shards={llm_cfg.model_shards}, "
+        f"per_chip_bytes={per_chip})")
 
     while True:
         time.sleep(3600)

@@ -214,12 +214,14 @@ class IterationScheduler:
         """Give the sequence KV state: load the handed-off pages, or
         (re-)prefill locally. False = blocks unavailable, stay queued."""
         if seq.handoff is not None:
+            # Full [n, dim] arrays or per-model-shard page-slice LISTS
+            # (multi-chip handoff) — the cache normalizes either; a bare
+            # np.asarray here would mis-stack a slice list into 3-D.
             k_arr, v_arr = seq.handoff
-            if not self.cache.load(seq.seq_id, np.asarray(k_arr),
-                                   np.asarray(v_arr)):
+            if not self.cache.load(seq.seq_id, k_arr, v_arr):
                 return False
             seq.handoff = None
-            seq.kv_len = len(k_arr)
+            seq.kv_len = self.cache.handoff_tokens(k_arr)
             return True
         # Local prefill: context is everything but the newest token (the
         # newest token is fed as the next decode step). For a fresh

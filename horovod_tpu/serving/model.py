@@ -213,6 +213,97 @@ def lm_generate(params: dict, prompt, max_new_tokens: int,
     return out
 
 
+def lm_params_nbytes(params) -> int:
+    """Persistent parameter bytes of a TinyLM params dict (arrays only;
+    the scalars are free)."""
+    return int(sum(v.nbytes for v in params.values()
+                   if isinstance(v, np.ndarray)))
+
+
+class ShardedLMParams:
+    """A TinyLM sharded across a multi-chip serving replica's model axis
+    (ISSUE 19) — dict-like, so the scheduler's decode step and
+    ``lm_prefill``/``lm_context_step`` run UNCHANGED against it.
+
+    Each of the ``model_shards`` chips persistently holds a 1/s row-slice
+    of every weight; ``__getitem__`` reassembles the full array on access
+    (one concatenate — the simulated all-gather of ZeRO-Inference-style
+    weight streaming) and the reassembled array is BITWISE the original,
+    so sharded serving is token-for-token exact by construction. The
+    gather is transient: per-chip PERSISTENT bytes
+    (:meth:`per_chip_nbytes`) is what the chip-budget gate counts, the
+    same convention the training plane's ``gather_params`` refresh uses."""
+
+    def __init__(self, shards) -> None:
+        shards = list(shards)
+        if not shards:
+            raise ValueError("need at least one shard")
+        keys = set(shards[0])
+        if any(set(s) != keys for s in shards):
+            raise ValueError("shards disagree on param keys")
+        self._shards = shards
+
+    @property
+    def model_shards(self) -> int:
+        return len(self._shards)
+
+    def __getitem__(self, key):
+        v = self._shards[0][key]
+        if not isinstance(v, np.ndarray):
+            return v            # replicated scalar (vocab/dim/max_context)
+        if len(self._shards) == 1:
+            return v
+        return np.concatenate([s[key] for s in self._shards], axis=0)
+
+    def __contains__(self, key) -> bool:
+        return key in self._shards[0]
+
+    def get(self, key, default=None):
+        return self[key] if key in self else default
+
+    def keys(self):
+        return self._shards[0].keys()
+
+    def shard(self, rank: int) -> dict:
+        """One chip's persistent slice tree."""
+        return self._shards[rank]
+
+    def per_chip_nbytes(self) -> int:
+        """Persistent parameter bytes the LARGEST chip holds — the figure
+        the HOROVOD_SERVE_LLM_CHIP_BUDGET_BYTES gate checks."""
+        return max(lm_params_nbytes(s) for s in self._shards)
+
+
+def shard_lm_params(params: dict, model_shards: int) -> ShardedLMParams:
+    """Slice a full TinyLM params dict into ``model_shards`` per-chip row
+    slices (every weight's dim 0: embed/pos rows, wq/wk/wv/wo input rows).
+    Row-slicing makes the access-time gather a plain concatenate — bitwise
+    exact — and every dim-0 size of the reference model (vocab, dim,
+    max_context) must divide evenly, mirroring the training plane's
+    uniform-slice discipline (tensor.tp_pair_slices)."""
+    if model_shards < 1:
+        raise ValueError(f"model_shards must be >= 1, got {model_shards}")
+    if model_shards == 1:
+        return ShardedLMParams([params])
+    for key, v in params.items():
+        if isinstance(v, np.ndarray) and v.shape[0] % model_shards:
+            raise ValueError(
+                f"param {key!r} dim 0 ({v.shape[0]}) not divisible by "
+                f"model_shards {model_shards}: sharded serving slices "
+                f"must be uniform")
+    shards = []
+    for r in range(model_shards):
+        shard = {}
+        for key, v in params.items():
+            if isinstance(v, np.ndarray):
+                per = v.shape[0] // model_shards
+                shard[key] = v[r * per:(r + 1) * per]
+            else:
+                shard[key] = v
+        shards.append(shard)
+    return ShardedLMParams(shards)
+
+
 def lm_builder(state: Any) -> dict:
     """Builder for the LLM serving plane (``HVD_SERVE_BUILDER`` default
     for llm replicas): returns the TinyLM params dict. A checkpointed

@@ -255,6 +255,37 @@ def test_training_rejit_knob_requires_callback():
                for r in rejits)
 
 
+def test_training_mesh_knob_controller_visible(monkeypatch):
+    """ISSUE 19: the 3-D mesh cube registers as a rejit-class knob when
+    the caller enumerates legal shapes — current value seeded from
+    HOROVOD_MESH, propose/canary/commit landing through the rejit
+    callback like every other trace-time constant."""
+    monkeypatch.setenv("HOROVOD_MESH", "4x2x1")
+    rejits = []
+    tc = TrainingController(engine=_FakeEngine(), rejit=rejits.append,
+                            canary_steps=2, cooldown_s=0.0,
+                            reg=MetricsRegistry(),
+                            mesh_choices=("4x2x1", "2x2x2"))
+    assert tc.loop.values["mesh"] == "4x2x1"
+    assert tc.loop.propose("mesh", "2x2x2", "operator reshape")
+    assert {"mesh": "2x2x2"} in rejits
+    verdicts = [tc.on_step(10.0) for _ in range(3)]
+    assert "commit" in verdicts
+    assert tc.loop.values["mesh"] == "2x2x2"
+
+
+def test_training_mesh_knob_validates_choices():
+    """Oversubscribed/malformed spec strings are rejected at construction,
+    not at the first reshape."""
+    with pytest.raises(ValueError):
+        TrainingController(engine=_FakeEngine(), reg=MetricsRegistry(),
+                           mesh_choices=("16x1x1",))
+    # Without mesh_choices the knob never registers (back-compat).
+    tc = TrainingController(engine=_FakeEngine(), reg=MetricsRegistry())
+    assert "mesh" not in tc.loop.values
+    assert not tc.loop.propose("mesh", "2x2x2", "unregistered knob")
+
+
 # ------------------------------------------------- serving controller
 
 def _serving(cfg=None, reg=None, **kw):

@@ -301,6 +301,47 @@ def test_disaggregated_e2e_oracle_kill_and_stats():
         server.stop()
 
 
+def test_sharded_replica_e2e_oracle():
+    """model_shards=2 (ISSUE 19): the disaggregated path serves through
+    multi-chip mesh replica groups under a chip budget the UNSHARDED
+    model provably exceeds — sharded pages cross the authenticated
+    handoff channel and generations stay token-for-token oracle-exact."""
+    from horovod_tpu.serving.llm.replica import per_chip_persistent_nbytes
+    from horovod_tpu.serving.model import shard_lm_params
+
+    need_full = per_chip_persistent_nbytes(
+        LLMConfig.from_env(colocated=0), PARAMS)
+    need_sharded = per_chip_persistent_nbytes(
+        LLMConfig.from_env(colocated=0, model_shards=2),
+        shard_lm_params(PARAMS, 2))
+    budget = (need_full + need_sharded) // 2
+    assert need_sharded <= budget < need_full, \
+        "budget framing broken — the oversized claim would be vacuous"
+
+    cfg = ServeConfig.from_env(port=0, slo_ms=60000.0)
+    llm_cfg = LLMConfig.from_env(colocated=0, prefill_replicas=1,
+                                 decode_replicas=1, model_shards=2,
+                                 chip_budget=budget)
+    server = LLMServer(config=cfg, llm_config=llm_cfg).start()
+    before = dict(server.reg.snapshot()["counters"])
+    try:
+        assert server.wait_ready(60), \
+            {r: p.describe() for r, p in server.pools.items()}
+        for pr, n in ([3, 17, 5], 16), ([60], 8), ([9, 30, 2, 8], 12):
+            st, body = _post(server.port, {"prompt": pr, "max_tokens": n})
+            assert st == 200
+            assert body["tokens"] == lm_generate(PARAMS, pr, n), pr
+        after = server.reg.snapshot()["counters"]
+
+        def delta(key):
+            return after.get(key, 0) - before.get(key, 0)
+
+        assert delta('horovod_serve_llm_handoffs_total{path="wire"}') >= 3
+        assert delta("horovod_serve_llm_handoff_bytes_total") > 0
+    finally:
+        server.stop()
+
+
 def test_colocated_e2e_local_fast_path():
     """HOROVOD_SERVE_LLM_COLOCATED=1: one both-role replica, prefill
     inside the decode engine, handoffs counted as path=local with zero

@@ -1,0 +1,292 @@
+"""Multi-chip sharded serving replicas (ISSUE 19): a prefill/decode
+replica as a model-sharded mesh process group. Weights are dim-0-sliced
+per chip and reassembled bitwise on access (ShardedLMParams); KV block
+tables hold per-model-shard page slices (PagedKVCache(model_shards=));
+the handoff channel carries the sharded pages. The bar everywhere is
+token-for-token exactness against the unsharded ``lm_generate`` oracle —
+including under preemption churn — plus the chip-budget gate that makes
+the oversized-model smoke meaningful."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from horovod_tpu.serving.config import LLMConfig
+from horovod_tpu.serving.llm.handoff import (
+    handoff_nbytes,
+    is_sharded_payload,
+    pack_kv,
+    pack_kv_sharded,
+    unpack_kv_sharded,
+)
+from horovod_tpu.serving.llm.kv_cache import PagedKVCache
+from horovod_tpu.serving.llm.replica import (
+    check_chip_budget,
+    per_chip_persistent_nbytes,
+)
+from horovod_tpu.serving.llm.scheduler import IterationScheduler, Sequence
+from horovod_tpu.serving.model import (
+    ShardedLMParams,
+    lm_generate,
+    lm_params_nbytes,
+    lm_prefill,
+    shard_lm_params,
+    tiny_lm_params,
+)
+
+PARAMS = tiny_lm_params()
+ARRAY_KEYS = ("embed", "pos", "wq", "wk", "wv", "wo")
+
+
+def _run(sched, max_steps=2000, until=None):
+    for _ in range(max_steps):
+        sched.step()
+        if until is not None and sched.finished_total >= until:
+            return
+        if not sched.waiting and not sched.running:
+            return
+    raise AssertionError(f"scheduler did not drain: {sched.stats()}")
+
+
+def _outputs(sched) -> dict:
+    return {s.seq_id: list(s.out) for s in sched.finished}
+
+
+# -- sharded params: bitwise gather + per-chip accounting ---------------------
+
+
+@pytest.mark.parametrize("s", [1, 2, 4])
+def test_shard_lm_params_gather_bitwise(s):
+    sp = shard_lm_params(PARAMS, s)
+    assert sp.model_shards == s
+    for key in ARRAY_KEYS:
+        got = sp[key]
+        np.testing.assert_array_equal(got, PARAMS[key])
+        assert got.dtype == PARAMS[key].dtype
+    for key in ("vocab", "dim", "max_context"):
+        assert sp[key] == PARAMS[key]
+    assert "embed" in sp and "nope" not in sp
+    assert sp.get("nope") is None
+    assert set(sp.keys()) == set(PARAMS.keys())
+
+
+def test_shard_lm_params_per_chip_bytes():
+    total = lm_params_nbytes(PARAMS)
+    for s in (2, 4):
+        sp = shard_lm_params(PARAMS, s)
+        assert sp.per_chip_nbytes() == total // s
+        # The shards really are slices, not copies of the whole model.
+        assert lm_params_nbytes(sp.shard(0)) == total // s
+
+
+def test_shard_lm_params_validates_divisibility():
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_lm_params(PARAMS, 3)   # 64/16/512 all reject s=3
+    with pytest.raises(ValueError, match="model_shards"):
+        shard_lm_params(PARAMS, 0)
+
+
+# -- sharded KV pages ---------------------------------------------------------
+
+
+def test_sharded_cache_gather_bitwise_vs_unsharded():
+    rng = np.random.default_rng(7)
+    dense = PagedKVCache(8, 4, 16)
+    sharded = PagedKVCache(8, 4, 16, model_shards=4)
+    assert sharded.per_chip_nbytes() * 4 == dense.per_chip_nbytes()
+    for cache in (dense, sharded):
+        assert cache.alloc.alloc("a", 10) is not None
+    for pos in range(10):
+        k, v = rng.normal(size=16).astype(np.float32), \
+            rng.normal(size=16).astype(np.float32)
+        dense.write("a", pos, k, v)
+        sharded.write("a", pos, k, v)
+    kd, vd = dense.gather("a", 10)
+    ks, vs = sharded.gather("a", 10)
+    np.testing.assert_array_equal(kd, ks)
+    np.testing.assert_array_equal(vd, vs)
+    # The per-shard page slices concatenate back to exactly the full view.
+    k_sl, v_sl = sharded.gather_sharded("a", 10)
+    assert len(k_sl) == 4 and k_sl[0].shape == (10, 4)
+    np.testing.assert_array_equal(np.concatenate(k_sl, axis=-1), kd)
+    np.testing.assert_array_equal(np.concatenate(v_sl, axis=-1), vd)
+
+
+def test_cache_load_accepts_slice_lists_and_full_arrays():
+    rng = np.random.default_rng(3)
+    k = rng.normal(size=(6, 16)).astype(np.float32)
+    v = rng.normal(size=(6, 16)).astype(np.float32)
+    k_sl = np.split(k, 2, axis=1)
+    v_sl = np.split(v, 2, axis=1)
+    assert PagedKVCache.handoff_tokens(k) == 6
+    assert PagedKVCache.handoff_tokens(k_sl) == 6
+    # Every (cache sharding) x (payload form) combination lands the same
+    # bytes — a sharded handoff can feed an unsharded cache and back.
+    for shards in (1, 2, 4):
+        for payload in ((k, v), (k_sl, v_sl)):
+            cache = PagedKVCache(8, 4, 16, model_shards=shards)
+            assert cache.load("s", payload[0], payload[1])
+            gk, gv = cache.gather("s", 6)
+            np.testing.assert_array_equal(gk, k)
+            np.testing.assert_array_equal(gv, v)
+
+
+def test_cache_validates_model_shards():
+    with pytest.raises(ValueError, match="model_shards"):
+        PagedKVCache(8, 4, 16, model_shards=3)   # 3 does not divide 16
+    with pytest.raises(ValueError, match="model_shards"):
+        PagedKVCache(8, 4, 16, model_shards=0)
+
+
+# -- sharded handoff wire format ----------------------------------------------
+
+
+def test_sharded_handoff_roundtrip_and_bytes():
+    prompt = [9, 30, 2]
+    k, v, first = lm_prefill(PARAMS, prompt)
+    dense = pack_kv(prompt, k, v, first)
+    sharded = pack_kv_sharded(prompt, np.split(k, 4, axis=1),
+                              np.split(v, 4, axis=1), first)
+    assert is_sharded_payload(sharded) and not is_sharded_payload(dense)
+    # Same total wire bytes: sharding re-slices, it does not duplicate.
+    assert handoff_nbytes(sharded) == handoff_nbytes(dense)
+    tokens, ks, vs, f = unpack_kv_sharded(sharded)
+    assert tokens == prompt and f == first
+    np.testing.assert_array_equal(np.concatenate(ks, axis=1), k)
+    np.testing.assert_array_equal(np.concatenate(vs, axis=1), v)
+
+
+def test_sharded_handoff_validates_shapes():
+    k, v, first = lm_prefill(PARAMS, [1, 2, 3])
+    ks, vs = np.split(k, 2, axis=1), np.split(v, 2, axis=1)
+    with pytest.raises(ValueError, match="malformed"):
+        pack_kv_sharded([1, 2, 3], ks, vs[:1], first)       # count mismatch
+    with pytest.raises(ValueError, match="malformed"):
+        pack_kv_sharded([1, 2], ks, vs, first)              # token mismatch
+    bad = pack_kv_sharded([1, 2, 3], ks, vs, first)
+    bad["v_shards"] = [p[:-1] for p in bad["v_shards"]]     # truncated wire
+    with pytest.raises(ValueError, match="malformed"):
+        unpack_kv_sharded(bad)
+
+
+# -- end-to-end: sharded replica group is token-for-token exact ---------------
+
+
+def test_sharded_scheduler_token_for_token():
+    """The full sharded stack (ShardedLMParams + sharded KV pages) under
+    the iteration scheduler reproduces lm_generate exactly, per request,
+    under continuous batching."""
+    sp = shard_lm_params(PARAMS, 4)
+    cache = PagedKVCache(32, 4, 16, model_shards=4)
+    s = IterationScheduler(cache, sp, max_active=4)
+    prompts = {0: [3, 17, 5], 1: [9, 30, 2, 8], 2: [60], 3: [1, 2, 3]}
+    for sid, pr in prompts.items():
+        s.submit(Sequence(sid, pr, 12))
+    _run(s, until=len(prompts))
+    outs = _outputs(s)
+    for sid, pr in prompts.items():
+        assert outs[sid] == lm_generate(PARAMS, pr, 12), sid
+
+
+def test_sharded_preemption_churn_exact():
+    """KV pressure forces preempt/resume on the SHARDED cache; every
+    output still matches the unsharded oracle bitwise (resume re-prefills
+    through the sharded params and re-pages the sharded slices)."""
+    sp = shard_lm_params(PARAMS, 2)
+    cache = PagedKVCache(12, 2, 16, watermark=1 / 12, model_shards=2)
+    s = IterationScheduler(cache, sp, max_active=4, admission_window=8)
+    prompts = {i: [10 + i, 20 + i, 30 + i] for i in range(6)}
+    for sid, pr in prompts.items():
+        s.submit(Sequence(sid, pr, 8))
+    _run(s, until=len(prompts))
+    assert cache.alloc.preemptions_total > 0, \
+        "churn test did not actually churn"
+    outs = _outputs(s)
+    for sid, pr in prompts.items():
+        assert outs[sid] == lm_generate(PARAMS, pr, 8), sid
+
+
+def test_sharded_handoff_admission_matches_oracle():
+    """Disaggregated path: a sharded prefill payload admitted into a
+    sharded decode group decodes exactly like the colocated path and the
+    oracle."""
+    prompt, max_new = [9, 30, 2], 10
+    sp = shard_lm_params(PARAMS, 4)
+    k, v, first = lm_prefill(sp, prompt)   # prefill through sharded params
+    payload = pack_kv_sharded(prompt, np.split(np.asarray(k), 4, axis=1),
+                              np.split(np.asarray(v), 4, axis=1), first)
+    tokens, ks, vs, f = unpack_kv_sharded(payload)
+
+    via_handoff = IterationScheduler(
+        PagedKVCache(16, 4, 16, model_shards=4), sp)
+    via_handoff.submit(Sequence(0, tokens, max_new, first_token=f,
+                                handoff=(ks, vs)))
+    _run(via_handoff, until=1)
+    assert _outputs(via_handoff)[0] == lm_generate(PARAMS, prompt, max_new)
+
+
+# -- chip-budget gate ---------------------------------------------------------
+
+
+def test_chip_budget_gate_frames_oversized_model():
+    """A budget framed BETWEEN the sharded and unsharded per-chip
+    footprints: the 2-D (unsharded) replica provably cannot start, the
+    model_shards=2 group fits with the ISSUE 19 >= 1.8x headroom."""
+    full = LLMConfig.from_env(num_blocks=64, model_shards=1)
+    need_full = per_chip_persistent_nbytes(full, PARAMS)
+    sharded_cfg = LLMConfig.from_env(num_blocks=64, model_shards=2)
+    sp = shard_lm_params(PARAMS, 2)
+    need_sharded = per_chip_persistent_nbytes(sharded_cfg, sp)
+    assert need_full >= 1.8 * need_sharded   # uniform slices: exactly 2x
+    budget = (need_sharded + need_full) // 2
+    with pytest.raises(MemoryError, match="exceeds chip budget"):
+        check_chip_budget(
+            LLMConfig.from_env(num_blocks=64, chip_budget=budget), PARAMS)
+    got = check_chip_budget(
+        LLMConfig.from_env(num_blocks=64, model_shards=2,
+                           chip_budget=budget), sp)
+    assert got == need_sharded
+    # chip_budget=0 never gates (the default).
+    check_chip_budget(full, PARAMS)
+
+
+def test_per_chip_bytes_excludes_cache_for_prefill_role():
+    cfg = LLMConfig.from_env(model_shards=2)
+    sp = shard_lm_params(PARAMS, 2)
+    with_cache = per_chip_persistent_nbytes(cfg, sp, with_cache=True)
+    without = per_chip_persistent_nbytes(cfg, sp, with_cache=False)
+    assert without == sp.per_chip_nbytes()
+    assert with_cache - without == \
+        cfg.num_blocks * cfg.block_size * (16 // 2) * 4 * 2
+
+
+# -- config plumbing ----------------------------------------------------------
+
+
+def test_llmconfig_sharding_fields_roundtrip(monkeypatch):
+    cfg = LLMConfig.from_env(model_shards=2, chip_budget=123456)
+    env = cfg.to_env()
+    assert env["HOROVOD_SERVE_LLM_MODEL_SHARDS"] == "2"
+    assert env["HOROVOD_SERVE_LLM_CHIP_BUDGET_BYTES"] == "123456"
+    for key, val in env.items():
+        monkeypatch.setenv(key, val)
+    again = LLMConfig.from_env()
+    assert again.model_shards == 2 and again.chip_budget == 123456
+
+
+def test_llmconfig_validates_sharding():
+    with pytest.raises(ValueError, match="model_shards"):
+        LLMConfig.from_env(model_shards=0)
+    with pytest.raises(ValueError, match="divide dim"):
+        LLMConfig.from_env(model_shards=3)   # dim=16
+    with pytest.raises(ValueError, match="chip_budget"):
+        LLMConfig.from_env(chip_budget=-1)
+
+
+def test_sharded_params_type_is_dict_like_for_scheduler():
+    sp = shard_lm_params(PARAMS, 2)
+    assert isinstance(sp, ShardedLMParams)
+    # The two accesses the scheduler/decode code actually performs:
+    assert len(sp["pos"]) == PARAMS["max_context"]
+    assert int(sp["dim"]) == 16

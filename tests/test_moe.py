@@ -168,3 +168,18 @@ def test_moe_transformer_and_ep_specs(ep_mesh):
             got = jax.jit(lambda p: model.apply({"params": p}, tok))(sharded)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_moe_matches_dense_oracle_fast(ep_mesh):
+    """Fast-tier dense-oracle equivalence (ISSUE 19 promotion satellite):
+    the only oracle pin that runs outside -m slow. Tiny token count keeps
+    the double all_to_all compile cheap; generous capacity means nothing
+    drops, so EP must reproduce the dense per-token arithmetic exactly
+    (float tolerance)."""
+    params = init_moe_params(jax.random.PRNGKey(6), DIM, HIDDEN, EXPERTS, EP)
+    x = jax.random.normal(jax.random.PRNGKey(7), (4 * EP, DIM))
+    with jax.default_matmul_precision("highest"):
+        out = run_ep(ep_mesh, params, x, capacity=4 * EP)
+        ref = dense_oracle(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-4)

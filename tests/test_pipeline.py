@@ -246,3 +246,39 @@ def test_split_merge_lm_params_roundtrip():
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
         params, back)
+
+
+def test_pipeline_grads_match_sequential_fast():
+    """Fast-tier gradient-oracle pin (ISSUE 19 promotion satellite): the
+    backward pipeline == dense grads at the smallest non-trivial scale
+    (2 stages, 1 layer each) so the equivalence fails loudly outside
+    -m slow too."""
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("pp",))
+    ks = jax.random.split(jax.random.PRNGKey(9), 2)
+    params = [{"w": jax.random.normal(k, (4, 4)) * 0.3,
+               "b": jnp.zeros((4,))} for k in ks]
+    stacked = stack_stage_params(params)
+    micro = jax.random.normal(jax.random.PRNGKey(10), (2, 1, 4))
+    target = jnp.full((2, 1, 4), 0.1)
+
+    def pipe_loss(stage_params, micro):
+        out = pipeline_apply(layer_fn, stage_params, micro, "pp")
+        return masked_last_stage_loss(jnp.mean((out - target) ** 2), "pp")
+
+    def seq_loss(stacked_params, micro):
+        def body(h, p):
+            return layer_fn(p, h), None
+
+        outs = [jax.lax.scan(body, m, stacked_params)[0] for m in micro]
+        return jnp.mean((jnp.stack(outs) - target) ** 2)
+
+    with jax.default_matmul_precision("highest"):
+        g_pipe = jax.jit(shard_map(
+            jax.grad(pipe_loss), mesh=mesh,
+            in_specs=(P("pp"), P()), out_specs=P("pp"),
+            check_vma=False))(stacked, micro)
+        g_ref = jax.grad(seq_loss)(stacked, micro)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)

@@ -205,3 +205,20 @@ def test_ulysses_gqa_matches_oracle(sp_mesh, impl):
             sp_mesh, q, k, v)
     tol = 2e-2 if impl == "flash" else 2e-5
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=tol, rtol=tol)
+
+
+def test_ring_attention_matches_oracle_fast():
+    """Fast-tier dense-oracle pin (ISSUE 19 promotion satellite): the ring
+    schedule vs the causal reference at the smallest ring (2 devices,
+    short sequence) — the online-softmax rescale is pinned at float32
+    tolerance outside -m slow too."""
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("sp",))
+    q, k, v = qkv(b=1, t=16, h=2, d=8, seed=4)
+    with jax.default_matmul_precision("highest"):
+        ref = causal_reference(q, k, v)
+        out = shard_map(
+            lambda a, b, c: ring_attention(a, b, c, "sp"), mesh=mesh,
+            in_specs=P(None, "sp"), out_specs=P(None, "sp"),
+            check_vma=False)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

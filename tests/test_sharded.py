@@ -65,13 +65,25 @@ def grid_mesh(batch: int, shard: int) -> Mesh:
 
 
 def test_parse_mesh_spec():
-    assert parse_mesh_spec("", 8) == (8, 1)
-    assert parse_mesh_spec("4x2", 8) == (4, 2)
-    assert parse_mesh_spec("2X4", 8) == (2, 4)
-    assert parse_mesh_spec("4×2", 8) == (4, 2)     # unicode ×, the docs spelling
-    assert parse_mesh_spec("-1x2", 8) == (4, 2)
-    assert parse_mesh_spec("2x-1", 8) == (2, 4)
-    for bad in ("3x2", "4x2x1", "axb", "-1x-1", "0x8", "4x3"):
+    # 1-/2-axis back-compat: existing spellings resolve to model=1.
+    assert parse_mesh_spec("", 8) == (8, 1, 1)
+    assert parse_mesh_spec("8", 8) == (8, 1, 1)
+    assert parse_mesh_spec("4x2", 8) == (4, 2, 1)
+    assert parse_mesh_spec("2X4", 8) == (2, 4, 1)
+    assert parse_mesh_spec("4×2", 8) == (4, 2, 1)  # unicode ×, the docs spelling
+    assert parse_mesh_spec("-1x2", 8) == (4, 2, 1)
+    assert parse_mesh_spec("2x-1", 8) == (2, 4, 1)
+    # 3-axis specs (ISSUE 19), -1 legal in any one position.
+    assert parse_mesh_spec("4x2x1", 8) == (4, 2, 1)
+    assert parse_mesh_spec("2x2x2", 8) == (2, 2, 2)
+    assert parse_mesh_spec("2X2×2", 8) == (2, 2, 2)
+    assert parse_mesh_spec("-1x2x2", 8) == (2, 2, 2)
+    assert parse_mesh_spec("2x-1x2", 8) == (2, 2, 2)
+    assert parse_mesh_spec("4x1x-1", 8) == (4, 1, 2)
+    for bad in ("3x2", "axb", "-1x-1", "0x8", "4x3",
+                # malformed / oversubscribed 3-axis shapes
+                "2x2x3", "4x2x2", "0x2x4", "2x2x0", "axbxc",
+                "-1x-1x2", "2x-1x-1", "1x2x3x4", "16x1x1"):
         with pytest.raises(ValueError):
             parse_mesh_spec(bad, 8)
 
@@ -84,6 +96,27 @@ def test_sharded_mesh_from_env(monkeypatch):
     mesh = sharded_mesh()
     assert mesh.shape == {"batch": 8, "shard": 1}
     assert sharded_mesh(shard=2).shape == {"batch": 4, "shard": 2}
+
+
+def test_sharded_mesh_third_axis(monkeypatch):
+    """The mesh goes 3-D exactly when the model axis is NAMED: a 3-axis
+    env spec (even `...x1`) or an explicit model= argument — 2-axis
+    spellings keep the bit-identical 2-D mesh."""
+    monkeypatch.setenv("HOROVOD_MESH", "2x2x2")
+    assert sharded_mesh().shape == {"batch": 2, "shard": 2, "model": 2}
+    monkeypatch.setenv("HOROVOD_MESH", "4x2x1")
+    assert sharded_mesh().shape == {"batch": 4, "shard": 2, "model": 1}
+    monkeypatch.delenv("HOROVOD_MESH")
+    assert sharded_mesh(model=2).shape == \
+        {"batch": 4, "shard": 1, "model": 2}
+    assert sharded_mesh(batch=2, shard=2, model=2).shape == \
+        {"batch": 2, "shard": 2, "model": 2}
+    m = sharded_mesh(batch=2, shard=2, model=2)
+    assert m.axis_names == ("batch", "shard", "model")
+    with pytest.raises(ValueError):
+        sharded_mesh(batch=8, shard=1, model=2)   # oversubscribed
+    with pytest.raises(ValueError):
+        sharded_mesh(batch=4, shard=1)            # 4x1x1 != 8 devices
 
 
 # ---------------------------------------------------------------- shard plan

@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""CI smoke for multi-chip sharded serving replicas (ISSUE 19; ci.sh).
+
+Serves a model that PROVABLY does not fit one chip's budget: the
+per-chip byte ceiling (HOROVOD_SERVE_LLM_CHIP_BUDGET_BYTES) is framed
+strictly BETWEEN the sharded (model_shards=2) and unsharded per-chip
+persistent footprints, so the 2-D plane cannot even start — verified
+both in-process (the replica startup gate raises) and as a real spawned
+pool that never becomes ready — while the sharded mesh group serves it
+end to end:
+
+1.  oversized framing: full per-chip footprint > budget >= sharded
+    per-chip footprint, with the ISSUE 19 >= 1.8x reduction headline
+    (the gated metric);
+2.  oracle: generations through the sharded group — weights dim-sliced
+    per chip, KV pages stored as per-model-shard slices, sharded pages
+    crossing the authenticated handoff channel — are token-for-token
+    EXACTLY the unsharded sequential generation, at rest and under
+    mixed concurrent load (zero non-200, zero diverged);
+3.  chaos: SIGKILL the sharded decode replica mid-load — in-flight
+    sequences requeue through re-prefill, the pool respawns under the
+    same chip budget, and ZERO client requests fail or diverge.
+
+Prints one perf-gate JSON line (``tp_smoke_memory_reduction``) that
+ci.sh floors with ``tools/perf_gate.py --min-abs``. Replicas are
+numpy-only (no jax backend start): wall-clock budget ~30 s.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MAX_NEW = 16
+SHARDS = 2
+
+
+def fail(msg: str) -> None:
+    print(f"tp smoke FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def post(port: int, payload: dict, timeout: float = 60.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class LoadStats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.codes: dict[int, int] = {}
+        self.diverged: list = []
+        self.errors: list[str] = []
+        self.ok_times: list[float] = []
+        self.decode_tokens = 0
+
+
+def drive(port: int, stats: LoadStats, oracles: dict, clients: int,
+          seconds: float, vocab: int) -> float:
+    from horovod_tpu.serving.model import lm_generate, tiny_lm_params
+
+    params = tiny_lm_params()
+    stop_t = time.monotonic() + seconds
+
+    def loop(ci: int):
+        j = 0
+        while time.monotonic() < stop_t:
+            j += 1
+            n = 1 + (ci * 3 + j) % 10
+            prompt = tuple((ci * 13 + j + k) % vocab for k in range(n))
+            if prompt not in oracles:
+                oracles[prompt] = lm_generate(params, list(prompt),
+                                              MAX_NEW)
+            try:
+                code, body = post(port, {"prompt": list(prompt),
+                                         "max_tokens": MAX_NEW})
+                with stats.lock:
+                    stats.codes[code] = stats.codes.get(code, 0) + 1
+                    if code == 200:
+                        stats.ok_times.append(time.monotonic())
+                        stats.decode_tokens += max(body["n_tokens"] - 1, 0)
+                        if body["tokens"] != oracles[prompt]:
+                            stats.diverged.append((prompt, body["tokens"]))
+            except urllib.error.HTTPError as e:
+                with stats.lock:
+                    stats.codes[e.code] = stats.codes.get(e.code, 0) + 1
+                    if len(stats.errors) < 5:
+                        stats.errors.append(
+                            f"HTTP {e.code}: {e.read()[:200]!r}")
+            except OSError as e:
+                with stats.lock:
+                    stats.codes[-1] = stats.codes.get(-1, 0) + 1
+                    if len(stats.errors) < 5:
+                        stats.errors.append(repr(e))
+
+    threads = [threading.Thread(target=loop, args=(i,))
+               for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.monotonic() - t0
+
+
+def main() -> int:
+    from horovod_tpu.serving.config import LLMConfig, ServeConfig
+    from horovod_tpu.serving.llm import LLMServer
+    from horovod_tpu.serving.llm.replica import (
+        check_chip_budget,
+        per_chip_persistent_nbytes,
+    )
+    from horovod_tpu.serving.model import (
+        lm_generate,
+        shard_lm_params,
+        tiny_lm_params,
+    )
+
+    params = tiny_lm_params()
+
+    # -- 1. frame the chip budget between sharded and full ----------------
+    need_full = per_chip_persistent_nbytes(
+        LLMConfig.from_env(colocated=0), params)
+    need_sharded = per_chip_persistent_nbytes(
+        LLMConfig.from_env(colocated=0, model_shards=SHARDS),
+        shard_lm_params(params, SHARDS))
+    reduction = need_full / need_sharded
+    if reduction < 1.8:
+        fail(f"per-chip reduction {reduction:.3f}x < 1.8x at "
+             f"model_shards={SHARDS} — sharding is not actually slicing")
+    budget = (need_full + need_sharded) // 2
+    if not need_sharded <= budget < need_full:
+        fail(f"budget framing broken: sharded={need_sharded} "
+             f"budget={budget} full={need_full}")
+    # The unsharded replica's startup gate must refuse this model.
+    try:
+        check_chip_budget(
+            LLMConfig.from_env(colocated=0, chip_budget=budget), params)
+        fail("unsharded replica passed a budget it must exceed — the "
+             "oversized claim would be vacuous")
+    except MemoryError:
+        pass
+    print(f"tp smoke: framing OK — full {need_full} B > budget "
+          f"{budget} B >= sharded {need_sharded} B per chip "
+          f"({reduction:.2f}x reduction)")
+
+    # -- 2. the 2-D plane provably cannot run it (spawned proof) ----------
+    cfg = ServeConfig.from_env(port=0, slo_ms=60000.0, max_retries=4)
+    denied = LLMServer(config=cfg, llm_config=LLMConfig.from_env(
+        colocated=0, prefill_replicas=1, decode_replicas=1,
+        chip_budget=budget)).start()
+    try:
+        if denied.wait_ready(6):
+            fail("unsharded pool became ready under the oversized "
+                 "budget — the chip gate is not enforced at startup")
+    finally:
+        denied.stop()
+    print("tp smoke: unsharded pool refused to start under the budget OK")
+
+    # -- 3. sharded group serves it, oracle-exact -------------------------
+    llm_cfg = LLMConfig.from_env(colocated=0, prefill_replicas=1,
+                                 decode_replicas=1, model_shards=SHARDS,
+                                 chip_budget=budget)
+    server = LLMServer(config=cfg, llm_config=llm_cfg).start()
+    try:
+        if not server.wait_ready(60):
+            fail("sharded pools never became ready: "
+                 + str({r: p.describe()
+                        for r, p in server.pools.items()}))
+        for prompt in ([3, 17, 5], [42], [7, 7, 7, 7, 7, 7, 7, 7]):
+            code, body = post(server.port,
+                              {"prompt": prompt, "max_tokens": MAX_NEW})
+            if code != 200:
+                fail(f"warmup generate answered {code}: {body}")
+            expect = lm_generate(params, prompt, MAX_NEW)
+            if body["tokens"] != expect:
+                fail(f"sharded serve diverged at rest: {prompt} -> "
+                     f"{body['tokens']} != oracle {expect}")
+        print("tp smoke: oracle exactness at rest OK")
+
+        oracles: dict = {}
+        nominal = LoadStats()
+        wall = drive(server.port, nominal, oracles, clients=6,
+                     seconds=4.0, vocab=llm_cfg.vocab)
+        n200 = nominal.codes.get(200, 0)
+        if not n200:
+            fail(f"nominal load produced no 200s: {nominal.codes} "
+                 f"{nominal.errors}")
+        bad = {c: n for c, n in nominal.codes.items() if c != 200}
+        if bad:
+            fail(f"nominal load had non-200 responses {bad}; first "
+                 f"errors: {nominal.errors}")
+        if nominal.diverged:
+            fail(f"sharded serve diverged under load: "
+                 f"{nominal.diverged[:3]}")
+        tok_per_s = nominal.decode_tokens / wall
+        cs = server.stats()["metrics"]["counters"]
+        if cs.get("horovod_serve_llm_handoff_bytes_total", 0) <= 0:
+            fail("no handoff bytes counted — sharded pages never "
+                 "crossed the wire?")
+        print(f"tp smoke: load OK — {n200} x 200, decode "
+              f"{tok_per_s:.0f} tok/s, 0 diverged")
+
+        # -- 4. SIGKILL the sharded decode replica mid-load ---------------
+        chaos = LoadStats()
+        dec = server.pools["decode"]
+        victim = next(r for r in dec.describe()["replicas"].values()
+                      if r["state"] == "serving")
+        kill_state = {}
+
+        def killer():
+            time.sleep(0.8)
+            os.kill(victim["pid"], 9)
+            kill_state["t"] = time.monotonic()
+
+        threading.Thread(target=killer).start()
+        drive(server.port, chaos, oracles, clients=6, seconds=6.0,
+              vocab=llm_cfg.vocab)
+        if "t" not in kill_state:
+            fail("killer thread never fired")
+        bad = {c: n for c, n in chaos.codes.items() if c != 200}
+        if bad:
+            fail(f"decode kill lost client requests: {bad}; first "
+                 f"errors: {chaos.errors}")
+        if chaos.diverged:
+            fail(f"divergence across the kill: {chaos.diverged[:3]}")
+        if not any(t > kill_state["t"] for t in chaos.ok_times):
+            fail("no request completed after the kill")
+        deadline = time.monotonic() + 60
+        while dec.serving_count() < 1 and time.monotonic() < deadline:
+            time.sleep(0.2)
+        if dec.serving_count() < 1:
+            fail("sharded decode pool never respawned after the kill "
+                 "(budget gate rejecting the respawn?)")
+        if not dec.blacklist.blacklisted():
+            fail("killed decode replica id was not blacklisted")
+        n_chaos = chaos.codes.get(200, 0)
+        final_cs = server.stats()["metrics"]["counters"]
+        print(f"tp smoke: chaos OK — killed sharded decode pid "
+              f"{victim['pid']} mid-load, {n_chaos} x 200 / 0 failures / "
+              f"0 diverged, respawned under the same chip budget")
+
+        print(json.dumps({
+            "metric": "tp_smoke_memory_reduction",
+            "value": round(reduction, 3), "unit": "x",
+            "model_shards": SHARDS,
+            "chip_budget_bytes": int(budget),
+            "full_per_chip_bytes": int(need_full),
+            "sharded_per_chip_bytes": int(need_sharded),
+            "requests_ok": n200,
+            "decode_tokens_per_s": round(tok_per_s, 2),
+            "chaos_requests_ok": n_chaos,
+            "handoff_bytes": final_cs.get(
+                "horovod_serve_llm_handoff_bytes_total", 0),
+            "preemptions": final_cs.get(
+                "horovod_serve_llm_preemptions_total", 0),
+        }), flush=True)
+    finally:
+        server.stop()
+    print("tp smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
