@@ -1873,6 +1873,96 @@ def serve_llm_bench_main() -> None:
                 "mean_batch_occupancy": llm_stats["mean_batch_occupancy"],
                 "preemptions": llm_stats["preemptions_total"],
             })
+
+        def seq_window(srv, w, reqs=10):
+            """Sequential single-client window -> engine tok/busy-s.
+            One request in flight at a time keeps the decode loop
+            uncontended, so the busy-time ratio is clean (same method
+            as the llm_smoke spec A/B leg)."""
+            prev = srv.stats()["serving"]["llm"]
+            for j in range(reqs):
+                n = prompt_lens[j % len(prompt_lens)]
+                body = json.dumps({
+                    "prompt": [(w * 13 + j + k) % llm_cfg.vocab
+                               for k in range(n)],
+                    "max_tokens": max_new}).encode()
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/v1/generate", data=body,
+                    headers={"Content-Type": "application/json"}),
+                    timeout=30).read()
+            cur = srv.stats()["serving"]["llm"]
+            d_tok = cur["tokens_decode_total"] - prev["tokens_decode_total"]
+            d_busy = cur["decode_busy_s"] - prev["decode_busy_s"]
+            return d_tok / max(d_busy, 1e-9)
+
+        # ISSUE 20 optional arm: speculative A/B — paired interleaved
+        # windows, best per-pair engine-throughput ratio.
+        if budget.remaining() > 45:
+            budget.stage("spec-ab")
+            arms = {k: LLMServer(
+                config=ServeConfig.from_env(port=0, slo_ms=slo_ms),
+                llm_config=LLMConfig.from_env(colocated=1, draft_k=k)
+            ).start() for k in (0, 3)}
+            try:
+                if all(s.wait_ready(30) for s in arms.values()):
+                    pairs = []
+                    for w in range(3):
+                        b = seq_window(arms[0], w)
+                        s = seq_window(arms[3], w)
+                        if w:        # window 0 is warmup
+                            pairs.append((s / b, b, s))
+                    ratio, b_best, s_best = max(pairs)
+                    spec_llm = arms[3].stats()["serving"]["llm"]
+                    out["spec_ab"] = {
+                        "draft_k": 3, "speedup_x": round(ratio, 3),
+                        "baseline_tok_per_busy_s": round(b_best, 1),
+                        "spec_tok_per_busy_s": round(s_best, 1),
+                        "acceptance_rate":
+                            spec_llm["spec_acceptance_rate"]}
+            finally:
+                for s in arms.values():
+                    s.stop()
+
+        # ISSUE 20 optional arm: radix prefix replay through a small
+        # pool (same shape as the llm_smoke leg: 4 hot 2-block system
+        # prompts + 1 cold one squeezed by an 11-block pool).
+        if budget.remaining() > 30:
+            budget.stage("prefix-replay")
+            psrv = LLMServer(
+                config=ServeConfig.from_env(port=0, slo_ms=slo_ms),
+                llm_config=LLMConfig.from_env(
+                    colocated=1, prefix_cache=1, num_blocks=11,
+                    max_active=4)).start()
+            try:
+                if psrv.wait_ready(30):
+                    purl = f"http://127.0.0.1:{psrv.port}/v1/generate"
+
+                    def ppost(prompt):
+                        urllib.request.urlopen(urllib.request.Request(
+                            purl, data=json.dumps(
+                                {"prompt": prompt,
+                                 "max_tokens": 4}).encode(),
+                            headers={"Content-Type": "application/json"}),
+                            timeout=30).read()
+
+                    sysps = [[(s * 7 + i) % llm_cfg.vocab
+                              for i in range(32)] for s in range(4)]
+                    ppost([(5 * 7 + i) % llm_cfg.vocab
+                           for i in range(32)] + [9])
+                    for rnd in range(3):
+                        for s, sys_p in enumerate(sysps):
+                            for tail in range(3):
+                                ppost(sys_p
+                                      + [(rnd + 11 * tail + s) % 61 + 1])
+                    pl = psrv.stats()["serving"]["llm"]
+                    out["prefix_replay"] = {
+                        "hit_rate": pl["prefix_hit_rate"],
+                        "hit_tokens": pl["prefix_hit_tokens_total"],
+                        "lookup_tokens": pl["prefix_lookup_tokens_total"],
+                        "recovered_blocks": pl["recovered_blocks_total"],
+                        "cow_copies": pl["cow_copies_total"]}
+            finally:
+                psrv.stop()
     finally:
         server.stop()
     budget.emit(out)

@@ -127,12 +127,20 @@ python tools/perf_gate.py --current /tmp/hvd_serve_smoke.log \
   --require-metric serve_smoke_throughput_rps \
   --min-abs serve_smoke_throughput_rps=25 --allow-missing-baseline
 
-echo "== llm smoke (ISSUE 12 token-level serving: 1-prefill + 1-decode topology, every generation oracle-exact (zero cross-request contamination), mean decode-batch occupancy > 1 under mixed-length load, TTFT p99 under the smoke SLO, decode-replica SIGKILL recovers via re-prefill requeue with zero failed client requests) =="
-timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/llm_smoke.py | tee /tmp/hvd_llm_smoke.log
+echo "== llm smoke (ISSUE 12 token-level serving + ISSUE 20 decode path: 1-prefill + 1-decode topology, every generation oracle-exact (zero cross-request contamination), mean decode-batch occupancy > 1 under mixed-length load, TTFT p99 under the smoke SLO, decode-replica SIGKILL recovers via re-prefill requeue with zero failed client requests; ISSUE 20 legs: speculative A/B paired-window engine decode throughput >= 1.3x with acceptance >= 0.5, radix prefix replay hit rate >= 0.5 with >= 1 block recovered under pool pressure and every shared-prefix response oracle-exact, chunked streams reassemble to the exact non-streaming body with first chunk inside the TTFT SLO) =="
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/llm_smoke.py | tee /tmp/hvd_llm_smoke.log
 python tools/perf_gate.py --current /tmp/hvd_llm_smoke.log \
   --baseline BASELINE.json --history 'BENCH_r0*.json' \
   --require-metric llm_smoke_decode_tokens_per_s \
-  --min-abs llm_smoke_decode_tokens_per_s=150 --allow-missing-baseline
+  --require-metric llm_smoke_spec_acceptance \
+  --require-metric llm_smoke_spec_speedup_x \
+  --require-metric llm_smoke_prefix_hit_rate \
+  --require-metric llm_smoke_stream_tpot_headroom_x \
+  --min-abs llm_smoke_decode_tokens_per_s=150 \
+  --min-abs llm_smoke_spec_acceptance=0.5 \
+  --min-abs llm_smoke_spec_speedup_x=1.3 \
+  --min-abs llm_smoke_prefix_hit_rate=0.5 \
+  --min-abs llm_smoke_stream_tpot_headroom_x=1.0 --allow-missing-baseline
 
 echo "== obs smoke (ISSUE 15 observability: injected decode slowdown fires the ttft_slo anomaly + flight dump; SIGKILL'd decode replica's mmap flight ring survives; one-command bundle names the dead replica, merges a strict mixed-plane trace, and a /v1/generate request is followable admit->queue->prefill->handoff->decode->retire with TTFT decomposed by phase) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/obs_smoke.py

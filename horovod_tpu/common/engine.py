@@ -2450,21 +2450,18 @@ class _Coordinator:
         if not self.key:
             raise HorovodInternalError(
                 "coordinator requires a shared HOROVOD_SECRET key")
-        # Brief bind retry: an elastic re-rendezvous rebuilds the
-        # coordinator on the SAME address moments after the previous
-        # generation's server closed — lingering accepted sockets can hold
-        # the port for a beat (EADDRINUSE despite SO_REUSEADDR). A dead
-        # port stays dead past the window and still raises.
-        deadline = time.monotonic() + 15.0
-        while True:
-            try:
-                self.server = socket.create_server(
-                    (host, port), backlog=world + 4, reuse_port=False)
-                break
-            except OSError as e:
-                if e.errno != 98 or time.monotonic() >= deadline:  # EADDRINUSE
-                    raise
-                time.sleep(0.2)
+        # Brief bind retry (resilience.bind_with_retry): an elastic
+        # re-rendezvous rebuilds the coordinator on the SAME address
+        # moments after the previous generation's server closed —
+        # lingering accepted sockets can hold the port for a beat
+        # (EADDRINUSE despite SO_REUSEADDR). A dead port stays dead past
+        # the deadline and still raises.
+        from .resilience import bind_with_retry
+
+        self.server, _ = bind_with_retry(
+            lambda p: socket.create_server(
+                (host, p), backlog=world + 4, reuse_port=False),
+            port, deadline_s=15.0)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._lock = threading.Lock()

@@ -210,6 +210,40 @@ def send_all(sock: socket.socket, data) -> None:
             "link") from e
 
 
+def bind_with_retry(bind_fn, port: int, window: int = 1,
+                    deadline_s: float = 0.0, sleep_s: float = 0.2):
+    """EADDRINUSE-tolerant server bind — ONE implementation for every
+    listener the runtime opens (ISSUE 20 satellite; previously the
+    metrics exporter's port-window sweep and the coordinator's same-port
+    retry were two private copies, and test launchers had neither).
+
+    Tries ``bind_fn(port + offset)`` for each offset in ``window`` (a
+    sliding sweep — an elastic respawn lands where the previous
+    generation's exporter still holds ``port + local_rank``); when the
+    whole window is busy, sleeps ``sleep_s`` and re-sweeps until
+    ``deadline_s`` has elapsed (a re-rendezvous rebinds the SAME address
+    moments after the old server closed — lingering accepted sockets can
+    hold it for a beat despite SO_REUSEADDR). Any other OSError — and
+    EADDRINUSE past the window and deadline — raises. Returns
+    ``(bound_object, offset)`` so the caller can log a port slide."""
+    import errno
+
+    deadline = time.monotonic() + deadline_s
+    window = max(window, 1)
+    while True:
+        last: Optional[OSError] = None
+        for offset in range(window):
+            try:
+                return bind_fn(port + offset), offset
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE:
+                    raise
+                last = e
+        if time.monotonic() >= deadline:
+            raise last
+        time.sleep(sleep_s)
+
+
 def _reset_for_tests() -> None:
     """Drop cached policy/counters (unit tests flip env vars)."""
     global _default

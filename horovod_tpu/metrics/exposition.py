@@ -92,7 +92,7 @@ class MetricsServer:
 
     def __init__(self, port: int, reg: Optional[MetricsRegistry] = None,
                  host: Optional[str] = None, host_view=None) -> None:
-        import errno
+        from ..common.resilience import bind_with_retry
 
         reg = reg or registry()
         host = host or os.environ.get("HOROVOD_METRICS_HOST", "127.0.0.1")
@@ -102,14 +102,9 @@ class MetricsServer:
                         if host_view is not None else None})
         window = 1 if port == 0 else max(
             int(os.environ.get("HOROVOD_METRICS_PORT_WINDOW", "") or 16), 1)
-        for offset in range(window):
-            try:
-                self._httpd = ThreadingHTTPServer((host, port + offset),
-                                                  handler)
-                break
-            except OSError as e:
-                if e.errno != errno.EADDRINUSE or offset == window - 1:
-                    raise
+        self._httpd, _ = bind_with_retry(
+            lambda p: ThreadingHTTPServer((host, p), handler),
+            port, window=window)
         if port and self._httpd.server_address[1] != port:
             from ..utils.logging import log
 

@@ -156,6 +156,17 @@ class LLMConfig:
     dim: int = 16              # HOROVOD_SERVE_LLM_DIM
     max_context: int = 512     # HOROVOD_SERVE_LLM_MAX_CONTEXT
     seed: int = 0              # HOROVOD_SERVE_LLM_SEED
+    # -- decode-side critical path (ISSUE 20) ---------------------------------
+    draft_k: int = 0           # HOROVOD_SERVE_LLM_DRAFT_K: speculative
+    #                            decoding — draft tokens proposed per
+    #                            iteration for the target to verify
+    #                            (0 = off). Output is bitwise unchanged.
+    prefix_cache: int = 0      # HOROVOD_SERVE_LLM_PREFIX_CACHE: 1 = radix
+    #                            prefix sharing over KV blocks (repeated
+    #                            system prompts prefill once, COW guarded)
+    stream: int = 0            # HOROVOD_SERVE_LLM_STREAM: 1 = default
+    #                            /v1/generate responses to chunked JSONL
+    #                            streaming (per-request "stream" wins)
     # -- multi-chip mesh replicas (ISSUE 19) ----------------------------------
     model_shards: int = 1      # HOROVOD_SERVE_LLM_MODEL_SHARDS: chips per
     #                            replica group; every weight and KV page
@@ -186,6 +197,9 @@ class LLMConfig:
         "dim": "HOROVOD_SERVE_LLM_DIM",
         "max_context": "HOROVOD_SERVE_LLM_MAX_CONTEXT",
         "seed": "HOROVOD_SERVE_LLM_SEED",
+        "draft_k": "HOROVOD_SERVE_LLM_DRAFT_K",
+        "prefix_cache": "HOROVOD_SERVE_LLM_PREFIX_CACHE",
+        "stream": "HOROVOD_SERVE_LLM_STREAM",
         "model_shards": "HOROVOD_SERVE_LLM_MODEL_SHARDS",
         "chip_budget": "HOROVOD_SERVE_LLM_CHIP_BUDGET_BYTES",
     }
@@ -259,3 +273,7 @@ class LLMConfig:
             raise ValueError(
                 f"chip_budget must be >= 0 (0 = unenforced), got "
                 f"{self.chip_budget}")
+        if self.draft_k < 0:
+            raise ValueError(
+                f"draft_k must be >= 0 (0 = speculation off), got "
+                f"{self.draft_k}")

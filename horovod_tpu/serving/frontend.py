@@ -11,6 +11,15 @@ Reuses the metrics-exposition server pattern (metrics/exposition.py:
   401 unauthenticated, 429 shed (projected queue wait over the SLO, with
   a ``Retry-After``), 503 failed after retries / shutting down, 504
   deadline exceeded.
+- ``POST /v1/generate`` with ``"stream": true`` (or the
+  ``HOROVOD_SERVE_LLM_STREAM=1`` default) — chunked transfer encoding,
+  one JSONL object ``{"token": t, "i": n}`` per generated token flushed
+  as the decode pool reports it, terminated by the EXACT object the
+  non-streaming path would have returned (so reassembly is trivially
+  byte-equal and errors/timeouts surface in-band as its ``"error"``).
+  Clients see TTFT instead of total latency; the TTFT histogram itself
+  is engine-measured (submit -> first token) either way, so the
+  ``ttft_slo`` anomaly rule watches the same number.
 - ``GET /healthz`` — 200 once at least one replica is serving (readiness
   probe for load balancers and the smoke), 503 before.
 - ``GET /stats`` — ``{"serving": {...}, "metrics": <registry snapshot>}``
@@ -38,6 +47,9 @@ import numpy as np
 
 class _Handler(BaseHTTPRequestHandler):
     server_ref = None  # type: ignore[assignment]  # the InferenceServer
+    # Chunked transfer encoding (the streaming /v1/generate path) is an
+    # HTTP/1.1 feature; Content-Length replies keep working unchanged.
+    protocol_version = "HTTP/1.1"
 
     # -- helpers -------------------------------------------------------------
 
@@ -113,6 +125,11 @@ class _Handler(BaseHTTPRequestHandler):
             except (ValueError, TypeError) as e:
                 self._reply(400, {"error": f"malformed request: {e}"})
                 return
+            srv = self.server_ref
+            if getattr(srv, "stream_requested", None) and \
+                    srv.stream_requested(body):
+                self._stream_generate(body)
+                return
             status, obj, headers = fn(body)
             self._reply(status, obj, headers=headers)
             return
@@ -147,6 +164,55 @@ class _Handler(BaseHTTPRequestHandler):
             })
         else:
             self._reply(req.code, {"error": req.error})
+
+    def _stream_generate(self, body: dict) -> None:
+        """Chunked /v1/generate: flush one JSONL object per token as the
+        decode pool reports progress, then the exact non-streaming
+        response object as the final line. Admission rejections (400/429)
+        stay plain Content-Length replies — there is nothing to stream."""
+        srv = self.server_ref
+        t0 = time.monotonic()
+        status, obj, headers, req = srv.submit_generate_http(body)
+        if req is None:
+            self._reply(status, obj, headers=headers)
+            return
+        srv.count_stream()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonl")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(obj: dict) -> None:
+            data = (json.dumps(obj) + "\n").encode()
+            self.wfile.write(f"{len(data):X}\r\n".encode()
+                             + data + b"\r\n")
+            self.wfile.flush()
+
+        sent, done = 0, False
+        deadline = (req.deadline_t or t0) + 0.05
+        try:
+            while not done and time.monotonic() < deadline:
+                toks, done = req.wait_tokens(
+                    sent, timeout=min(0.25, deadline - time.monotonic()))
+                for t in toks[sent:]:
+                    chunk({"token": int(t), "i": sent})
+                    sent += 1
+            # completion may outrun the last poll's streamed prefix: the
+            # remaining tokens still flush as per-token lines before the
+            # terminal object
+            for t in (req.tokens or [])[sent:]:
+                chunk({"token": int(t), "i": sent})
+                sent += 1
+            status, obj = srv.finish_generate_http(req, t0)
+            chunk(obj)
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client gave up mid-stream; the request resolves anyway
+        # chunked framing has an explicit terminator, but the handler
+        # cannot know whether the client saw it if the pipe broke — drop
+        # the connection rather than risk a desynced keep-alive reuse
+        self.close_connection = True
 
     def log_message(self, *args):  # silence per-request stderr spam
         pass

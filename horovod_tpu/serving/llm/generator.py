@@ -38,7 +38,7 @@ class GenRequest:
     __slots__ = ("rid", "prompt", "max_new_tokens", "enqueue_t",
                  "deadline_t", "retries", "event", "code", "tokens",
                  "error", "ttft_s", "done_t", "_lock", "tid",
-                 "prefilled_t")
+                 "prefilled_t", "partial", "_cond")
 
     def __init__(self, prompt, max_new_tokens: int,
                  deadline_t: Optional[float] = None) -> None:
@@ -55,6 +55,8 @@ class GenRequest:
         self.ttft_s: Optional[float] = None   # set once, first-writer wins
         self.done_t = 0.0
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.partial: list[int] = []   # streamed prefix (poll-fed, monotone)
         self.tid = f"req:gen:{self.rid}"   # serving trace ID (tracing/serve)
         self.prefilled_t = 0.0             # handoff-span start (router clock)
 
@@ -78,6 +80,7 @@ class GenRequest:
             if self.ttft_s is None:
                 self.ttft_s = self.done_t - self.enqueue_t
             self.event.set()
+            self._cond.notify_all()
             return True
 
     def fail(self, code: int, error: str) -> bool:
@@ -87,7 +90,39 @@ class GenRequest:
             self.code, self.error = code, error
             self.done_t = time.monotonic()
             self.event.set()
+            self._cond.notify_all()
             return True
+
+    def push_tokens(self, tokens) -> bool:
+        """Streaming feed (poll-driven): extend the visible token prefix.
+        Monotone — an update that does not strictly extend the current
+        prefix is dropped, which is what makes a post-retry replay (the
+        respawned replica re-decodes the same deterministic tokens from
+        the start) invisible to a streaming reader. Ignored once the
+        request reached a terminal state."""
+        with self._lock:
+            if self.event.is_set():
+                return False
+            toks = [int(t) for t in tokens]
+            if len(toks) <= len(self.partial) or \
+                    toks[:len(self.partial)] != self.partial:
+                return False
+            self.partial = toks
+            self._cond.notify_all()
+            return True
+
+    def wait_tokens(self, seen: int, timeout: float) -> tuple:
+        """Block until more than ``seen`` tokens are visible or the
+        request is terminal; returns ``(token_prefix, done)``. The
+        streaming frontend loops on this to flush chunks."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while len(self.partial) <= seen and not self.event.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            return list(self.partial), self.event.is_set()
 
     def tpot_s(self) -> Optional[float]:
         """Time-per-output-token over the decode phase (excludes TTFT);
@@ -257,7 +292,10 @@ class DecodeEngine:
             self._collect_locked()
             finished = list(self._finished.values())
             self._finished.clear()
-            progress = {s.seq_id: len(s.out) for s in self._sched.running}
+            # Token LISTS, not counts: the router pushes them into each
+            # GenRequest's streaming prefix (frontend chunked flush) and
+            # still derives first-token progress from the length.
+            progress = {s.seq_id: list(s.out) for s in self._sched.running}
             stats = self._sched.stats()
             sequences = self._sched.sequences()
         return {"finished": finished, "progress": progress, "stats": stats,

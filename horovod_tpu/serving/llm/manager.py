@@ -273,16 +273,20 @@ class PoolManager(ReplicaManager):
             mine = self._inflight.get(rep.rid, {})
             finished = [(rec, mine.pop(rec["rid"], None))
                         for rec in resp.get("finished", [])]
-            progressing = [mine.get(rid)
-                           for rid, n in resp.get("progress", {}).items()
-                           if n >= 1]
+            # progress values are token LISTS (generator.poll): length >= 1
+            # is the TTFT observation, the tokens themselves feed each
+            # request's streaming prefix for the chunked frontend flush.
+            progressing = [(mine.get(rid), toks)
+                           for rid, toks in resp.get("progress", {}).items()
+                           if len(toks) >= 1]
         for rec, req in finished:
             self.server.on_finished(req, rec)
             if req is not None:
                 rep.requests_done += 1
-        for req in progressing:
+        for req, toks in progressing:
             if req is not None:
                 req.mark_first_token()
+                req.push_tokens(toks)
         self.server.mirror_stats(rep.rid, resp.get("stats", {}), dt_s)
         self.server.mirror_sequences(rep.rid, resp.get("sequences", []))
         stats = resp.get("stats", {})

@@ -242,11 +242,22 @@ def main() -> int:
         cache = PagedKVCache(llm_cfg.num_blocks, llm_cfg.block_size,
                              int(params["dim"]),
                              watermark=llm_cfg.watermark,
-                             model_shards=llm_cfg.model_shards)
+                             model_shards=llm_cfg.model_shards,
+                             prefix_cache=bool(llm_cfg.prefix_cache))
+        draft = None
+        if llm_cfg.draft_k > 0:
+            # Derived from the (seeded) target params, so every decode
+            # replica — including a respawn after SIGKILL — drafts
+            # identically; the verify loop keeps outputs bitwise the
+            # target's either way.
+            from ..model import draft_lm_params
+
+            draft = draft_lm_params(params)
         engine = DecodeEngine(IterationScheduler(
             cache, params, max_active=llm_cfg.max_active,
             admission_window=llm_cfg.admission_window,
-            tracer=tracer)).start()
+            tracer=tracer, draft_params=draft,
+            draft_k=llm_cfg.draft_k)).start()
         # Stall watchdog on the decode loop (ISSUE 15 satellite): a
         # replica whose iterations stop progressing for
         # HOROVOD_STALL_CHECK_TIME names the stuck sequence ids and trips

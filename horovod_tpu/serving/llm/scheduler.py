@@ -48,7 +48,7 @@ from typing import Optional
 import numpy as np
 
 from ...tracing.serve import serve_trace_id
-from ..model import lm_context_step, lm_prefill
+from ..model import lm_draft_chain, lm_prefill_from, lm_verify_chain
 from .kv_cache import PagedKVCache, blocks_for
 
 WAITING = "waiting"
@@ -99,13 +99,24 @@ class Sequence:
 class IterationScheduler:
     def __init__(self, cache: PagedKVCache, params: dict,
                  max_active: int = 8, admission_window: int = 64,
-                 tracer=None) -> None:
+                 tracer=None, draft_params: Optional[dict] = None,
+                 draft_k: int = 0) -> None:
         if max_active < 1:
             raise ValueError(f"max_active must be >= 1, got {max_active}")
+        if draft_k < 0:
+            raise ValueError(f"draft_k must be >= 0, got {draft_k}")
         self.cache = cache
         self.params = params
         self.max_active = max_active
         self.admission_window = admission_window
+        # Speculative decoding (ISSUE 20; Leviathan et al. 2211.17192):
+        # the draft proposes up to draft_k tokens per iteration which the
+        # target verifies greedily — bitwise the sequential output. The
+        # draft is the embedding path of the float16-rounded target
+        # (model.lm_draft_chain): stateless, so it keeps NO K/V, touches
+        # no paged blocks, and costs nothing to preempt or resume.
+        self.draft_params = draft_params if draft_k > 0 else None
+        self.draft_k = draft_k if draft_params is not None else 0
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
         self.finished: list[Sequence] = []
@@ -126,6 +137,14 @@ class IterationScheduler:
         self.blocks_freed_total = 0   # by RETIREMENT (feeds the release
         #                               EWMA behind KV admission; preempt
         #                               churn deliberately excluded)
+        self.spec_proposed_total = 0  # draft tokens offered for verify
+        self.spec_accepted_total = 0  # draft tokens the target confirmed
+        self.decode_busy_ns = 0       # wall time inside decode phases
+        #                               that emitted >= 1 token: the
+        #                               denominator of ENGINE decode
+        #                               throughput (client tok/s is
+        #                               protocol-bound; the speculative
+        #                               A/B gate needs the engine number)
 
     # -- intake ---------------------------------------------------------------
 
@@ -152,15 +171,21 @@ class IterationScheduler:
     # -- the engine iteration -------------------------------------------------
 
     def step(self) -> int:
-        """One iteration: admit -> decode one token per running sequence
-        -> retire. Returns the number of tokens decoded (0 = idle)."""
+        """One iteration: admit -> decode (one token per running sequence,
+        plus any draft tokens the target verified) -> retire. Returns the
+        number of tokens decoded (0 = idle)."""
         self._admit_phase()
         t0 = time.monotonic_ns() if self.tracer else 0
         members = [s.seq_id for s in self.running] if self.tracer else ()
-        decoded = self._decode_phase()
+        t_dec = time.monotonic_ns()
+        decoded, n_seqs = self._decode_phase()
         if decoded:
+            self.decode_busy_ns += time.monotonic_ns() - t_dec
             self.iterations_total += 1
-            self.occupancy_sum += decoded
+            # occupancy counts SEQUENCES per iteration (the Orca batch
+            # size), not tokens — speculative acceptance must not inflate
+            # mean_batch_occupancy.
+            self.occupancy_sum += n_seqs
             self.last_progress_t = time.monotonic()
             if self.tracer:
                 # ONE span per iteration, member sequence ids in args —
@@ -218,10 +243,12 @@ class IterationScheduler:
             # (multi-chip handoff) — the cache normalizes either; a bare
             # np.asarray here would mis-stack a slice list into 3-D.
             k_arr, v_arr = seq.handoff
-            if not self.cache.load(seq.seq_id, k_arr, v_arr):
+            if not self.cache.load(seq.seq_id, k_arr, v_arr,
+                                   tokens=seq.prompt):
                 return False
             seq.handoff = None
             seq.kv_len = self.cache.handoff_tokens(k_arr)
+            self.cache.register_prefix(seq.seq_id, seq.prompt)
             return True
         # Local prefill: context is everything but the newest token (the
         # newest token is fed as the next decode step). For a fresh
@@ -229,24 +256,72 @@ class IterationScheduler:
         # prompt + out[:-1] — deterministic, so the resume is bitwise
         # identical to never having been preempted.
         ctx = seq.tokens[:-1] if seq.out else seq.prompt
-        if self.cache.alloc.alloc(seq.seq_id, len(ctx)) is None:
+        shared = self.cache.admit_prefix(seq.seq_id, ctx)
+        if shared is None:
             return False
-        k_arr, v_arr, nxt = lm_prefill(self.params, ctx)
-        for pos in range(len(ctx)):
-            self.cache.write(seq.seq_id, pos, k_arr[pos], v_arr[pos])
+        # Prefill only the positions the radix trie did not already hold;
+        # on a FULL hit recompute just the final position's step for its
+        # next-token logits and skip the (bitwise redundant) write — the
+        # cached row must stay shared, not COW-split.
+        start = min(shared, len(ctx) - 1)
+        k_pre, v_pre = self.cache.gather(seq.seq_id, start)
+        k_new, v_new, nxt = lm_prefill_from(self.params, ctx, k_pre, v_pre)
+        for pos in range(start, len(ctx)):
+            if pos >= shared:
+                self.cache.write(seq.seq_id, pos,
+                                 k_new[pos - start], v_new[pos - start])
         seq.kv_len = len(ctx)
-        self.tokens_prefill_total += len(ctx)
+        self.tokens_prefill_total += len(ctx) - start
+        self.cache.register_prefix(seq.seq_id, seq.prompt)
         if not seq.out:
             seq.out.append(nxt)
             if seq.first_token_rel_s is None:
                 seq.first_token_rel_s = time.monotonic() - seq.submit_t
         return True
 
-    def _decode_phase(self) -> int:
-        decoded = 0
+    def _decode_phase(self) -> tuple:
+        decoded = n_seqs = 0
         for seq in list(self.running):
             if seq.state is not RUNNING:
                 continue   # preempted mid-iteration by a neighbor's growth
+            emitted = self._decode_seq(seq)
+            decoded += emitted
+            n_seqs += 1 if emitted else 0
+        return decoded, n_seqs
+
+    def _decode_seq(self, seq: Sequence) -> int:
+        """Decode for ONE sequence this iteration: the target always
+        computes at least one token; with a draft attached, up to
+        ``draft_k`` proposals are verified first-mismatch-wins, so a full
+        acceptance emits ``draft_k + 1`` tokens (the bonus token falls
+        out of the last verify step's own logits). Greedy argmax means
+        every emitted token equals the sequential oracle's, whatever the
+        draft proposed — mismatches only cost the speculation."""
+        proposals = self._propose(seq) if self.draft_params else []
+        emitted = 0
+        pos0 = seq.kv_len
+        # ONE block-table gather per iteration, sized for the whole
+        # verify chain. The snapshot stays bitwise equal to a re-gather
+        # (context rows are append-only and the chain's rows land in
+        # both the buffer and the cache), so verifying k+1 tokens pays
+        # the O(context) materialization once instead of once per token
+        # — this is where speculation's net decode-throughput win
+        # physically comes from.
+        buf_k = np.empty((pos0 + len(proposals) + 1, self.params["dim"]),
+                         np.float32)
+        buf_v = np.empty_like(buf_k)
+        if pos0:
+            k0, v0 = self.cache.gather(seq.seq_id, pos0)
+            buf_k[:pos0] = k0
+            buf_v[:pos0] = v0
+        chain = lm_verify_chain(self.params, seq.tokens[-1], proposals,
+                                pos0, buf_k, buf_v, seq.eos_id)
+        # Commit phase: every chain token's K/V row is for a token the
+        # target COMMITTED (the fed chain is feed + its own outputs), so
+        # scatter each row as its block lands — stopping cleanly if
+        # memory pressure preempts this very sequence mid-chain
+        # (accepted tokens are kept; the resume re-prefills them).
+        for nxt in chain:
             pos = seq.kv_len
             while not self.cache.alloc.extend(seq.seq_id, pos + 1):
                 victim = self._preempt_victim()
@@ -254,20 +329,36 @@ class IterationScheduler:
                 if victim is seq:
                     break
             if seq.state is not RUNNING:
-                continue
-            k_ctx, v_ctx = self.cache.gather(seq.seq_id, pos)
-            nxt, k_vec, v_vec = lm_context_step(
-                self.params, seq.tokens[-1], pos, k_ctx, v_ctx)
-            self.cache.write(seq.seq_id, pos, k_vec, v_vec)
+                break
+            self.cache.write(seq.seq_id, pos, buf_k[pos], buf_v[pos])
             seq.kv_len = pos + 1
             seq.out.append(nxt)
-            decoded += 1
+            emitted += 1
             self.tokens_decode_total += 1
             if seq.first_token_rel_s is None:
                 seq.first_token_rel_s = time.monotonic() - seq.submit_t
             if seq.is_done():
                 self._retire(seq)
-        return decoded
+                break
+        if proposals:
+            self.spec_proposed_total += len(proposals)
+            self.spec_accepted_total += max(emitted - 1, 0)
+        return emitted
+
+    def _propose(self, seq: Sequence) -> list:
+        """Run the stateless draft ahead of the target: up to
+        ``draft_k`` greedy embedding-path proposals starting from the
+        sequence's newest token. Capped so a full acceptance plus bonus
+        token never overshoots ``max_new_tokens`` or the position
+        table."""
+        dp = self.draft_params
+        m_cap = min(self.draft_k,
+                    seq.max_new_tokens - len(seq.out) - 1,
+                    len(dp["pos"]) - 1 - seq.kv_len)
+        if m_cap <= 0:
+            return []
+        return lm_draft_chain(dp, seq.tokens[-1], seq.kv_len, m_cap,
+                              seq.eos_id)
 
     # -- transitions ----------------------------------------------------------
 
@@ -342,4 +433,8 @@ class IterationScheduler:
             "occupancy_sum": self.occupancy_sum,
             "finished_total": self.finished_total,
             "blocks_freed_total": self.blocks_freed_total,
+            "spec_proposed_total": self.spec_proposed_total,
+            "spec_accepted_total": self.spec_accepted_total,
+            "decode_busy_s": round(self.decode_busy_ns / 1e9, 6),
+            **self.cache.prefix_stats(),
         }

@@ -122,6 +122,33 @@ class LLMServer:
         self._handoff_local_c = self.reg.counter(
             "horovod_serve_llm_handoffs_total",
             help="prefill->decode sequence handoffs", path="local")
+        self._spec_proposed_c = self.reg.counter(
+            "horovod_serve_llm_spec_tokens_total",
+            help="speculative-decoding draft tokens by verify outcome",
+            kind="proposed")
+        self._spec_accepted_c = self.reg.counter(
+            "horovod_serve_llm_spec_tokens_total",
+            help="speculative-decoding draft tokens by verify outcome",
+            kind="accepted")
+        self._prefix_hit_c = self.reg.counter(
+            "horovod_serve_llm_prefix_tokens_total",
+            help="radix prefix-cache admission tokens by lookup outcome",
+            kind="hit")
+        self._prefix_lookup_c = self.reg.counter(
+            "horovod_serve_llm_prefix_tokens_total",
+            help="radix prefix-cache admission tokens by lookup outcome",
+            kind="lookup")
+        self._recovered_c = self.reg.counter(
+            "horovod_serve_llm_kv_blocks_recovered_total",
+            help="trie-retained KV blocks evicted back to the free list "
+                 "under allocation pressure")
+        self._cow_c = self.reg.counter(
+            "horovod_serve_llm_cow_copies_total",
+            help="KV blocks copy-on-write-split before a write into a "
+                 "shared block")
+        self._streams_c = self.reg.counter(
+            "horovod_serve_llm_streams_total",
+            help="generate requests served as chunked streaming responses")
         self._ttft_h = self.reg.histogram(
             "horovod_serve_llm_ttft_seconds",
             help="time to first token (submit -> first generated token)")
@@ -269,9 +296,12 @@ class LLMServer:
             free = self.llm.num_blocks * n_dec
         return free, queued
 
-    def handle_generate_http(self, body: dict):
-        """(status, payload, headers) for POST /v1/generate — the hook
-        frontend._Handler dispatches to."""
+    def submit_generate_http(self, body: dict):
+        """Parse + admit one POST /v1/generate body. Returns ``(status,
+        error_payload, headers, req)`` — ``req`` is None exactly when the
+        request already terminated (400/429) and the error triple is the
+        response; otherwise the caller waits on ``req`` (blocking or
+        streaming) and finishes with :meth:`finish_generate_http`."""
         try:
             prompt = body["prompt"]
             if not isinstance(prompt, (list, tuple)):
@@ -286,20 +316,25 @@ class LLMServer:
                 if deadline_ms <= 0:
                     raise ValueError("deadline_ms must be > 0")
         except (KeyError, TypeError, ValueError) as e:
-            return 400, {"error": f"malformed request: {e}"}, None
-        t0 = time.monotonic()
+            return 400, {"error": f"malformed request: {e}"}, None, None
         req, wait = self.submit_generate(prompt, max_new, deadline_ms)
         if req.code == 429:
             return 429, {"error": req.error}, \
-                {"Retry-After": f"{max(wait, 0.001):.3f}"}
+                {"Retry-After": f"{max(wait, 0.001):.3f}"}, None
         if req.code == 400:
-            return 400, {"error": req.error}, None
-        budget = (req.deadline_t or t0) - t0
-        if not req.event.wait(timeout=budget + 0.05):
+            return 400, {"error": req.error}, None, None
+        return 0, None, None, req
+
+    def finish_generate_http(self, req: GenRequest, t0: float):
+        """(status, payload) once ``req.event`` is set (or its deadline
+        passed): the terminal /v1/generate response body. The streaming
+        path sends exactly this object as its final chunk, which is what
+        makes chunk reassembly == the non-streaming body."""
+        if not req.event.is_set():
             if req.fail(504, "deadline exceeded awaiting generation"):
                 self.count_code(504)
         if req.code != 200:
-            return req.code, {"error": req.error}, None
+            return req.code, {"error": req.error}
         tpot = req.tpot_s()
         return 200, {
             "tokens": req.tokens,
@@ -307,7 +342,30 @@ class LLMServer:
             "ttft_ms": round((req.ttft_s or 0.0) * 1e3, 3),
             "tpot_ms": round(tpot * 1e3, 3) if tpot is not None else None,
             "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
-        }, None
+        }
+
+    def handle_generate_http(self, body: dict):
+        """(status, payload, headers) for POST /v1/generate — the hook
+        frontend._Handler dispatches to (non-streaming path)."""
+        t0 = time.monotonic()
+        status, payload, headers, req = self.submit_generate_http(body)
+        if req is None:
+            return status, payload, headers
+        budget = (req.deadline_t or t0) - t0
+        req.event.wait(timeout=budget + 0.05)
+        status, payload = self.finish_generate_http(req, t0)
+        return status, payload, None
+
+    def stream_requested(self, body: dict) -> bool:
+        """Per-request ``"stream"`` wins; HOROVOD_SERVE_LLM_STREAM sets
+        the default."""
+        flag = body.get("stream") if isinstance(body, dict) else None
+        if flag is None:
+            return bool(self.llm.stream)
+        return bool(flag)
+
+    def count_stream(self) -> None:
+        self._streams_c.inc()
 
     # -- pool-worker hooks ---------------------------------------------------
 
@@ -390,7 +448,14 @@ class LLMServer:
                              "blocks_free", "iterations_total",
                              "occupancy_sum")}
         for counter, key in ((self._preempt_c, "preemptions_total"),
-                             (self._tok_decode_c, "tokens_decode_total")):
+                             (self._tok_decode_c, "tokens_decode_total"),
+                             (self._spec_proposed_c, "spec_proposed_total"),
+                             (self._spec_accepted_c, "spec_accepted_total"),
+                             (self._prefix_hit_c, "prefix_hit_tokens_total"),
+                             (self._prefix_lookup_c,
+                              "prefix_lookup_tokens_total"),
+                             (self._recovered_c, "recovered_blocks_total"),
+                             (self._cow_c, "cow_copies_total")):
             delta = stats.get(key, 0) - last.get(key, 0)
             if delta > 0:
                 counter.inc(delta)
@@ -463,7 +528,12 @@ class LLMServer:
                    for k in ("active", "waiting", "blocks_used",
                              "blocks_free", "iterations_total",
                              "occupancy_sum", "preemptions_total",
-                             "tokens_decode_total", "finished_total")}
+                             "tokens_decode_total", "finished_total",
+                             "spec_proposed_total", "spec_accepted_total",
+                             "prefix_hit_tokens_total",
+                             "prefix_lookup_tokens_total",
+                             "recovered_blocks_total", "cow_copies_total",
+                             "decode_busy_s")}
         return {
             "serving": {
                 "uptime_s": round(time.time() - (self._started_t or
@@ -476,6 +546,20 @@ class LLMServer:
                     "mean_batch_occupancy": round(
                         agg["occupancy_sum"]
                         / max(agg["iterations_total"], 1), 3),
+                    "spec_acceptance_rate": round(
+                        agg["spec_accepted_total"]
+                        / max(agg["spec_proposed_total"], 1), 4),
+                    # engine decode throughput: tokens per second of
+                    # decode-phase wall time, summed across replicas —
+                    # the denominator client-side tok/s can't see (HTTP
+                    # + polling dominate it); the speculative A/B smoke
+                    # arm gates on THIS number's ratio.
+                    "decode_tokens_per_busy_s": round(
+                        agg["tokens_decode_total"]
+                        / max(agg["decode_busy_s"], 1e-9), 1),
+                    "prefix_hit_rate": round(
+                        agg["prefix_hit_tokens_total"]
+                        / max(agg["prefix_lookup_tokens_total"], 1), 4),
                     "ttft_p50_ms": round(ttft.get("p50", 0.0) * 1e3, 3),
                     "ttft_p99_ms": round(ttft.get("p99", 0.0) * 1e3, 3),
                     "tpot_p50_ms": round(tpot.get("p50", 0.0) * 1e3, 3),

@@ -144,8 +144,11 @@ def tiny_lm_params(vocab: int = 64, dim: int = 16, max_context: int = 512,
 
 
 def _lm_softmax(x: np.ndarray) -> np.ndarray:
-    e = np.exp(x - np.max(x))
-    return e / np.sum(e)
+    # ndarray-method reductions, not np.max/np.sum: same ufunc.reduce
+    # kernel (bitwise-identical result) minus the module-level dispatch
+    # overhead — this runs once per decoded token on the serving path.
+    e = np.exp(x - x.max())
+    return e / e.sum()
 
 
 def lm_context_step(params: dict, token: int, pos: int,
@@ -172,6 +175,126 @@ def lm_context_step(params: dict, token: int, pos: int,
     return int(np.argmax(logits)), k, v
 
 
+_GEMM_ROWS_EXACT: dict = {}
+
+
+def _gemm_rows_exact(dim: int) -> bool:
+    """Probe (once per dim per process) whether this BLAS produces
+    bitwise-identical rows for a batched ``[m, dim] @ [dim, dim]``
+    matmul and the per-row matvec. True on every mainstream x86/ARM
+    OpenBLAS/MKL build at TinyLM sizes (small inner dimension, same
+    sequential accumulation order), but the batched verify forward must
+    DEGRADE to per-row projections rather than silently break the
+    oracle contract anywhere it does not hold."""
+    ok = _GEMM_ROWS_EXACT.get(dim)
+    if ok is None:
+        rs = np.random.RandomState(7)
+        hm = rs.uniform(-1, 1, (5, dim)).astype(np.float32)
+        wm = rs.uniform(-1, 1, (dim, dim)).astype(np.float32)
+        batched = hm @ wm
+        ok = all(np.array_equal(batched[i], hm[i] @ wm) for i in range(5))
+        _GEMM_ROWS_EXACT[dim] = ok
+    return ok
+
+
+def lm_verify_chain(params: dict, feed: int, proposals, pos0: int,
+                    buf_k: np.ndarray, buf_v: np.ndarray,
+                    eos_id: int = -1) -> list:
+    """The target side of speculative decoding (Leviathan et al.,
+    arXiv:2211.17192) as ONE chained call: feed ``feed`` at ``pos0``,
+    then walk the draft's ``proposals`` first-mismatch-wins — each step
+    checks the draft's guess against the target argmax; on a mismatch
+    the target's own token is already the correct emission, so only the
+    remaining guesses are discarded. Returns the emitted tokens (between
+    1 and ``len(proposals) + 1`` of them) and fills ``buf_k``/``buf_v``
+    rows ``pos0 .. pos0+len(out)-1`` in place.
+
+    ``buf_k``/``buf_v`` must hold the gathered context in rows
+    ``[:pos0]`` with capacity ``pos0 + len(proposals) + 1``. Two
+    amortizations make this the paper's "one batched forward": the fed
+    chain is known up front (teacher forcing — ``feed`` plus the
+    proposals), so all K/V/Q projections run as ONE matmul batch
+    (guarded by :func:`_gemm_rows_exact`); and each step attends over
+    ``buf[:pos+1]`` views instead of re-materializing O(context) arrays
+    per token. Both are bitwise :func:`lm_context_step` on the same
+    values, so speculation inherits the oracle contract; with an empty
+    proposal list this is exactly one plain decode step."""
+    last = pos0 + len(proposals)
+    if last >= len(params["pos"]):
+        raise ValueError(f"position {last} exceeds max_context "
+                         f"{len(params['pos'])}")
+    embed, posv, wo = params["embed"], params["pos"], params["wo"]
+    dim = buf_k.shape[1]
+    feeds = [feed] + list(proposals)
+    if _gemm_rows_exact(dim):
+        hs = embed[feeds] + posv[pos0:last + 1]
+        kb = hs @ params["wk"]
+        vb = hs @ params["wv"]
+        qb = hs @ params["wq"]
+    else:
+        hs = np.empty((len(feeds), dim), np.float32)
+        kb = np.empty_like(hs)
+        vb = np.empty_like(hs)
+        qb = np.empty_like(hs)
+        for j, t in enumerate(feeds):
+            h = embed[t] + posv[pos0 + j]
+            hs[j] = h
+            kb[j] = h @ params["wk"]
+            vb[j] = h @ params["wv"]
+            qb[j] = h @ params["wq"]
+    scale = np.sqrt(dim).astype(np.float32)
+    out = []
+    pos = pos0
+    for j in range(len(feeds)):
+        # row j was fed feeds[j], which is committed iff every earlier
+        # proposal matched — the loop only reaches j in that case, so
+        # rows written to the buffer always belong to the real chain.
+        buf_k[pos] = kb[j]
+        buf_v[pos] = vb[j]
+        ks = buf_k[:pos + 1]
+        vs = buf_v[:pos + 1]
+        att = _lm_softmax((ks @ qb[j]) / scale) @ vs
+        nxt = int(((hs[j] + att) @ wo).argmax())
+        out.append(nxt)
+        pos += 1
+        if nxt == eos_id or j >= len(proposals) or proposals[j] != nxt:
+            break
+    return out
+
+
+def lm_draft_chain(params: dict, feed: int, pos0: int,
+                   steps: int, eos_id: int = -1) -> list:
+    """The draft side of speculative decoding: up to ``steps`` greedy
+    self-fed proposals from the EMBEDDING PATH alone —
+    ``argmax((embed[tok] + pos[p]) @ wo)`` — no attention, no K/V, no
+    state. This is the "small draft" of Leviathan et al.: the target's
+    (float16-rounded) token and position tables already rank the
+    likeliest continuation well enough for a useful acceptance rate,
+    and skipping attention makes a proposal ~6x cheaper than a target
+    step — the asymmetry speculation needs to pay for itself (a draft
+    as expensive as the target can never win: it burns k draft steps
+    to save at most k of k+1 target steps' overhead). The verify loop
+    guarantees OUTPUT correctness regardless of what is proposed; the
+    draft's only job is guessing the target's argmax, so it needs no
+    bitwise contract and no KV scratch to rebuild on preemption.
+    Stops early at ``eos_id`` — nothing meaningful to propose past the
+    end of a sequence. Returns the proposed tokens."""
+    if pos0 + steps - 1 >= len(params["pos"]):
+        raise ValueError(f"position {pos0 + steps - 1} exceeds "
+                         f"max_context {len(params['pos'])}")
+    embed, posv, wo = params["embed"], params["pos"], params["wo"]
+    out = []
+    tok, pos = feed, pos0
+    for _ in range(steps):
+        nxt = int(((embed[tok] + posv[pos]) @ wo).argmax())
+        out.append(nxt)
+        pos += 1
+        if nxt == eos_id:
+            break
+        tok = nxt
+    return out
+
+
 def lm_prefill(params: dict, tokens) -> tuple:
     """Run the prompt through the model sequentially: returns
     ``(K, V, next_token)`` with K/V of shape ``[len(tokens), dim]`` —
@@ -189,6 +312,54 @@ def lm_prefill(params: dict, tokens) -> tuple:
         nxt, ks[i], vs[i] = lm_context_step(params, int(t), i,
                                             ks[:i], vs[:i])
     return ks, vs, nxt
+
+
+def lm_prefill_from(params: dict, tokens, k_prefix, v_prefix) -> tuple:
+    """Prefill resuming from cached K/V rows (radix prefix hit,
+    kv_cache.RadixPrefixCache): positions ``0..len(k_prefix)-1`` are
+    already materialized, so only positions ``len(k_prefix)..n-1`` run
+    through the model. Returns ``(K_new, V_new, next_token)`` with K/V
+    covering just the NEW positions. With an empty prefix this is
+    bitwise :func:`lm_prefill`; with any prefix the result is bitwise
+    identical too, because a position's K/V depends only on (token,
+    position) and the attention gather sees the same values either way."""
+    n = len(tokens)
+    start = len(k_prefix)
+    if not (0 <= start < n):
+        raise ValueError(f"prefix covers {start} of {n} prompt positions "
+                         f"(need at least one position to compute)")
+    dim = params["dim"]
+    ks = np.zeros((n, dim), np.float32)
+    vs = np.zeros((n, dim), np.float32)
+    ks[:start] = np.asarray(k_prefix, np.float32).reshape(start, dim)
+    vs[:start] = np.asarray(v_prefix, np.float32).reshape(start, dim)
+    nxt = -1
+    for i in range(start, n):
+        nxt, ks[i], vs[i] = lm_context_step(params, int(tokens[i]), i,
+                                            ks[:i], vs[:i])
+    return ks[start:], vs[start:], nxt
+
+
+def draft_lm_params(params) -> dict:
+    """The DRAFT model for speculative decoding (scheduler.py verify
+    loop; Leviathan et al., arXiv:2211.17192): the target's weights
+    rounded through float16 and back. Deterministic in every process (a
+    pure function of the target params, which are themselves seeded), so
+    prefill/decode replicas and kill->respawn recovery agree bitwise; the
+    ~1e-3 relative perturbation leaves almost every greedy argmax
+    unchanged (TinyLM's top-2 logit gaps are orders of magnitude larger),
+    which is what buys the high acceptance rate — while the verify loop
+    guarantees the OUTPUT is the target's regardless. Materializes
+    ``ShardedLMParams`` transparently (drafting runs on the scheduler,
+    which already holds the gathered view)."""
+    out = {}
+    for key in params.keys():
+        v = params[key]
+        if isinstance(v, np.ndarray):
+            out[key] = v.astype(np.float16).astype(np.float32)
+        else:
+            out[key] = v
+    return out
 
 
 def lm_generate(params: dict, prompt, max_new_tokens: int,

@@ -3,6 +3,16 @@
 One implementation of the free-port / shared-secret / HOROVOD_* env / Popen
 world spawner (previously copied per test file — protocol env changes now
 land in exactly one place).
+
+``free_port()`` is inherently TOCTOU: the probe socket closes before the
+coordinator binds, so a parallel test (or anything else on the host) can
+steal the port in between. The coordinator itself now rides
+``resilience.bind_with_retry`` (same-port re-sweep for ~15 s), which
+absorbs the common case of a *lingering* socket from a previous world; when
+the port is genuinely taken by another live server, ``launch_world``
+detects the EADDRINUSE rank failure and relaunches the whole world on a
+fresh port (the known test_protocol flake — passed in isolation, collided
+under a full parallel run).
 """
 
 from __future__ import annotations
@@ -13,8 +23,13 @@ import secrets
 import socket
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Signatures of a rank that died because the coordinator (or any listener it
+# opens) lost the free_port() race. Matched against stderr of failed ranks.
+_EADDRINUSE_MARKS = ("Address already in use", "EADDRINUSE", "Errno 98")
 
 
 def free_port() -> int:
@@ -25,13 +40,8 @@ def free_port() -> int:
     return port
 
 
-def launch_world(world: int, script: str, extra_env=None, per_rank_env=None,
-                 timeout: float = 180, check: bool = True) -> list[dict]:
-    """Spawn ``world`` ranks running ``script`` with a shared secret and
-    coordinator address. Returns per-rank dicts:
-    ``{"rc": int, "out": <last stdout line parsed as JSON> | None,
-    "stderr": str}``. With ``check`` (default) a non-zero rank fails the
-    test immediately."""
+def _launch_once(world: int, script: str, extra_env, per_rank_env,
+                 timeout: float) -> list[dict]:
     port = free_port()
     secret = secrets.token_hex(16)
     procs = []
@@ -54,20 +64,18 @@ def launch_world(world: int, script: str, extra_env=None, per_rank_env=None,
     try:
         for p in procs:
             stdout, stderr = p.communicate(timeout=timeout)
-            if check:
-                assert p.returncode == 0, f"rank failed:\n{stderr[-3000:]}"
             out = stdout.strip().splitlines()
-            parsed = None
+            parsed, parse_err = None, None
             if out:
                 try:
                     parsed = json.loads(out[-1])
-                except ValueError:
-                    if check:
-                        raise
+                except ValueError as e:
+                    parse_err = e
             results.append({
                 "rc": p.returncode,
                 "out": parsed,
                 "stderr": stderr,
+                "_parse_err": parse_err,
             })
     finally:
         # One hung or failed rank must not leak the others into the rest of
@@ -76,4 +84,36 @@ def launch_world(world: int, script: str, extra_env=None, per_rank_env=None,
             if p.poll() is None:
                 p.kill()
                 p.communicate()
+    return results
+
+
+def launch_world(world: int, script: str, extra_env=None, per_rank_env=None,
+                 timeout: float = 180, check: bool = True,
+                 bind_attempts: int = 3) -> list[dict]:
+    """Spawn ``world`` ranks running ``script`` with a shared secret and
+    coordinator address. Returns per-rank dicts:
+    ``{"rc": int, "out": <last stdout line parsed as JSON> | None,
+    "stderr": str}``. With ``check`` (default) a non-zero rank fails the
+    test immediately — unless the failure is a port-bind collision
+    (EADDRINUSE in stderr), in which case the whole world is relaunched on
+    a fresh port, up to ``bind_attempts`` times total."""
+    attempts = max(bind_attempts, 1)
+    results: list[dict] = []
+    for attempt in range(attempts):
+        results = _launch_once(world, script, extra_env, per_rank_env,
+                               timeout)
+        collided = any(
+            r["rc"] != 0 and any(m in r["stderr"]
+                                 for m in _EADDRINUSE_MARKS)
+            for r in results)
+        if not collided or attempt == attempts - 1:
+            break
+        time.sleep(0.2)
+    if check:
+        for r in results:
+            assert r["rc"] == 0, f"rank failed:\n{r['stderr'][-3000:]}"
+            if r["_parse_err"] is not None:
+                raise r["_parse_err"]
+    for r in results:
+        r.pop("_parse_err", None)
     return results

@@ -195,3 +195,302 @@ def test_retired_blocks_reused_without_stale_reads():
     assert cache.load("b", b_k, b_k)
     gk, _ = cache.gather("b", 3)
     np.testing.assert_array_equal(gk, b_k)   # nothing of "a" leaks in
+
+
+# -- prefix sharing / copy-on-write (ISSUE 20) --------------------------------
+
+
+def test_admit_with_shared_blocks_refcounts_and_free():
+    a = BlockAllocator(8, 4, watermark=0.0)
+    t1 = a.alloc("s1", 8)                    # 2 private blocks
+    a.retain(t1[0])                          # trie pins the first
+    t2 = a.admit("s2", 8, shared=(t1[0],))   # shares it + 1 fresh
+    assert t2[0] == t1[0] and a.refs(t1[0]) == 3
+    a.check_invariants()
+    # owner retires: the shared block stays allocated (trie + s2 hold it)
+    assert a.free("s1") == 1                 # only the private one freed
+    assert a.refs(t1[0]) == 2
+    assert a.free("s2") == 1
+    assert a.refs(t1[0]) == 1                # trie retention remains
+    assert a.release(t1[0])                  # now it frees
+    a.check_invariants()
+    assert a.free_count == a.num_blocks
+
+
+def test_shared_admission_counts_only_fresh_against_watermark():
+    a = BlockAllocator(4, 2, watermark=0.5)  # reserve = 2, usable = 2
+    t = a.alloc("s1", 4)                     # both usable blocks
+    a.retain(t[0])
+    a.retain(t[1])
+    assert a.alloc("s2", 2) is None          # no fresh block available
+    # a FULLY shared admission needs zero fresh blocks -> admits
+    t2 = a.admit("s2", 4, shared=tuple(t))
+    assert t2 == t
+    a.check_invariants()
+
+
+def test_admit_refusal_references_nothing():
+    a = BlockAllocator(4, 2, watermark=0.0)
+    t = a.alloc("s1", 8)
+    a.retain(t[0])
+    before = a.refs(t[0])
+    assert a.admit("s2", 12, shared=(t[0],)) is None   # 5 fresh > 0 free
+    assert a.refs(t[0]) == before            # refusal left no refs behind
+    a.check_invariants()
+
+
+def test_cow_unshared_block_raises_and_shared_block_swaps():
+    a = BlockAllocator(8, 2, watermark=0.0)
+    t1 = a.alloc("s1", 4)
+    with pytest.raises(ValueError, match="unshared"):
+        a.cow("s1", 0)
+    a.retain(t1[0])
+    new = a.cow("s1", 0)
+    assert new is not None and new != t1[0]
+    assert a.table("s1")[0] == new
+    assert a.refs(t1[0]) == 1 and a.refs(new) == 1
+    a.check_invariants()
+
+
+def test_retain_release_guardrails():
+    a = BlockAllocator(4, 2)
+    t = a.alloc("s", 2)
+    with pytest.raises(ValueError, match="free block"):
+        a.retain(3)                          # never allocated
+    with pytest.raises(ValueError, match="unretained"):
+        a.release(t[0])                      # table ref but no retention
+
+
+def test_property_shared_ops_refcount_model_replay(seed=0xBEEF, ops=2500):
+    """The COW/refcount property bar: random admit-with-shared / extend /
+    free / preempt / retain / release / cow interleavings against a pure
+    reference model of per-block refcounts — never a leak, never a
+    double-free, invariants after every op."""
+    rng = np.random.RandomState(seed)
+    a = BlockAllocator(num_blocks=24, block_size=2, watermark=0.1)
+    tables: dict = {}          # sid -> list of blocks (model mirror)
+    retained: dict = {}        # block -> retention count (model mirror)
+    next_id = 0
+
+    def model_refs(b):
+        return retained.get(b, 0) + sum(t.count(b) for t in tables.values())
+
+    for _ in range(ops):
+        op = rng.randint(6)
+        if op == 0:                                       # admit w/ sharing
+            n_tok = int(rng.randint(1, 16))
+            shareable = [b for b in set().union(*tables.values(), set())
+                         if model_refs(b)] if tables else []
+            rng.shuffle(shareable)
+            n_blocks = blocks_for(n_tok, 2)
+            shared = shareable[:int(rng.randint(0, n_blocks + 1))]
+            got = a.admit(next_id, n_tok, tuple(shared))
+            if got is not None:
+                assert got[:len(shared)] == list(shared)
+                tables[next_id] = list(got)
+                next_id += 1
+        elif op == 1 and tables:                          # extend
+            sid = int(rng.choice(list(tables)))
+            n_tok = (len(tables[sid]) + int(rng.randint(0, 3))) * 2
+            if a.extend(sid, n_tok):
+                tables[sid] = a.table(sid)
+        elif op == 2 and tables:                          # free / preempt
+            sid = int(rng.choice(list(tables)))
+            expect = sum(1 for b in set(tables[sid])
+                         for _ in [0]
+                         if model_refs(b) == tables[sid].count(b))
+            freed = (a.preempt if rng.randint(2) else a.free)(sid)
+            assert freed == expect
+            del tables[sid]
+        elif op == 3 and tables:                          # retain
+            sid = int(rng.choice(list(tables)))
+            b = int(rng.choice(tables[sid]))
+            a.retain(b)
+            retained[b] = retained.get(b, 0) + 1
+        elif op == 4 and retained:                        # release
+            b = int(rng.choice(list(retained)))
+            a.release(b)
+            retained[b] -= 1
+            if not retained[b]:
+                del retained[b]
+        elif op == 5 and tables:                          # cow
+            sid = int(rng.choice(list(tables)))
+            idx = int(rng.randint(len(tables[sid])))
+            b = tables[sid][idx]
+            if model_refs(b) >= 2:
+                new = a.cow(sid, idx)
+                if new is not None:
+                    tables[sid][idx] = new
+        a.check_invariants()
+        for sid, t in tables.items():
+            assert a.table(sid) == t
+    for sid in list(tables):
+        a.free(sid)
+        del tables[sid]
+    for b in list(retained):
+        for _ in range(retained.pop(b)):
+            a.release(b)
+    a.check_invariants()
+    assert a.free_count == a.num_blocks
+
+
+def test_radix_lookup_register_and_partial_match():
+    from horovod_tpu.serving.llm.kv_cache import RadixPrefixCache
+
+    a = BlockAllocator(8, 2, watermark=0.0)
+    trie = RadixPrefixCache(a)
+    t = a.alloc("s1", 6)                     # 3 blocks for [1,2,3,4,5,6]
+    assert trie.register([1, 2, 3, 4, 5, 6], t) == 3
+    assert len(trie) == 3
+    # full-block hits, MRU-touched
+    blocks, partial = trie.lookup([1, 2, 3, 4, 9, 9])
+    assert blocks == t[:2] and partial is None
+    # partial tail: [1,2] full + one row of the [3,4] block
+    blocks, partial = trie.lookup([1, 2, 3, 7])
+    assert blocks == t[:1] and partial == (t[1], 1)
+    # re-registering the same tokens adds nothing (LRU refresh only)
+    assert trie.register([1, 2, 3, 4], t) == 0
+    assert trie.hit_tokens_total > 0 and trie.lookup_tokens_total > 0
+
+
+def test_radix_evict_releases_lru_leaves_only():
+    from horovod_tpu.serving.llm.kv_cache import RadixPrefixCache
+
+    a = BlockAllocator(8, 2, watermark=0.0)
+    trie = RadixPrefixCache(a)
+    t1 = a.alloc("s1", 4)
+    trie.register([1, 2, 3, 4], t1)
+    a.free("s1")                             # trie-only retention now
+    # the [1,2] interior node is NOT evictable while its child lives;
+    # evict(1) must take the leaf [3,4] first
+    assert trie.evict(1) == 1
+    assert a.refs(t1[1]) == 0 and a.refs(t1[0]) == 1
+    assert trie.evict(5) == 1                # then the (now leaf) root child
+    assert a.free_count == a.num_blocks
+    assert trie.recovered_blocks_total == 2
+    assert len(trie) == 0
+    a.check_invariants()
+
+
+def test_reclaimer_hook_evicts_under_admission_pressure():
+    cache = PagedKVCache(num_blocks=4, block_size=2, dim=3, watermark=0.0,
+                         prefix_cache=True)
+    rng = np.random.RandomState(5)
+    k = rng.randn(8, 3).astype(np.float32)
+    assert cache.load("a", k, k, tokens=[1, 2, 3, 4, 5, 6, 7, 8])
+    cache.register_prefix("a", [1, 2, 3, 4, 5, 6, 7, 8])
+    cache.alloc.free("a")                    # all 4 blocks trie-retained
+    assert cache.alloc.free_count == 0
+    # a cold admission must evict LRU prefixes instead of refusing
+    assert cache.alloc.alloc("b", 6) is not None
+    assert cache.prefix.recovered_blocks_total >= 3
+    cache.alloc.check_invariants()
+
+
+def test_paged_cow_isolates_sibling_reads_bitwise():
+    """Two sequences share prefix blocks; one diverges and writes — the
+    sibling's gather must stay bitwise the original (the COW safety
+    net), and the write lands in a private copy."""
+    rng = np.random.RandomState(7)
+    cache = PagedKVCache(num_blocks=8, block_size=2, dim=3, watermark=0.0,
+                         prefix_cache=True)
+    tokens = [1, 2, 3, 4]
+    k = rng.randn(4, 3).astype(np.float32)
+    v = rng.randn(4, 3).astype(np.float32)
+    assert cache.load("a", k, v, tokens=tokens)
+    cache.register_prefix("a", tokens)
+    shared = cache.admit_prefix("b", tokens)
+    assert shared == 4                       # both blocks by reference
+    assert cache.alloc.table("b") == cache.alloc.table("a")
+    # "b" overwrites a SHARED position: must COW, not corrupt "a"
+    cache.write("b", 3, np.ones(3, np.float32), np.ones(3, np.float32))
+    assert cache.cow_copies_total == 1
+    assert cache.alloc.table("b")[1] != cache.alloc.table("a")[1]
+    ka, va = cache.gather("a", 4)
+    np.testing.assert_array_equal(ka, k)
+    np.testing.assert_array_equal(va, v)
+    kb, _ = cache.gather("b", 4)
+    np.testing.assert_array_equal(kb[:3], k[:3])   # copied rows preserved
+    np.testing.assert_array_equal(kb[3], np.ones(3, np.float32))
+    cache.alloc.check_invariants()
+
+
+def test_admit_prefix_partial_tail_copies_rows_at_admission():
+    rng = np.random.RandomState(9)
+    cache = PagedKVCache(num_blocks=8, block_size=4, dim=3, watermark=0.0,
+                         prefix_cache=True)
+    tokens = [1, 2, 3, 4, 5, 6]
+    k = rng.randn(6, 3).astype(np.float32)
+    assert cache.load("a", k, k, tokens=tokens)
+    cache.register_prefix("a", tokens)       # registers block [1,2,3,4]
+    # [1,2,3,9]: 3 rows of the registered block match -> copied, not shared
+    shared = cache.admit_prefix("b", [1, 2, 3, 9, 9])
+    assert shared == 3
+    assert cache.alloc.table("b")[0] != cache.alloc.table("a")[0]
+    kb, _ = cache.gather("b", 3)
+    np.testing.assert_array_equal(kb, k[:3])
+    # writing the divergent tail needs no COW (the block is private)
+    cache.write("b", 3, np.ones(3, np.float32), np.ones(3, np.float32))
+    assert cache.cow_copies_total == 0
+    cache.alloc.check_invariants()
+
+
+def test_prefix_sharing_with_model_shards_bitwise():
+    """Sharing lives in the block table, so a model-sharded cache shares
+    and COWs identically — gathers reassemble bitwise."""
+    rng = np.random.RandomState(13)
+    for shards in (1, 2):
+        cache = PagedKVCache(num_blocks=8, block_size=2, dim=4,
+                             watermark=0.0, model_shards=shards,
+                             prefix_cache=True)
+        tokens = [5, 6, 7, 8]
+        k = rng.randn(4, 4).astype(np.float32)
+        v = rng.randn(4, 4).astype(np.float32)
+        assert cache.load("a", k, v, tokens=tokens)
+        cache.register_prefix("a", tokens)
+        assert cache.admit_prefix("b", tokens) == 4
+        # "b" diverges at position 2: rewrites its suffix (append-only,
+        # like the scheduler) — the first rewrite COWs, the second lands
+        # in the now-private block
+        for pos in (2, 3):
+            cache.write("b", pos, np.full(4, float(pos), np.float32),
+                        np.full(4, float(pos), np.float32))
+        assert cache.cow_copies_total == 1
+        ka, _ = cache.gather("a", 4)
+        np.testing.assert_array_equal(ka, k)
+        kb, _ = cache.gather("b", 4)
+        np.testing.assert_array_equal(kb[:2], k[:2])
+        np.testing.assert_array_equal(kb[2], np.full(4, 2.0, np.float32))
+        np.testing.assert_array_equal(kb[3], np.full(4, 3.0, np.float32))
+        ks, _ = cache.gather_sharded("b", 4)
+        np.testing.assert_array_equal(np.concatenate(ks, axis=-1), kb)
+        cache.alloc.check_invariants()
+
+
+def test_load_with_tokens_skips_shared_scatter_but_stays_exact():
+    rng = np.random.RandomState(17)
+    cache = PagedKVCache(num_blocks=8, block_size=2, dim=3, watermark=0.0,
+                         prefix_cache=True)
+    tokens = [1, 2, 3, 4]
+    k = rng.randn(4, 3).astype(np.float32)
+    v = rng.randn(4, 3).astype(np.float32)
+    assert cache.load("a", k, v, tokens=tokens)
+    cache.register_prefix("a", tokens)
+    hits_before = cache.prefix.hit_tokens_total
+    assert cache.load("b", k, v, tokens=tokens)   # full prefix hit
+    assert cache.prefix.hit_tokens_total - hits_before == 4
+    kb, vb = cache.gather("b", 4)
+    np.testing.assert_array_equal(kb, k)
+    np.testing.assert_array_equal(vb, v)
+    assert cache.cow_copies_total == 0            # nothing re-scattered
+    cache.alloc.check_invariants()
+
+
+def test_prefix_stats_shape():
+    on = PagedKVCache(4, 2, 3, prefix_cache=True).prefix_stats()
+    off = PagedKVCache(4, 2, 3).prefix_stats()
+    for d in (on, off):
+        assert set(d) == {"prefix_hit_tokens_total",
+                          "prefix_lookup_tokens_total",
+                          "recovered_blocks_total", "cow_copies_total"}
+        assert all(val == 0 for val in d.values())

@@ -388,3 +388,100 @@ def test_generate_route_absent_on_stateless_server(tmp_path):
         assert b"LLM" in ei.value.read()
     finally:
         fe.stop()
+
+# -- streaming (ISSUE 20) -----------------------------------------------------
+
+
+def _stream_post(port, payload, timeout=60.0):
+    """Raw chunked read of a streaming /v1/generate: returns
+    ``(status, transfer_encoding, [(arrival_t, parsed_line), ...])``."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/generate",
+                     body=json.dumps(payload).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        lines = []
+        while True:
+            raw = resp.readline()
+            if not raw:
+                break
+            lines.append((time.monotonic(), json.loads(raw)))
+        return resp.status, resp.getheader("Transfer-Encoding"), lines
+    finally:
+        conn.close()
+
+
+def test_streaming_reassembly_equals_non_streaming_body():
+    """The streaming bar: per-token JSONL chunks reassemble to EXACTLY
+    the non-streaming response — same tokens, same final object shape —
+    and the final line is oracle-exact."""
+    cfg = ServeConfig.from_env(port=0, slo_ms=60000.0)
+    llm_cfg = LLMConfig.from_env(colocated=1, decode_replicas=1)
+    server = LLMServer(config=cfg, llm_config=llm_cfg).start()
+    try:
+        assert server.wait_ready(60)
+        prompt, max_new = [3, 17, 5], 24
+        st, plain = _post(server.port, {"prompt": prompt,
+                                        "max_tokens": max_new})
+        assert st == 200
+        status, te, lines = _stream_post(
+            server.port, {"prompt": prompt, "max_tokens": max_new,
+                          "stream": True})
+        assert status == 200 and te == "chunked"
+        token_lines, final = [obj for _, obj in lines[:-1]], lines[-1][1]
+        # the terminal object IS the non-streaming body (timings differ)
+        assert set(final) == set(plain)
+        assert final["tokens"] == plain["tokens"] == lm_generate(
+            PARAMS, prompt, max_new)
+        assert final["n_tokens"] == max_new
+        # per-token chunks: contiguous indices, reassembling to the body
+        assert [ln["i"] for ln in token_lines] == list(range(max_new))
+        assert [ln["token"] for ln in token_lines] == final["tokens"]
+        cs = server.stats()["metrics"]["counters"]
+        assert cs.get("horovod_serve_llm_streams_total", 0) >= 1
+    finally:
+        server.stop()
+
+
+def test_streaming_default_env_and_per_request_override():
+    """HOROVOD_SERVE_LLM_STREAM=1 makes streaming the default; a body
+    ``"stream": false`` still gets a plain Content-Length reply."""
+    cfg = ServeConfig.from_env(port=0, slo_ms=60000.0)
+    llm_cfg = LLMConfig.from_env(colocated=1, decode_replicas=1, stream=1)
+    server = LLMServer(config=cfg, llm_config=llm_cfg).start()
+    try:
+        assert server.wait_ready(60)
+        status, te, lines = _stream_post(
+            server.port, {"prompt": [9, 2], "max_tokens": 8})
+        assert status == 200 and te == "chunked"
+        assert lines[-1][1]["tokens"] == lm_generate(PARAMS, [9, 2], 8)
+        st, body = _post(server.port, {"prompt": [9, 2], "max_tokens": 8,
+                                       "stream": False})
+        assert st == 200
+        assert body["tokens"] == lm_generate(PARAMS, [9, 2], 8)
+    finally:
+        server.stop()
+
+
+def test_streaming_errors_stay_reachable():
+    """Admission rejections answer plain 400 (nothing to stream); a
+    deadline that expires mid-stream surfaces in-band as the terminal
+    object's ``"error"`` — the client never hangs on a dead stream."""
+    cfg = ServeConfig.from_env(port=0, slo_ms=60000.0)
+    llm_cfg = LLMConfig.from_env(colocated=1, decode_replicas=1)
+    server = LLMServer(config=cfg, llm_config=llm_cfg).start()
+    try:
+        assert server.wait_ready(60)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server.port, {"prompt": [], "stream": True})
+        assert ei.value.code == 400
+        status, _, lines = _stream_post(
+            server.port, {"prompt": [3, 1], "max_tokens": 16,
+                          "stream": True, "deadline_ms": 1})
+        assert status == 200                 # already committed to chunked
+        assert "error" in lines[-1][1]
+    finally:
+        server.stop()

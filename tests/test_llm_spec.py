@@ -1,0 +1,291 @@
+"""Speculative decoding (ISSUE 20 tentpole): the draft/verify loop must
+reproduce the sequential ``lm_generate`` oracle BITWISE — under batching,
+preemption churn, EOS, handoff admission, and even a deliberately wrong
+draft — while the acceptance counters prove the speculation actually
+paid (net tokens per target iteration > 1 with the f16 draft)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from horovod_tpu.serving.llm.kv_cache import PagedKVCache
+from horovod_tpu.serving.llm.scheduler import IterationScheduler, Sequence
+from horovod_tpu.serving.model import (
+    draft_lm_params,
+    lm_context_step,
+    lm_draft_chain,
+    lm_generate,
+    lm_prefill,
+    lm_prefill_from,
+    lm_verify_chain,
+    tiny_lm_params,
+)
+
+PARAMS = tiny_lm_params()
+DRAFT = draft_lm_params(PARAMS)
+
+
+def _run(sched, max_steps=4000, until=None):
+    for _ in range(max_steps):
+        sched.step()
+        if until is not None and sched.finished_total >= until:
+            return
+        if not sched.waiting and not sched.running:
+            return
+    raise AssertionError(f"scheduler did not drain: {sched.stats()}")
+
+
+def _outputs(sched) -> dict:
+    return {s.seq_id: list(s.out) for s in sched.finished}
+
+
+def _sched(cache=None, draft=DRAFT, k=3, **kw):
+    cache = cache or PagedKVCache(64, 4, 16)
+    return IterationScheduler(cache, PARAMS, draft_params=draft,
+                              draft_k=k, **kw)
+
+
+# -- model-side pieces --------------------------------------------------------
+
+
+def test_lm_prefill_from_empty_prefix_is_lm_prefill():
+    k, v, nxt = lm_prefill(PARAMS, [4, 9, 11])
+    empty = np.zeros((0, 16), np.float32)
+    k2, v2, n2 = lm_prefill_from(PARAMS, [4, 9, 11], empty, empty)
+    np.testing.assert_array_equal(k, k2)
+    np.testing.assert_array_equal(v, v2)
+    assert nxt == n2
+
+
+def test_lm_prefill_from_any_split_is_bitwise_identical():
+    tokens = [4, 9, 11, 30, 2, 8, 17]
+    k_ref, v_ref, nxt_ref = lm_prefill(PARAMS, tokens)
+    for cut in range(1, len(tokens)):
+        k_new, v_new, nxt = lm_prefill_from(
+            PARAMS, tokens, k_ref[:cut], v_ref[:cut])
+        np.testing.assert_array_equal(k_new, k_ref[cut:])
+        np.testing.assert_array_equal(v_new, v_ref[cut:])
+        assert nxt == nxt_ref
+
+
+def test_lm_prefill_from_rejects_full_or_overlong_prefix():
+    k, v, _ = lm_prefill(PARAMS, [4, 9])
+    with pytest.raises(ValueError):
+        lm_prefill_from(PARAMS, [4, 9], k, v)
+
+
+def test_verify_chain_bitwise_equals_repeated_context_steps():
+    """The amortized verify chain (one gather, buffer views, no per-step
+    concat) must be BITWISE the naive lm_context_step loop — that
+    equivalence is what lets speculation inherit the oracle contract."""
+    tokens = [4, 9, 11, 30, 2]
+    k_ref, v_ref, feed = lm_prefill(PARAMS, tokens)
+    n = len(tokens)
+    # naive: one lm_context_step per fed token, re-concatenated context
+    ks, vs = list(k_ref), list(v_ref)
+    naive, tok = [], feed
+    for j in range(4):
+        nxt, kv, vv = lm_context_step(
+            PARAMS, tok, n + j,
+            np.asarray(ks, np.float32), np.asarray(vs, np.float32))
+        ks.append(kv)
+        vs.append(vv)
+        naive.append(nxt)
+        tok = nxt
+    # chained: proposals == the target's own outputs, so all accepted
+    buf_k = np.empty((n + 4, 16), np.float32)
+    buf_v = np.empty_like(buf_k)
+    buf_k[:n] = k_ref
+    buf_v[:n] = v_ref
+    chain = lm_verify_chain(PARAMS, feed, naive[:3], n, buf_k, buf_v)
+    assert chain == naive
+    np.testing.assert_array_equal(buf_k[n:], np.asarray(ks[n:], np.float32))
+    np.testing.assert_array_equal(buf_v[n:], np.asarray(vs[n:], np.float32))
+    # first-mismatch-wins: a wrong proposal stops the chain AFTER the
+    # target's own (correct) token for that slot
+    buf_k[:n] = k_ref
+    buf_v[:n] = v_ref
+    wrong = [naive[0], (naive[1] + 1) % PARAMS["vocab"], naive[2]]
+    cut = lm_verify_chain(PARAMS, feed, wrong, n, buf_k, buf_v)
+    assert cut == naive[:2]
+    # guard parity with lm_context_step's max-context check
+    with pytest.raises(ValueError, match="max_context"):
+        lm_verify_chain(PARAMS, feed, [1] * len(PARAMS["pos"]), n,
+                        buf_k, buf_v)
+
+
+def test_draft_chain_stateless_and_bounded():
+    props = lm_draft_chain(DRAFT, 5, 3, 4)
+    assert props == lm_draft_chain(DRAFT, 5, 3, 4)   # deterministic
+    assert len(props) == 4
+    # position-dependent (it reads the pos table), eos stops early
+    assert lm_draft_chain(DRAFT, 5, 3, 4, eos_id=props[0]) == props[:1]
+    with pytest.raises(ValueError, match="max_context"):
+        lm_draft_chain(DRAFT, 5, len(DRAFT["pos"]) - 2, 4)
+
+
+def test_draft_params_deterministic_and_close():
+    d2 = draft_lm_params(PARAMS)
+    for key in ("embed", "pos", "wq", "wk", "wv", "wo"):
+        np.testing.assert_array_equal(DRAFT[key], d2[key])
+        assert DRAFT[key].dtype == np.float32
+        # perturbed (it IS a different model) but only at f16 resolution
+        assert not np.array_equal(DRAFT[key], PARAMS[key])
+        np.testing.assert_allclose(DRAFT[key], PARAMS[key], rtol=2e-3,
+                                   atol=2e-3)
+
+
+# -- the oracle bar -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("k", [1, 2, 3, 4])
+def test_single_sequence_oracle_exact_for_every_draft_k(k):
+    s = _sched(k=k)
+    s.submit(Sequence(0, [3, 17, 5], 16))
+    _run(s, until=1)
+    assert _outputs(s)[0] == lm_generate(PARAMS, [3, 17, 5], 16)
+    assert s.cache.alloc.used_count == 0
+
+
+def test_acceptance_rate_high_and_tokens_per_iteration_above_one():
+    """The perf claim in miniature: the f16 draft's proposals almost all
+    survive greedy verify, so the engine emits well over one token per
+    target iteration for the same oracle-exact output."""
+    s = _sched(k=3)
+    for i in range(4):
+        s.submit(Sequence(i, [3 * i + 1, 5 * i + 2], 24))
+    _run(s, until=4)
+    for i in range(4):
+        assert _outputs(s)[i] == lm_generate(
+            PARAMS, [3 * i + 1, 5 * i + 2], 24)
+    st = s.stats()
+    assert st["spec_proposed_total"] > 0
+    rate = st["spec_accepted_total"] / st["spec_proposed_total"]
+    assert rate >= 0.5, f"f16 draft acceptance collapsed: {rate:.2f}"
+    per_iter = st["tokens_decode_total"] / st["iterations_total"]
+    # 4 sequences per iteration at >= ~2 tokens each when accepting
+    assert per_iter > len(_outputs(s)) * 1.3, \
+        f"speculation bought nothing: {per_iter:.2f} tokens/iteration"
+    # occupancy counts sequences, not tokens — unchanged by speculation
+    assert st["occupancy_sum"] <= st["iterations_total"] * 4
+
+
+def test_garbage_draft_still_oracle_exact_with_low_acceptance():
+    """A draft from a DIFFERENT seed proposes mostly wrong tokens: the
+    verify loop must discard them and still emit the target's exact
+    output — speculation may only cost, never corrupt."""
+    garbage = tiny_lm_params(seed=99)
+    s = _sched(draft=garbage, k=4)
+    for i in range(3):
+        s.submit(Sequence(i, [i + 1, 2 * i + 3, 7], 20))
+    _run(s, until=3)
+    for i in range(3):
+        assert _outputs(s)[i] == lm_generate(
+            PARAMS, [i + 1, 2 * i + 3, 7], 20)
+    st = s.stats()
+    assert st["spec_proposed_total"] > 0
+    rate = st["spec_accepted_total"] / st["spec_proposed_total"]
+    assert rate < 0.9          # a garbage draft cannot look like a good one
+
+
+def test_eos_mid_speculation_cuts_exactly_like_oracle():
+    oracle = lm_generate(PARAMS, [3, 17, 5], 32)
+    eos = oracle[4]                     # stops mid-verify-window
+    s = _sched(k=3)
+    s.submit(Sequence(0, [3, 17, 5], 32, eos_id=eos))
+    _run(s, until=1)
+    assert _outputs(s)[0] == lm_generate(PARAMS, [3, 17, 5], 32, eos_id=eos)
+    assert _outputs(s)[0] == oracle[:5]
+
+
+def test_max_new_tokens_never_overshoots():
+    for max_new in (1, 2, 3, 4, 5):
+        s = _sched(k=4)
+        s.submit(Sequence(0, [9, 30, 2], max_new))
+        _run(s, until=1)
+        out = _outputs(s)[0]
+        assert out == lm_generate(PARAMS, [9, 30, 2], max_new)
+        assert len(out) == max_new
+
+
+def test_churn_batch_with_speculation_oracle_exact():
+    """The contamination oracle under speculation: overlapping mixed
+    lengths through a pool small enough to force preemption — every
+    output bitwise oracle-equal, allocator invariants clean."""
+    rng = np.random.RandomState(11)
+    cache = PagedKVCache(24, 4, 16, watermark=1 / 24)
+    s = _sched(cache=cache, k=3, max_active=4, admission_window=8)
+    prompts = {}
+    for i in range(10):
+        pr = [int(t) for t in rng.randint(0, 64, rng.randint(1, 7))]
+        prompts[i] = pr
+        s.submit(Sequence(i, pr, int(rng.randint(2, 12))))
+    _run(s, until=10, max_steps=8000)
+    outs = _outputs(s)
+    for i, pr in prompts.items():
+        seq = next(q for q in s.finished if q.seq_id == i)
+        assert outs[i] == lm_generate(PARAMS, pr, seq.max_new_tokens), \
+            f"sequence {i} diverged under speculative churn"
+    cache.alloc.check_invariants()
+    assert cache.alloc.used_count == 0
+
+
+def test_preempt_mid_generation_resumes_exactly_with_draft():
+    prompt, max_new = [3, 17, 5], 12
+    s = _sched(k=3, max_active=2)
+    seq = Sequence(0, prompt, max_new)
+    s.submit(seq)
+    for _ in range(2):
+        s.step()
+    assert seq.state == "running" and len(seq.out) >= 2
+    s._preempt(seq)
+    _run(s, until=1)
+    assert seq.out == lm_generate(PARAMS, prompt, max_new)
+
+
+def test_handoff_admission_speculates_exactly():
+    """A sequence entering via the prefill-pool handoff path decodes
+    speculatively to the same oracle output as the local path."""
+    prompt, max_new = [9, 30, 2], 10
+    k, v, first = lm_prefill(PARAMS, prompt)
+    s = _sched(cache=PagedKVCache(16, 4, 16), k=3)
+    s.submit(Sequence(0, prompt, max_new, first_token=first,
+                      handoff=(k, v)))
+    _run(s, until=1)
+    assert _outputs(s)[0] == lm_generate(PARAMS, prompt, max_new)
+    assert s.stats()["spec_accepted_total"] > 0
+
+
+def test_draft_disabled_paths():
+    # draft_k=0 with params: speculation off, counters stay zero
+    s = IterationScheduler(PagedKVCache(16, 4, 16), PARAMS,
+                           draft_params=DRAFT, draft_k=0)
+    s.submit(Sequence(0, [1, 2], 6))
+    _run(s, until=1)
+    assert _outputs(s)[0] == lm_generate(PARAMS, [1, 2], 6)
+    st = s.stats()
+    assert st["spec_proposed_total"] == 0 and st["spec_accepted_total"] == 0
+    # draft_k>0 without params: likewise off
+    s2 = IterationScheduler(PagedKVCache(16, 4, 16), PARAMS, draft_k=3)
+    assert s2.draft_k == 0
+    with pytest.raises(ValueError, match="draft_k"):
+        IterationScheduler(PagedKVCache(16, 4, 16), PARAMS, draft_k=-1)
+
+
+def test_speculation_composes_with_prefix_cache():
+    """Both tentpole optimizations on at once: shared-prefix admissions
+    feeding speculative decode stay oracle-exact and actually share."""
+    cache = PagedKVCache(64, 4, 16, prefix_cache=True)
+    s = _sched(cache=cache, k=3, max_active=4)
+    sys_prompt = [7, 7, 7, 7, 2, 9]          # > one full block shared
+    for i in range(6):
+        s.submit(Sequence(i, sys_prompt + [i + 1], 10))
+    _run(s, until=6)
+    for i in range(6):
+        assert _outputs(s)[i] == lm_generate(PARAMS, sys_prompt + [i + 1],
+                                             10)
+    st = s.stats()
+    assert st["prefix_hit_tokens_total"] > 0
+    assert st["spec_accepted_total"] > 0
+    cache.alloc.check_invariants()

@@ -655,3 +655,63 @@ def test_injected_reset_demotes_then_repromotes_bitwise():
         assert o["plane"] == 1, "world did not return to the ring plane"
     assert {r["out"]["hash"] for r in faulty} == clean_hash, \
         "faulted world diverged bitwise from the clean world"
+
+
+# -- bind_with_retry (ISSUE 20 satellite) -------------------------------------
+
+
+def _bind(p):
+    s = socket.create_server(("127.0.0.1", p))
+    return s
+
+
+def test_bind_with_retry_free_port_binds_at_offset_zero():
+    from launch_util import free_port
+
+    port = free_port()
+    s, offset = resilience.bind_with_retry(_bind, port)
+    try:
+        assert offset == 0 and s.getsockname()[1] == port
+    finally:
+        s.close()
+
+
+def test_bind_with_retry_slides_through_the_window():
+    from launch_util import free_port
+
+    port = free_port()
+    holder = socket.create_server(("127.0.0.1", port))
+    try:
+        with pytest.raises(OSError):          # window=1: no slide allowed
+            resilience.bind_with_retry(_bind, port, window=1)
+        s, offset = resilience.bind_with_retry(_bind, port, window=8)
+        try:
+            assert offset >= 1
+            assert s.getsockname()[1] == port + offset
+        finally:
+            s.close()
+    finally:
+        holder.close()
+
+
+def test_bind_with_retry_deadline_outwaits_a_lingering_holder():
+    from launch_util import free_port
+
+    port = free_port()
+    holder = socket.create_server(("127.0.0.1", port))
+    threading.Timer(0.4, holder.close).start()
+    t0 = time.monotonic()
+    s, offset = resilience.bind_with_retry(_bind, port, deadline_s=10.0,
+                                           sleep_s=0.05)
+    try:
+        assert offset == 0 and time.monotonic() - t0 >= 0.3
+    finally:
+        s.close()
+
+
+def test_bind_with_retry_propagates_non_eaddrinuse_errors():
+    def boom(p):
+        raise OSError(13, "Permission denied")
+
+    with pytest.raises(OSError, match="Permission denied"):
+        resilience.bind_with_retry(boom, 1)

